@@ -1,0 +1,30 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference framework.
+
+Capability-parity rebuild of NVIDIA Dynamo (surveyed in SURVEY.md) designed
+TPU-first: the orchestration layer (runtime, KV-aware router, disaggregated
+serving, KV block manager, planner) plus — unlike the reference, which
+delegates to vLLM/SGLang/TRT-LLM — a native JAX/XLA/Pallas inference engine.
+
+Layer map (mirrors reference layers L0..L8, see SURVEY.md §1):
+  runtime/    — distributed runtime: discovery, request plane, endpoints,
+                cancellation, metrics (ref: lib/runtime)
+  tokens/     — token blocks + PositionalLineageHash contract
+                (ref: lib/tokens, lib/kv-hashing)
+  router/     — KV-aware routing: indexer, selector, slot manager
+                (ref: lib/kv-router, lib/llm/src/kv_router)
+  mocker/     — GPU/TPU-free simulated engine for CPU-only testing
+                (ref: lib/mocker)
+  frontend/   — OpenAI-compatible HTTP service + preprocessor + pipeline
+                (ref: lib/llm/src/http, preprocessor, entrypoint)
+  engine/     — native JAX engine: continuous batching, paged KV cache,
+                sampling, worker contract (new; no reference equivalent)
+  models/     — model families (Llama dense, MoE) as functional JAX code
+  ops/        — Pallas/XLA kernels (paged attention, block gather/scatter)
+  parallel/   — mesh/sharding policy (tp/dp/ep/sp over ICI)
+  kvbm/       — multi-tier KV block manager G1(HBM)/G2(host)/G3(disk)
+                (ref: lib/kvbm-*)
+  planner/    — SLA autoscaler OBSERVE→PREDICT→PROPOSE→EXECUTE
+                (ref: components/src/dynamo/planner)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,50 @@
+from .cancellation import CancellationToken
+from .config import RuntimeConfig, parse_truthy
+from .component import Client, Component, Endpoint, Namespace, ServedEndpoint
+from .discovery import (
+    DiscoveryBackend,
+    FileDiscovery,
+    Instance,
+    MemDiscovery,
+    WatchEvent,
+    make_discovery,
+    new_instance_id,
+)
+from .distributed import DistributedRuntime
+from .event_plane import EventPlane, InProcEventPlane, ZmqEventPlane
+from .metrics import MetricsHierarchy
+from .push_router import PushRouter, RouterMode
+from .request_plane import (
+    EngineError,
+    RequestContext,
+    RequestPlaneClient,
+    RequestPlaneServer,
+)
+
+__all__ = [
+    "CancellationToken",
+    "Client",
+    "Component",
+    "DiscoveryBackend",
+    "DistributedRuntime",
+    "Endpoint",
+    "EngineError",
+    "EventPlane",
+    "FileDiscovery",
+    "InProcEventPlane",
+    "Instance",
+    "MemDiscovery",
+    "MetricsHierarchy",
+    "Namespace",
+    "PushRouter",
+    "RequestContext",
+    "RequestPlaneClient",
+    "RequestPlaneServer",
+    "RouterMode",
+    "RuntimeConfig",
+    "ServedEndpoint",
+    "WatchEvent",
+    "ZmqEventPlane",
+    "new_instance_id",
+    "parse_truthy",
+]

@@ -1,0 +1,44 @@
+"""Small asyncio helpers shared across the runtime."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, Optional
+
+_SENTINEL = object()
+
+
+async def next_or_cancel(q: asyncio.Queue, cancel: Optional[asyncio.Event]) -> Any:
+    """Await the next queue item, or return the CANCELLED sentinel if the
+    cancel event fires first.  Pending futures are always cleaned up."""
+    if cancel is None:
+        return await q.get()
+    if cancel.is_set():
+        return CANCELLED
+    get = asyncio.ensure_future(q.get())
+    cw = asyncio.ensure_future(cancel.wait())
+    try:
+        done, pending = await asyncio.wait(
+            {get, cw}, return_when=asyncio.FIRST_COMPLETED
+        )
+    finally:
+        for f in (get, cw):
+            if not f.done():
+                f.cancel()
+    if get in done:
+        return get.result()
+    return CANCELLED
+
+
+CANCELLED = _SENTINEL
+
+
+async def iter_queue(
+    q: asyncio.Queue, cancel: Optional[asyncio.Event]
+) -> AsyncIterator[Any]:
+    """Yield queue items until the cancel event fires."""
+    while cancel is None or not cancel.is_set():
+        item = await next_or_cancel(q, cancel)
+        if item is CANCELLED:
+            return
+        yield item

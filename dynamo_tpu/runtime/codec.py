@@ -1,0 +1,43 @@
+"""Wire codec for the request plane.
+
+Length-prefixed msgpack frames (ref: the two-part codec in
+lib/runtime/src/pipeline/network/codec/).  One TCP connection multiplexes many
+concurrent request/response streams, keyed by request id.
+
+Frame types (field "t"):
+  client→server:  req   {t, id, path, payload, ctx}
+                  cancel{t, id, kill}
+  server→client:  data  {t, id, data}          (one per stream item)
+                  err   {t, id, error}         (terminal)
+                  end   {t, id}                (terminal)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Dict
+
+import msgpack
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def encode_frame(obj: Dict[str, Any]) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Dict[str, Any]:
+    hdr = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    body = await reader.readexactly(n)
+    return msgpack.unpackb(body, raw=False)
+
+
+async def write_frame(writer: asyncio.StreamWriter, obj: Dict[str, Any]) -> None:
+    writer.write(encode_frame(obj))
+    await writer.drain()

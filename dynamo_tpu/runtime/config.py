@@ -1,0 +1,69 @@
+"""Runtime configuration from environment (ref: lib/runtime/src/config.rs:46).
+
+Keeps the reference's `DYN_*` environment vocabulary so deployment docs and
+operator-injected env translate directly.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+_TRUTHY = {"1", "true", "yes", "on", "y", "t"}
+_FALSY = {"0", "false", "no", "off", "n", "f", ""}
+
+
+def parse_truthy(value: str | bool | None, default: bool = False) -> bool:
+    """Canonical boolean env parsing (ref: lib/truthy/src/lib.rs:1-12)."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        return value
+    v = value.strip().lower()
+    if v in _TRUTHY:
+        return True
+    if v in _FALSY:
+        return False
+    raise ValueError(f"unrecognized boolean value: {value!r}")
+
+
+def env_truthy(name: str, default: bool = False) -> bool:
+    return parse_truthy(os.environ.get(name), default)
+
+
+@dataclass
+class RuntimeConfig:
+    # discovery plane (ref: docs/design-docs/distributed-runtime.md:40-48)
+    discovery_backend: str = "mem"  # mem | file
+    discovery_path: str = ""  # root dir for the file backend
+    lease_ttl_s: float = 5.0
+
+    # request plane (ref: docs/design-docs/request-plane.md:8-47)
+    request_plane: str = "tcp"
+    tcp_host: str = "127.0.0.1"
+    tcp_port: int = 0  # 0 = ephemeral
+
+    # event plane (ref: docs/design-docs/event-plane.md:20-57)
+    event_plane: str = "auto"  # auto: zmq when file discovery, else inproc
+
+    namespace: str = "dynamo"
+    system_port: int = 0  # /health /live /metrics server; 0 = disabled
+
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RuntimeConfig":
+        cfg = cls(
+            discovery_backend=os.environ.get("DYN_DISCOVERY_BACKEND", "mem"),
+            discovery_path=os.environ.get("DYN_DISCOVERY_PATH", ""),
+            lease_ttl_s=float(os.environ.get("DYN_LEASE_TTL", "5.0")),
+            request_plane=os.environ.get("DYN_REQUEST_PLANE", "tcp"),
+            tcp_host=os.environ.get("DYN_TCP_HOST", "127.0.0.1"),
+            tcp_port=int(os.environ.get("DYN_TCP_PORT", "0")),
+            event_plane=os.environ.get("DYN_EVENT_PLANE", "auto"),
+            namespace=os.environ.get("DYN_NAMESPACE", "dynamo"),
+            system_port=int(os.environ.get("DYN_SYSTEM_PORT", "0")),
+        )
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        return cfg

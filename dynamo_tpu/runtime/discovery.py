@@ -1,0 +1,318 @@
+"""Discovery plane: lease-scoped KV store with prefix watch.
+
+Models the reference's discovery abstraction (ref: lib/runtime/src/discovery/,
+docs/design-docs/distributed-runtime.md:40-66): instances register themselves
+under `v1/instances/{ns}/{component}/{endpoint}/{instance_id}`, model cards
+under `v1/mdc/{ns}/{model}`, and consumers `list_and_watch` a prefix.  Entries
+are bound to a lease; when the owner dies the lease expires and watchers see a
+delete — that is the failure-detection primitive everything else builds on.
+
+Backends:
+  * MemDiscovery  — in-process, shared per cluster_id (test default; ref mock.rs)
+  * FileDiscovery — a directory tree on local disk with mtime heartbeats;
+    supports multi-process single-host clusters with zero infra
+    (ref: file discovery backend).
+An etcd/K8s backend slots in behind the same interface when available.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+INSTANCE_PREFIX = "v1/instances"
+MDC_PREFIX = "v1/mdc"
+EVENT_ENDPOINT_PREFIX = "v1/events"
+
+
+def new_instance_id() -> int:
+    return secrets.randbits(63)
+
+
+@dataclass(frozen=True)
+class Instance:
+    """A live endpoint instance (ref: lib/runtime/src/component.rs:107)."""
+
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int
+    address: str  # request-plane address, "host:port"
+    metadata: Dict[str, Any] = field(default_factory=dict, hash=False)
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.endpoint}"
+
+    def key(self) -> str:
+        return f"{INSTANCE_PREFIX}/{self.path}/{self.instance_id}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "namespace": self.namespace,
+            "component": self.component,
+            "endpoint": self.endpoint,
+            "instance_id": self.instance_id,
+            "address": self.address,
+            "metadata": self.metadata,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Instance":
+        return Instance(
+            namespace=d["namespace"],
+            component=d["component"],
+            endpoint=d["endpoint"],
+            instance_id=int(d["instance_id"]),
+            address=d["address"],
+            metadata=d.get("metadata", {}),
+        )
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str  # "put" | "delete"
+    key: str
+    value: Optional[Dict[str, Any]] = None
+
+
+class DiscoveryBackend:
+    """Lease-scoped KV store with prefix watch."""
+
+    async def start(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    async def close(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    async def put(self, key: str, value: Dict[str, Any], lease: bool = True) -> None:
+        raise NotImplementedError
+
+    async def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    async def get_prefix(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        raise NotImplementedError
+
+    def watch(
+        self, prefix: str, cancel: Optional[asyncio.Event] = None
+    ) -> AsyncIterator[WatchEvent]:
+        """Yields a `put` for every existing key, then live updates."""
+        raise NotImplementedError
+
+    async def revoke_lease(self) -> None:
+        """Drop every key registered under this backend instance's lease."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# In-memory backend (per-process clusters, the unit/integration test default)
+# ---------------------------------------------------------------------------
+
+
+class _MemCluster:
+    def __init__(self) -> None:
+        self.store: Dict[str, Dict[str, Any]] = {}
+        self.watchers: List[Tuple[str, asyncio.Queue]] = []
+
+    def notify(self, ev: WatchEvent) -> None:
+        for prefix, q in list(self.watchers):
+            if ev.key.startswith(prefix):
+                q.put_nowait(ev)
+
+
+_MEM_CLUSTERS: Dict[str, _MemCluster] = {}
+
+
+class MemDiscovery(DiscoveryBackend):
+    def __init__(self, cluster_id: str = "default"):
+        self.cluster_id = cluster_id
+        self._cluster = _MEM_CLUSTERS.setdefault(cluster_id, _MemCluster())
+        self._owned: set[str] = set()
+
+    async def put(self, key: str, value: Dict[str, Any], lease: bool = True) -> None:
+        self._cluster.store[key] = value
+        if lease:
+            self._owned.add(key)
+        self._cluster.notify(WatchEvent("put", key, value))
+
+    async def delete(self, key: str) -> None:
+        self._cluster.store.pop(key, None)
+        self._owned.discard(key)
+        self._cluster.notify(WatchEvent("delete", key))
+
+    async def get_prefix(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        return {k: v for k, v in self._cluster.store.items() if k.startswith(prefix)}
+
+    async def watch(
+        self, prefix: str, cancel: Optional[asyncio.Event] = None
+    ) -> AsyncIterator[WatchEvent]:
+        from .aio import iter_queue
+
+        q: asyncio.Queue = asyncio.Queue()
+        entry = (prefix, q)
+        self._cluster.watchers.append(entry)
+        try:
+            for k, v in list(self._cluster.store.items()):
+                if k.startswith(prefix):
+                    yield WatchEvent("put", k, v)
+            async for ev in iter_queue(q, cancel):
+                yield ev
+        finally:
+            try:
+                self._cluster.watchers.remove(entry)
+            except ValueError:
+                pass
+
+    async def revoke_lease(self) -> None:
+        for key in list(self._owned):
+            await self.delete(key)
+
+    async def close(self) -> None:
+        await self.revoke_lease()
+
+
+# ---------------------------------------------------------------------------
+# File backend (multi-process single-host clusters, no external infra)
+# ---------------------------------------------------------------------------
+
+
+def _key_to_relpath(key: str) -> str:
+    # key components never contain os separators other than '/'
+    return key.replace("/", os.sep) + ".json"
+
+
+class FileDiscovery(DiscoveryBackend):
+    """Directory-tree KV store with mtime-heartbeat leases.
+
+    Heartbeat task refreshes mtimes of owned keys every ttl/3; scanners treat
+    files older than ttl as expired (delete + unlink).  Watch is poll-based
+    (interval default 100ms) — fine for control-plane rates.
+    """
+
+    def __init__(self, root: str, ttl_s: float = 5.0, poll_s: float = 0.1):
+        self.root = root
+        self.ttl_s = ttl_s
+        self.poll_s = poll_s
+        self._owned: set[str] = set()
+        self._hb_task: Optional[asyncio.Task] = None
+        self._closed = asyncio.Event()
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, _key_to_relpath(key))
+
+    async def start(self) -> None:
+        if self._hb_task is None:
+            self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._closed.is_set():
+            for key in list(self._owned):
+                p = self._path(key)
+                try:
+                    os.utime(p, None)
+                except FileNotFoundError:
+                    self._owned.discard(key)
+            try:
+                await asyncio.wait_for(self._closed.wait(), timeout=self.ttl_s / 3)
+            except asyncio.TimeoutError:
+                pass
+
+    async def put(self, key: str, value: Dict[str, Any], lease: bool = True) -> None:
+        await self.start()
+        p = self._path(key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp{secrets.token_hex(4)}"
+        with open(tmp, "w") as f:
+            json.dump(value, f)
+        os.replace(tmp, p)
+        if lease:
+            self._owned.add(key)
+
+    async def delete(self, key: str) -> None:
+        self._owned.discard(key)
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def _scan(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        now = time.time()
+        base = self.root
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in files:
+                if not fn.endswith(".json"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, base)
+                key = rel[: -len(".json")].replace(os.sep, "/")
+                if not key.startswith(prefix):
+                    continue
+                try:
+                    st = os.stat(full)
+                    if now - st.st_mtime > self.ttl_s:
+                        # expired lease — reap so watchers converge
+                        try:
+                            os.unlink(full)
+                        except OSError:
+                            pass
+                        continue
+                    with open(full) as f:
+                        out[key] = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue  # concurrent write/delete; next poll catches up
+        return out
+
+    async def get_prefix(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        return await asyncio.get_event_loop().run_in_executor(None, self._scan, prefix)
+
+    async def watch(
+        self, prefix: str, cancel: Optional[asyncio.Event] = None
+    ) -> AsyncIterator[WatchEvent]:
+        known: Dict[str, str] = {}
+        while cancel is None or not cancel.is_set():
+            snap = await self.get_prefix(prefix)
+            cur = {k: json.dumps(v, sort_keys=True) for k, v in snap.items()}
+            for k, ser in cur.items():
+                if known.get(k) != ser:
+                    yield WatchEvent("put", k, snap[k])
+            for k in list(known):
+                if k not in cur:
+                    yield WatchEvent("delete", k)
+            known = cur
+            try:
+                if cancel is not None:
+                    await asyncio.wait_for(cancel.wait(), timeout=self.poll_s)
+                    break
+                await asyncio.sleep(self.poll_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def revoke_lease(self) -> None:
+        for key in list(self._owned):
+            await self.delete(key)
+
+    async def close(self) -> None:
+        self._closed.set()
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
+        await self.revoke_lease()
+
+
+def make_discovery(backend: str, *, path: str = "", ttl_s: float = 5.0,
+                   cluster_id: str = "default") -> DiscoveryBackend:
+    if backend == "mem":
+        return MemDiscovery(cluster_id=cluster_id)
+    if backend == "file":
+        if not path:
+            raise ValueError("file discovery requires DYN_DISCOVERY_PATH")
+        return FileDiscovery(path, ttl_s=ttl_s)
+    raise ValueError(f"unknown discovery backend: {backend}")

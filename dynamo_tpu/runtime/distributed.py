@@ -1,0 +1,90 @@
+"""DistributedRuntime: the per-process root object.
+
+Ref: lib/runtime/src/distributed.rs:47 — owns the discovery client (with
+lease keepalive), the lazily-started request-plane server, the request-plane
+client pool, the event plane, the metrics registry, and the root cancellation
+token.  Everything else (`Namespace` → `Component` → `Endpoint`) hangs off it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+from .cancellation import CancellationToken
+from .component import Namespace
+from .config import RuntimeConfig
+from .discovery import DiscoveryBackend, make_discovery, new_instance_id
+from .event_plane import EventPlane, make_event_plane
+from .metrics import MetricsHierarchy
+from .request_plane import RequestPlaneClient, RequestPlaneServer
+
+logger = logging.getLogger(__name__)
+
+
+class DistributedRuntime:
+    def __init__(self, config: Optional[RuntimeConfig] = None,
+                 discovery: Optional[DiscoveryBackend] = None,
+                 cluster_id: str = "default"):
+        self.config = config or RuntimeConfig.from_env()
+        self.cluster_id = cluster_id
+        self.worker_id = new_instance_id()
+        self.root_token = CancellationToken()
+        self.discovery = discovery or make_discovery(
+            self.config.discovery_backend,
+            path=self.config.discovery_path,
+            ttl_s=self.config.lease_ttl_s,
+            cluster_id=cluster_id,
+        )
+        ep_kind = self.config.event_plane
+        if ep_kind == "auto":
+            ep_kind = "zmq" if self.config.discovery_backend == "file" else "inproc"
+        self.event_plane: EventPlane = make_event_plane(
+            ep_kind, self.discovery, cluster_id
+        )
+        self.request_server = RequestPlaneServer(
+            self.config.tcp_host, self.config.tcp_port,
+            root_token=self.root_token,
+        )
+        self.request_client = RequestPlaneClient()
+        self.metrics = MetricsHierarchy(namespace=self.config.namespace)
+        self._system_server = None
+        self._closed = False
+
+    @classmethod
+    def detached(cls, **overrides) -> "DistributedRuntime":
+        """Construct from environment (`DYN_*`), the worker-process entry."""
+        return cls(config=RuntimeConfig.from_env(**overrides))
+
+    def namespace(self, name: Optional[str] = None) -> Namespace:
+        return Namespace(self, name or self.config.namespace)
+
+    async def start(self) -> "DistributedRuntime":
+        await self.discovery.start()
+        if self.config.system_port:
+            from .system_status import SystemStatusServer
+
+            self._system_server = SystemStatusServer(self, self.config.system_port)
+            await self._system_server.start()
+        return self
+
+    async def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.root_token.kill()
+        if self._system_server is not None:
+            await self._system_server.close()
+        await self.request_client.close()
+        await self.request_server.close()
+        await self.event_plane.close()
+        await self.discovery.close()
+        logger.info("runtime %d shut down", self.worker_id)
+
+    async def __aenter__(self) -> "DistributedRuntime":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.shutdown()

@@ -1,0 +1,172 @@
+"""Event plane: pub/sub for KV events, load metrics, replica sync.
+
+Ref: docs/design-docs/event-plane.md:20-57 and
+lib/runtime/src/transports/event_plane/mod.rs:263,624.
+
+Backends:
+  * InProcEventPlane — per-cluster in-process broadcast (test default).
+  * ZmqEventPlane    — each publisher binds a PUB socket on an ephemeral port
+    and announces it in discovery under v1/events/{instance_id}; subscribers
+    watch that prefix and connect SUB sockets with a topic filter.  Pure CPU,
+    works across processes with no broker (ref: ZMQ default event plane).
+
+Subjects are dotted strings, e.g. "kv_events.{namespace}.{component}" — a
+subscription matches subject prefixes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+from .discovery import EVENT_ENDPOINT_PREFIX, DiscoveryBackend, new_instance_id
+
+logger = logging.getLogger(__name__)
+
+
+class EventPlane:
+    async def publish(self, subject: str, payload: Any) -> None:
+        raise NotImplementedError
+
+    def subscribe(
+        self, subject_prefix: str, cancel: Optional[asyncio.Event] = None
+    ) -> AsyncIterator[Tuple[str, Any]]:
+        raise NotImplementedError
+
+    async def close(self) -> None:  # pragma: no cover - trivial
+        pass
+
+
+# ---------------------------------------------------------------------------
+
+
+class _InProcBus:
+    def __init__(self) -> None:
+        self.subs: List[Tuple[str, asyncio.Queue]] = []
+
+
+_BUSES: Dict[str, _InProcBus] = {}
+
+
+class InProcEventPlane(EventPlane):
+    def __init__(self, cluster_id: str = "default"):
+        self._bus = _BUSES.setdefault(cluster_id, _InProcBus())
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        for prefix, q in list(self._bus.subs):
+            if subject.startswith(prefix):
+                q.put_nowait((subject, payload))
+
+    async def subscribe(
+        self, subject_prefix: str, cancel: Optional[asyncio.Event] = None
+    ) -> AsyncIterator[Tuple[str, Any]]:
+        from .aio import iter_queue
+
+        q: asyncio.Queue = asyncio.Queue()
+        ent = (subject_prefix, q)
+        self._bus.subs.append(ent)
+        try:
+            async for item in iter_queue(q, cancel):
+                yield item
+        finally:
+            try:
+                self._bus.subs.remove(ent)
+            except ValueError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+
+
+class ZmqEventPlane(EventPlane):
+    """Brokerless ZMQ pub/sub with discovery-announced publisher endpoints."""
+
+    def __init__(self, discovery: DiscoveryBackend, host: str = "127.0.0.1"):
+        import zmq
+        import zmq.asyncio
+
+        self._zmq = zmq
+        self._ctx = zmq.asyncio.Context.instance()
+        self.discovery = discovery
+        self.host = host
+        self._pub = None
+        self._pub_addr: Optional[str] = None
+        self._iid = new_instance_id()
+
+    async def _ensure_pub(self) -> None:
+        if self._pub is None:
+            self._pub = self._ctx.socket(self._zmq.PUB)
+            port = self._pub.bind_to_random_port(f"tcp://{self.host}")
+            self._pub_addr = f"tcp://{self.host}:{port}"
+            await self.discovery.put(
+                f"{EVENT_ENDPOINT_PREFIX}/{self._iid}", {"address": self._pub_addr}
+            )
+            # PUB/SUB joins are async; give subscribers a beat to connect.
+            await asyncio.sleep(0.05)
+
+    async def publish(self, subject: str, payload: Any) -> None:
+        await self._ensure_pub()
+        assert self._pub is not None
+        await self._pub.send_multipart(
+            [subject.encode(), msgpack.packb(payload, use_bin_type=True)]
+        )
+
+    async def subscribe(
+        self, subject_prefix: str, cancel: Optional[asyncio.Event] = None
+    ) -> AsyncIterator[Tuple[str, Any]]:
+        zmq = self._zmq
+        sub = self._ctx.socket(zmq.SUB)
+        sub.setsockopt(zmq.SUBSCRIBE, subject_prefix.encode())
+        connected: set[str] = set()
+        out_q: asyncio.Queue = asyncio.Queue()
+
+        stop = asyncio.Event()
+
+        async def watch_publishers() -> None:
+            async for ev in self.discovery.watch(
+                EVENT_ENDPOINT_PREFIX + "/", cancel=stop
+            ):
+                if ev.type == "put" and ev.value:
+                    addr = ev.value.get("address")
+                    if addr and addr not in connected:
+                        sub.connect(addr)
+                        connected.add(addr)
+
+        async def recv_loop() -> None:
+            while True:
+                subject, body = await sub.recv_multipart()
+                out_q.put_nowait(
+                    (subject.decode(), msgpack.unpackb(body, raw=False))
+                )
+
+        wt = asyncio.create_task(watch_publishers())
+        rt = asyncio.create_task(recv_loop())
+        try:
+            from .aio import iter_queue
+
+            async for item in iter_queue(out_q, cancel):
+                yield item
+        finally:
+            stop.set()
+            wt.cancel()
+            rt.cancel()
+            sub.close(linger=0)
+
+    async def close(self) -> None:
+        if self._pub is not None:
+            await self.discovery.delete(f"{EVENT_ENDPOINT_PREFIX}/{self._iid}")
+            self._pub.close(linger=0)
+            self._pub = None
+
+
+def make_event_plane(kind: str, discovery: DiscoveryBackend,
+                     cluster_id: str = "default") -> EventPlane:
+    if kind == "inproc":
+        return InProcEventPlane(cluster_id)
+    if kind == "zmq":
+        return ZmqEventPlane(discovery)
+    raise ValueError(f"unknown event plane: {kind}")

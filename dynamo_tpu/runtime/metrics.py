@@ -1,0 +1,91 @@
+"""Hierarchical Prometheus metrics (ref: lib/runtime/src/metrics.rs).
+
+Every metric created through a MetricsHierarchy is auto-labeled with
+namespace/component/endpoint, so dashboards aggregate across the deployment
+without per-callsite label plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+
+class MetricsHierarchy:
+    _HIER_LABELS = ("dynamo_namespace", "dynamo_component", "dynamo_endpoint")
+
+    def __init__(self, registry: Optional["CollectorRegistry"] = None,
+                 namespace: str = "", component: str = "", endpoint: str = ""):
+        self.registry = registry if registry is not None else CollectorRegistry()
+        self.labels = {
+            "dynamo_namespace": namespace,
+            "dynamo_component": component,
+            "dynamo_endpoint": endpoint,
+        }
+        self._metrics: Dict[str, object] = {}
+
+    def scoped(self, namespace: str = "", component: str = "",
+               endpoint: str = "") -> "MetricsHierarchy":
+        child = MetricsHierarchy(
+            registry=self.registry,
+            namespace=namespace or self.labels["dynamo_namespace"],
+            component=component or self.labels["dynamo_component"],
+            endpoint=endpoint or self.labels["dynamo_endpoint"],
+        )
+        child._metrics = self._metrics  # share metric objects, differ in labels
+        return child
+
+    def _get(self, cls, name: str, doc: str, extra: Sequence[str] = (),
+             **kw):
+        # prometheus metric names are globally unique per registry; a second
+        # callsite with a different extra-label set is a definition error we
+        # surface immediately rather than a late .labels() ValueError
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(name, doc, list(self._HIER_LABELS) + list(extra),
+                    registry=self.registry, **kw)
+            self._metrics[name] = m
+        else:
+            want = tuple(self._HIER_LABELS) + tuple(extra)
+            if tuple(m._labelnames) != want:
+                raise ValueError(
+                    f"metric {name!r} already defined with labels "
+                    f"{m._labelnames}, requested {want}"
+                )
+        return m
+
+    def counter(self, name: str, doc: str = "", extra: Sequence[str] = ()):
+        return self._get(Counter, name, doc, extra)
+
+    def gauge(self, name: str, doc: str = "", extra: Sequence[str] = ()):
+        return self._get(Gauge, name, doc, extra)
+
+    def histogram(self, name: str, doc: str = "", extra: Sequence[str] = (),
+                  buckets=None):
+        kw = {"buckets": buckets} if buckets else {}
+        return self._get(Histogram, name, doc, extra, **kw)
+
+    def inc(self, name: str, value: float = 1.0, doc: str = "", **extra) -> None:
+        self.counter(name, doc, tuple(extra.keys())).labels(
+            **self.labels, **extra
+        ).inc(value)
+
+    def set(self, name: str, value: float, doc: str = "", **extra) -> None:
+        self.gauge(name, doc, tuple(extra.keys())).labels(
+            **self.labels, **extra
+        ).set(value)
+
+    def observe(self, name: str, value: float, doc: str = "", **extra) -> None:
+        self.histogram(name, doc, tuple(extra.keys())).labels(
+            **self.labels, **extra
+        ).observe(value)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

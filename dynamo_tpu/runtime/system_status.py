@@ -1,0 +1,53 @@
+"""Per-process system status server: /health /live /metrics.
+
+Ref: lib/runtime/src/system_status_server.rs:159-222.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import TYPE_CHECKING
+
+from aiohttp import web
+
+if TYPE_CHECKING:
+    from .distributed import DistributedRuntime
+
+
+class SystemStatusServer:
+    def __init__(self, runtime: "DistributedRuntime", port: int,
+                 host: str = "0.0.0.0"):
+        self.runtime = runtime
+        self.host = host
+        self.port = port
+        self._runner = None
+
+    async def _health(self, request: web.Request) -> web.Response:
+        healthy = not self.runtime.root_token.is_stopped()
+        return web.json_response(
+            {"status": "healthy" if healthy else "shutting_down",
+             "worker_id": self.runtime.worker_id},
+            status=200 if healthy else 503,
+        )
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.runtime.metrics.render(),
+                            content_type="text/plain")
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,119 @@
+"""Canonical block-identity hashing: the PositionalLineageHash (PLH) contract.
+
+This is the single source of truth for mapping a token sequence to KV-block
+identities, shared by the engine (paged cache registration), the KV router
+(radix indexer), the mocker (prefix-cache simulation) and the KV block manager
+(dedup registry).  Keeping one implementation used by every subsystem is the
+lesson the reference learned the hard way (its kvbm-consolidator exists to
+reconcile divergent hash streams) — see reference lib/kv-hashing/src/lib.rs:2-8
+and lib/tokens/src/lib.rs:539.
+
+Definition (128-bit, lineage-carrying, position-dependent):
+
+    plh[0]  = H(salt || lora_hash || tokens[0:B])
+    plh[i]  = H(plh[i-1] || tokens[i*B:(i+1)*B])
+
+where H is BLAKE2b-128 and B is the block size.  Because each hash chains its
+parent, equality of plh[i] implies equality of the *entire* token prefix up to
+block i, so a flat hash-set lookup is equivalent to a radix-tree prefix walk —
+the property the router indexer relies on.
+
+Only FULL blocks get a PLH; a trailing partial block is identified by a UUID
+(see blocks.UniqueBlock) and never shared across requests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Optional, Sequence
+
+DEFAULT_BLOCK_SIZE = 64
+
+# A PLH is represented as a Python int in [0, 2**128).
+PositionalLineageHash = int
+
+_HASH_BYTES = 16
+
+
+def _h(data: bytes) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=_HASH_BYTES).digest(), "little"
+    )
+
+
+def _tokens_to_bytes(tokens: Sequence[int]) -> bytes:
+    # uint32 little-endian, matching the wire encoding of token ids.
+    return b"".join(int(t).to_bytes(4, "little", signed=False) for t in tokens)
+
+
+def local_block_hash(tokens: Sequence[int]) -> int:
+    """Content-only (lineage-free) hash of one block's tokens.
+
+    Used where block *content* identity matters irrespective of position
+    (ref: lib/kv-router LocalBlockHash).
+    """
+    return _h(b"lbh\x00" + _tokens_to_bytes(tokens))
+
+
+def compute_block_hashes(
+    tokens: Sequence[int],
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    *,
+    parent: Optional[PositionalLineageHash] = None,
+    salt: bytes = b"",
+) -> list[PositionalLineageHash]:
+    """PLHs for every *full* block of ``tokens``.
+
+    ``parent`` continues an existing lineage (e.g. hashing a continuation of
+    an already-hashed prefix).  The trailing partial block (len < block_size)
+    is ignored.
+    """
+    if block_size <= 0:
+        raise ValueError(f"block_size must be positive, got {block_size}")
+    out: list[PositionalLineageHash] = []
+    prev = parent
+    n_full = len(tokens) // block_size
+    for i in range(n_full):
+        chunk = tokens[i * block_size : (i + 1) * block_size]
+        if prev is None:
+            data = b"plh\x00" + salt + b"\x00" + _tokens_to_bytes(chunk)
+        else:
+            data = prev.to_bytes(_HASH_BYTES, "little") + _tokens_to_bytes(chunk)
+        prev = _h(data)
+        out.append(prev)
+    return out
+
+
+def compute_block_hashes_for_request(
+    token_ids: Sequence[int],
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    *,
+    lora_name: Optional[str] = None,
+) -> list[PositionalLineageHash]:
+    """The Request→Vec<PLH> contract (ref: lib/kv-hashing/src/lib.rs:2-14).
+
+    Pure computation, no I/O.  ``lora_name`` namespaces the lineage so KV from
+    different adapters never aliases.
+    """
+    salt = lora_name.encode() if lora_name else b""
+    return compute_block_hashes(token_ids, block_size, salt=salt)
+
+
+def prefix_overlap_blocks(
+    request_hashes: Sequence[PositionalLineageHash],
+    have: Iterable[PositionalLineageHash] | set,
+) -> int:
+    """Longest prefix (in blocks) of ``request_hashes`` contained in ``have``.
+
+    Because PLHs chain their lineage, membership of hash i implies the whole
+    prefix matches; we still walk front-to-back so a missing early block stops
+    the count (evictions can leave holes in an index).
+    """
+    have_set = have if isinstance(have, (set, frozenset, dict)) else set(have)
+    n = 0
+    for h in request_hashes:
+        if h in have_set:
+            n += 1
+        else:
+            break
+    return n

@@ -1,0 +1,34 @@
+"""Echo client: streams from every discovered echo worker, round-robin.
+
+Usage: DYN_DISCOVERY_BACKEND=file DYN_DISCOVERY_PATH=/tmp/cluster \
+       python examples/runtime_echo_client.py [n_requests]
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, ".")
+
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode
+
+
+async def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    rt = await DistributedRuntime.detached().start()
+    ep = rt.namespace("ns").component("echo").endpoint("generate")
+    client = await ep.client(RouterMode.ROUND_ROBIN).start()
+    insts = await client.wait_for_instances()
+    print(f"discovered {len(insts)} instance(s): "
+          f"{[i.instance_id for i in insts]}", flush=True)
+    for r in range(n):
+        out = []
+        async for item in client.generate({"items": list(range(3))}):
+            out.append(item)
+        print(f"req {r}: worker={out[0]['worker']} "
+              f"echoes={[o['echo'] for o in out]}", flush=True)
+    await client.close()
+    await rt.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,39 @@
+"""Echo worker: serves `ns/echo/generate` on a file-discovery cluster.
+
+Usage: DYN_DISCOVERY_BACKEND=file DYN_DISCOVERY_PATH=/tmp/cluster \
+       python examples/runtime_echo_worker.py [worker_name]
+
+Mirrors the reference's lib/runtime/examples/ hello-world services.
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, ".")
+
+from dynamo_tpu.runtime import DistributedRuntime
+
+
+async def main() -> None:
+    name = sys.argv[1] if len(sys.argv) > 1 else "worker"
+    rt = await DistributedRuntime.detached().start()
+
+    async def handler(payload, ctx):
+        for item in payload.get("items", []):
+            if ctx.is_stopped():
+                return
+            yield {"echo": item, "worker": name}
+            await asyncio.sleep(0.01)
+
+    ep = rt.namespace("ns").component("echo").endpoint("generate")
+    served = await ep.serve_endpoint(handler)
+    print(f"ready instance_id={served.instance_id}", flush=True)
+    try:
+        await rt.root_token.wait_killed()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await rt.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,39 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): everything below the
+hardware layer is testable with no accelerator.  Multi-chip sharding tests run
+against 8 virtual CPU devices; real-TPU paths are exercised by bench.py and
+the driver's dryrun instead.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import asyncio
+import inspect
+
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async-test support (pytest-asyncio is not in the image)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        return True
+    return None

@@ -1,0 +1,70 @@
+"""Tests for the PLH hashing contract (ref test model: lib/tokens tests)."""
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    compute_block_hashes,
+    compute_block_hashes_for_request,
+    local_block_hash,
+)
+from dynamo_tpu.tokens.hashing import prefix_overlap_blocks
+
+
+def test_full_blocks_only():
+    toks = list(range(130))
+    hs = compute_block_hashes(toks, block_size=64)
+    assert len(hs) == 2  # 130 // 64
+
+
+def test_determinism_and_uniqueness():
+    a = compute_block_hashes(list(range(128)), 64)
+    b = compute_block_hashes(list(range(128)), 64)
+    assert a == b
+    c = compute_block_hashes([1] + list(range(1, 128)), 64)
+    assert a[0] != c[0]
+    # lineage: same second-block content, different first block -> different PLH
+    assert a[1] != c[1]
+
+
+def test_lineage_chains():
+    toks = list(range(256))
+    full = compute_block_hashes(toks, 64)
+    head = compute_block_hashes(toks[:128], 64)
+    tail = compute_block_hashes(toks[128:], 64, parent=head[-1])
+    assert full == head + tail
+
+
+def test_positional_dependence():
+    # identical content at different positions hashes differently (PLH)...
+    toks = [7] * 128
+    hs = compute_block_hashes(toks, 64)
+    assert hs[0] != hs[1]
+    # ...but local (content) hash is identical
+    assert local_block_hash(toks[:64]) == local_block_hash(toks[64:])
+
+
+def test_lora_salt_namespaces():
+    toks = list(range(64))
+    a = compute_block_hashes_for_request(toks, 64)
+    b = compute_block_hashes_for_request(toks, 64, lora_name="adapter1")
+    assert a != b
+
+
+def test_incremental_sequence_matches_batch():
+    toks = list(range(300))
+    seq = TokenBlockSequence(block_size=64)
+    completed = seq.extend(toks)
+    assert seq.block_hashes == compute_block_hashes(toks, 64)
+    assert completed == seq.block_hashes
+    assert seq.num_full_blocks == 4
+    assert seq.partial_len() == 300 - 256
+    assert seq.num_blocks == 5
+
+
+def test_prefix_overlap():
+    toks = list(range(256))
+    hs = compute_block_hashes(toks, 64)
+    assert prefix_overlap_blocks(hs, set(hs)) == 4
+    assert prefix_overlap_blocks(hs, set(hs[:2])) == 2
+    # hole in the middle stops the walk
+    assert prefix_overlap_blocks(hs, {hs[0], hs[2], hs[3]}) == 1
+    assert prefix_overlap_blocks(hs, set()) == 0
