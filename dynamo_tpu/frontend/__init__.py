@@ -1,0 +1,24 @@
+from .pipeline import MigrationOperator, ModelPipeline
+from .preprocessor import OpenAIPreprocessor
+from .service import HttpService, ModelManager, ModelWatcher
+from .tokenizer import (
+    HFTokenizer,
+    IncrementalDetokenizer,
+    MockTokenizer,
+    Tokenizer,
+    tokenizer_from_mdc,
+)
+
+__all__ = [
+    "HFTokenizer",
+    "HttpService",
+    "IncrementalDetokenizer",
+    "MigrationOperator",
+    "MockTokenizer",
+    "ModelManager",
+    "ModelPipeline",
+    "ModelWatcher",
+    "OpenAIPreprocessor",
+    "Tokenizer",
+    "tokenizer_from_mdc",
+]

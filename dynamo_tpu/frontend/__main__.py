@@ -1,0 +1,61 @@
+"""`python -m dynamo_tpu.frontend` — OpenAI HTTP server + preprocessor +
+router in one process (ref: components/src/dynamo/frontend/main.py)."""
+
+import argparse
+import asyncio
+import logging
+
+from ..runtime import DistributedRuntime, RouterMode
+from .service import HttpService, ModelManager, ModelWatcher
+
+
+def build_args() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dynamo_tpu.frontend")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    # "kv" joins the choices when the KV router lands (M3)
+    p.add_argument(
+        "--router-mode", default="round_robin",
+        choices=["random", "round_robin", "least_loaded", "p2c"],
+    )
+    p.add_argument("--busy-threshold", type=int, default=None)
+    p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    p.add_argument("--router-temperature", type=float, default=0.0)
+    return p
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    args = build_args().parse_args()
+    rt = await DistributedRuntime.detached().start()
+    manager = ModelManager()
+
+    make_route = None
+    mode = RouterMode(args.router_mode)
+    if mode == RouterMode.KV:
+        from ..router.kv_router import make_kv_route_factory
+
+        make_route = make_kv_route_factory(
+            rt,
+            overlap_score_weight=args.kv_overlap_score_weight,
+            temperature=args.router_temperature,
+        )
+    watcher = await ModelWatcher(
+        rt, manager, router_mode=mode, make_route=make_route
+    ).start()
+    service = await HttpService(
+        rt, manager, host=args.host, port=args.port,
+        busy_threshold=args.busy_threshold,
+    ).start()
+    print(f"ready port={args.port}", flush=True)
+    try:
+        await rt.root_token.wait_killed()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await service.close()
+    await watcher.close()
+    await rt.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,159 @@
+"""Per-model request pipeline: routing + migration + detokenization.
+
+Ref: lib/llm/src/entrypoint/input/common.rs:499-522 — the assembled chain
+SegmentSource → OpenAIPreprocessor → Migration → Backend(detok) → router →
+worker, with backward edges doing incremental detokenization.  Here the chain
+is an async-generator composition per request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, replace
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ..protocols import LLMEngineOutput, ModelDeploymentCard, PreprocessedRequest
+from ..runtime import CancellationToken, Client, EngineError
+from .preprocessor import OpenAIPreprocessor
+
+logger = logging.getLogger(__name__)
+
+MIGRATABLE_MARKERS = ("connection lost", "no handler", "worker draining")
+
+
+def is_migratable(err: EngineError) -> bool:
+    """Worker-death errors are retryable on another instance; user
+    cancellations and model errors are not (ref: migration.rs:60-75)."""
+    msg = str(err).lower()
+    return any(m in msg for m in MIGRATABLE_MARKERS)
+
+
+class MigrationOperator:
+    """Replays accumulated tokens to a new worker on migratable errors.
+
+    Ref: lib/llm/src/migration.rs:70.  The retried request's prompt is the
+    original prompt plus every token already generated, so the new worker
+    continues exactly where the dead one stopped (KV rebuilt via prefill,
+    ideally mostly from prefix cache).
+    """
+
+    def __init__(self, client: Client, migration_limit: int = 0,
+                 route=None):
+        self.client = client
+        self.migration_limit = migration_limit
+        # route(request, token) -> (instance_id | None); KV router plugs in here
+        self.route = route
+
+    async def generate(
+        self, request: PreprocessedRequest, token: Optional[CancellationToken] = None
+    ) -> AsyncIterator[LLMEngineOutput]:
+        attempts = 0
+        emitted: list[int] = []
+        avoid: set[int] = set()
+        while True:
+            req = request
+            if emitted:
+                req = replace(
+                    request,
+                    token_ids=list(request.token_ids) + emitted,
+                    stop=replace(request.stop,
+                                 max_tokens=request.stop.max_tokens - len(emitted)),
+                )
+            instance_id = None
+            if self.route is not None:
+                instance_id = await self.route(req, avoid=avoid)
+            try:
+                async for item in self.client.generate(
+                    req.to_dict(), instance_id=instance_id, token=token
+                ):
+                    out = LLMEngineOutput.from_dict(item)
+                    emitted.extend(out.token_ids)
+                    yield out
+                return
+            except EngineError as e:
+                if (token is not None and token.is_stopped()):
+                    raise
+                if attempts >= self.migration_limit or not is_migratable(e):
+                    raise
+                attempts += 1
+                if instance_id is not None:
+                    avoid.add(instance_id)
+                logger.warning(
+                    "migrating request %s (attempt %d/%d) after: %s",
+                    request.request_id, attempts, self.migration_limit, e,
+                )
+                await asyncio.sleep(0.05)
+
+
+@dataclass
+class ChatDelta:
+    text: str = ""
+    finish_reason: Optional[str] = None
+    token_count: int = 0
+
+
+class ModelPipeline:
+    """Everything the HTTP layer needs to serve one model."""
+
+    def __init__(self, mdc: ModelDeploymentCard, client: Client,
+                 route=None):
+        self.mdc = mdc
+        self.preprocessor = OpenAIPreprocessor(mdc)
+        self.client = client
+        self.migration = MigrationOperator(
+            client, migration_limit=mdc.migration_limit, route=route
+        )
+
+    async def generate_deltas(
+        self, request: PreprocessedRequest,
+        token: Optional[CancellationToken] = None,
+    ) -> AsyncIterator[ChatDelta]:
+        """Engine stream → detokenized text deltas with stop-string handling."""
+        detok = self.preprocessor.tokenizer.make_detokenizer()
+        stops = request.stop.stop or []
+        pending = ""  # holdback buffer for partial stop-string matches
+        async for out in self.migration.generate(request, token=token):
+            delta = detok.push(out.token_ids)
+            finish = out.finish_reason
+            if stops:
+                pending += delta
+                cut = self._find_stop(pending, stops)
+                if cut is not None:
+                    yield ChatDelta(text=pending[:cut], finish_reason="stop",
+                                    token_count=len(out.token_ids))
+                    return
+                if finish is not None:
+                    # stream over: flush the held-back text, it wasn't a stop
+                    emit, pending = pending, ""
+                else:
+                    hold = self._max_partial_suffix(pending, stops)
+                    emit = pending[: len(pending) - hold]
+                    pending = pending[len(pending) - hold:]
+                yield ChatDelta(text=emit, finish_reason=finish,
+                                token_count=len(out.token_ids))
+            else:
+                yield ChatDelta(text=delta, finish_reason=finish,
+                                token_count=len(out.token_ids))
+            if finish is not None:
+                return
+
+    @staticmethod
+    def _find_stop(text: str, stops: list[str]) -> Optional[int]:
+        best = None
+        for s in stops:
+            i = text.find(s)
+            if i >= 0 and (best is None or i < best):
+                best = i
+        return best
+
+    @staticmethod
+    def _max_partial_suffix(text: str, stops: list[str]) -> int:
+        """Longest suffix of text that is a proper prefix of any stop string."""
+        best = 0
+        for s in stops:
+            for k in range(min(len(s) - 1, len(text)), 0, -1):
+                if text.endswith(s[:k]):
+                    best = max(best, k)
+                    break
+        return best

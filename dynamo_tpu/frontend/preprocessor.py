@@ -1,0 +1,91 @@
+"""OpenAI request preprocessing: chat templating + tokenization + params.
+
+Ref: lib/llm/src/preprocessor.rs:286 (OpenAIPreprocessor) — minijinja chat
+templating + HF tokenization producing a PreprocessedRequest.  jinja2 is the
+Python equivalent of minijinja; HF chat templates render unchanged.
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Any, Dict, List, Optional, Tuple
+
+import jinja2
+
+from ..protocols import (
+    ModelDeploymentCard,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from .tokenizer import Tokenizer, tokenizer_from_mdc
+
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for m in messages %}"
+    "<|{{ m['role'] }}|>\n{{ m['content'] }}<|end|>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+DEFAULT_MAX_TOKENS = 512
+
+
+class OpenAIPreprocessor:
+    def __init__(self, mdc: ModelDeploymentCard,
+                 tokenizer: Optional[Tokenizer] = None):
+        self.mdc = mdc
+        self.tokenizer = tokenizer or tokenizer_from_mdc(mdc.tokenizer)
+        env = jinja2.Environment()
+        self.template = env.from_string(mdc.chat_template or DEFAULT_CHAT_TEMPLATE)
+
+    # -- request builders -------------------------------------------------
+    def render_chat(self, messages: List[Dict[str, Any]]) -> str:
+        return self.template.render(
+            messages=messages, add_generation_prompt=True
+        )
+
+    def preprocess_chat(self, body: Dict[str, Any]) -> PreprocessedRequest:
+        prompt = self.render_chat(body.get("messages", []))
+        return self._build(prompt, body)
+
+    def preprocess_completion(self, body: Dict[str, Any]) -> PreprocessedRequest:
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = "".join(prompt)
+        return self._build(prompt, body)
+
+    def _build(self, prompt: str, body: Dict[str, Any]) -> PreprocessedRequest:
+        token_ids = self.tokenizer.encode(prompt)
+        max_ctx = self.mdc.context_length
+        if len(token_ids) >= max_ctx:
+            raise ValueError(
+                f"prompt is {len(token_ids)} tokens, exceeding the model's "
+                f"context length of {max_ctx}"
+            )
+        max_tokens = body.get("max_tokens") or body.get(
+            "max_completion_tokens"
+        ) or DEFAULT_MAX_TOKENS
+        max_tokens = max(1, min(int(max_tokens), max_ctx - len(token_ids)))
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        return PreprocessedRequest(
+            token_ids=token_ids,
+            model=body.get("model", self.mdc.name),
+            request_id=body.get("request_id") or f"req-{secrets.token_hex(8)}",
+            sampling=SamplingOptions(
+                temperature=float(body.get("temperature", 1.0)),
+                top_p=float(body.get("top_p", 1.0)),
+                top_k=int(body.get("top_k", 0)),
+                seed=body.get("seed"),
+                frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+                presence_penalty=float(body.get("presence_penalty", 0.0)),
+            ),
+            stop=StopConditions(
+                max_tokens=max_tokens,
+                stop=stop,
+                ignore_eos=bool(body.get("ignore_eos", False)),
+            ),
+            lora_name=body.get("lora_name"),
+            annotations=body.get("nvext", {}).get("annotations", []),
+        )

@@ -1,0 +1,325 @@
+"""OpenAI-compatible HTTP service + model discovery.
+
+Ref: lib/llm/src/http/service/service_v2.rs:494 (HttpService) for the routes,
+lib/llm/src/discovery/watcher.rs:217 (ModelWatcher) and
+model_manager.rs:134 (ModelManager) for dynamic model discovery, and
+busy_threshold.rs for load shedding.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from ..protocols import ModelDeploymentCard
+from ..runtime import CancellationToken, DistributedRuntime, RouterMode
+from ..runtime.discovery import MDC_PREFIX
+from .pipeline import ModelPipeline
+
+logger = logging.getLogger(__name__)
+
+
+class ModelManager:
+    """model name → pipeline; populated by the watcher."""
+
+    def __init__(self) -> None:
+        self.models: Dict[str, ModelPipeline] = {}
+
+    def get(self, name: str) -> Optional[ModelPipeline]:
+        return self.models.get(name)
+
+    def list_models(self) -> list[Dict[str, Any]]:
+        return [
+            {"id": name, "object": "model", "owned_by": "dynamo_tpu",
+             "created": 0}
+            for name in sorted(self.models)
+        ]
+
+
+class ModelWatcher:
+    """Subscribes to `v1/mdc/**`; builds/tears down per-model pipelines."""
+
+    def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
+                 router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+                 make_route=None):
+        self.runtime = runtime
+        self.manager = manager
+        self.router_mode = router_mode
+        # make_route(mdc) -> optional coroutine route(req, avoid) -> instance_id
+        self.make_route = make_route
+        self._cancel = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._clients: Dict[str, Any] = {}
+
+    async def start(self) -> "ModelWatcher":
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def _loop(self) -> None:
+        try:
+            async for ev in self.runtime.discovery.watch(
+                MDC_PREFIX + "/", cancel=self._cancel
+            ):
+                try:
+                    if ev.type == "put" and ev.value:
+                        await self._add(ModelDeploymentCard.from_dict(ev.value))
+                    elif ev.type == "delete":
+                        self._remove_by_key(ev.key)
+                except Exception:
+                    logger.exception("model watcher failed applying %s", ev)
+        except asyncio.CancelledError:
+            pass
+
+    async def _add(self, mdc: ModelDeploymentCard) -> None:
+        existing = self.manager.models.get(mdc.name)
+        if existing is not None:
+            if existing.mdc.to_dict() == mdc.to_dict():
+                return
+            # MDC update (new template/tokenizer/limits): rebuild the
+            # pipeline but keep the existing endpoint client
+            self.manager.models[mdc.name] = ModelPipeline(
+                mdc, existing.client, route=existing.migration.route
+            )
+            logger.info("model %s updated", mdc.name)
+            return
+        ep = (
+            self.runtime.namespace(mdc.namespace)
+            .component(mdc.component)
+            .endpoint(mdc.endpoint)
+        )
+        client = await ep.client(self.router_mode).start()
+        route = None
+        if self.make_route is not None:
+            route = await self.make_route(mdc, client)
+        self.manager.models[mdc.name] = ModelPipeline(mdc, client, route=route)
+        self._clients[mdc.key()] = (client, mdc.name)
+        logger.info("model %s registered (endpoint %s/%s/%s)",
+                    mdc.name, mdc.namespace, mdc.component, mdc.endpoint)
+
+    def _remove_by_key(self, key: str) -> None:
+        ent = self._clients.pop(key, None)
+        if ent is None:
+            return
+        client, name = ent
+        self.manager.models.pop(name, None)
+        asyncio.ensure_future(client.close())
+        logger.info("model %s deregistered", name)
+
+    async def close(self) -> None:
+        self._cancel.set()
+        if self._task is not None:
+            self._task.cancel()
+        for client, _name in self._clients.values():
+            await client.close()
+
+
+class HttpService:
+    def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
+                 host: str = "0.0.0.0", port: int = 8000,
+                 busy_threshold: Optional[int] = None):
+        self.runtime = runtime
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.busy_threshold = busy_threshold
+        self.inflight = 0
+        self._runner: Optional[web.AppRunner] = None
+        m = runtime.metrics.scoped(component="frontend")
+        self._m_requests = m
+        self.app = web.Application()
+        self.app.router.add_get("/v1/models", self.h_models)
+        self.app.router.add_post("/v1/chat/completions", self.h_chat)
+        self.app.router.add_post("/v1/completions", self.h_completions)
+        self.app.router.add_get("/health", self.h_health)
+        self.app.router.add_get("/metrics", self.h_metrics)
+
+    # -- helpers ----------------------------------------------------------
+    def _busy(self) -> bool:
+        return (
+            self.busy_threshold is not None
+            and self.inflight >= self.busy_threshold
+        )
+
+    @staticmethod
+    def _error(status: int, msg: str, etype: str = "invalid_request_error"):
+        return web.json_response(
+            {"error": {"message": msg, "type": etype}}, status=status
+        )
+
+    # -- routes -----------------------------------------------------------
+    async def h_health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "healthy", "models": sorted(self.manager.models)}
+        )
+
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.runtime.metrics.render(),
+                            content_type="text/plain")
+
+    async def h_models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"object": "list", "data": self.manager.list_models()}
+        )
+
+    async def h_chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_inference(request, chat=True)
+
+    async def h_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_inference(request, chat=False)
+
+    async def _handle_inference(self, request: web.Request,
+                                chat: bool) -> web.StreamResponse:
+        if self._busy():
+            return self._error(503, "service busy", "overloaded_error")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return self._error(400, "invalid JSON body")
+        model = body.get("model", "")
+        pipeline = self.manager.get(model)
+        if pipeline is None:
+            return self._error(
+                404, f"model {model!r} not found; available: "
+                     f"{sorted(self.manager.models)}", "not_found_error")
+        if chat and not isinstance(body.get("messages"), list):
+            return self._error(400, "'messages' must be a list")
+        try:
+            req = (pipeline.preprocessor.preprocess_chat(body) if chat
+                   else pipeline.preprocessor.preprocess_completion(body))
+        except Exception as e:
+            return self._error(400, f"preprocessing failed: {e}")
+
+        token = self.runtime.root_token.child()
+        self.inflight += 1
+        self._m_requests.inc("dynamo_frontend_requests_total", model=model)
+        t0 = time.monotonic()
+        try:
+            if body.get("stream"):
+                return await self._stream_response(
+                    request, pipeline, req, token, chat, model)
+            return await self._unary_response(pipeline, req, token, chat, model)
+        finally:
+            self.inflight -= 1
+            self._m_requests.observe(
+                "dynamo_frontend_request_duration_seconds",
+                time.monotonic() - t0, model=model)
+            token.detach()
+
+    async def _unary_response(self, pipeline: ModelPipeline, req, token,
+                              chat: bool, model: str) -> web.Response:
+        text_parts: list[str] = []
+        finish = None
+        ntok = 0
+        try:
+            async for d in pipeline.generate_deltas(req, token=token):
+                text_parts.append(d.text)
+                ntok += d.token_count
+                if d.finish_reason:
+                    finish = d.finish_reason
+        except Exception as e:
+            logger.exception("generation failed")
+            return self._error(500, f"generation failed: {e}", "server_error")
+        text = "".join(text_parts)
+        usage = {
+            "prompt_tokens": len(req.token_ids),
+            "completion_tokens": ntok,
+            "total_tokens": len(req.token_ids) + ntok,
+        }
+        rid = req.request_id
+        created = int(time.time())
+        if chat:
+            payload = {
+                "id": rid, "object": "chat.completion", "created": created,
+                "model": model,
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish or "stop",
+                }],
+                "usage": usage,
+            }
+        else:
+            payload = {
+                "id": rid, "object": "text_completion", "created": created,
+                "model": model,
+                "choices": [{"index": 0, "text": text,
+                             "finish_reason": finish or "stop"}],
+                "usage": usage,
+            }
+        return web.json_response(payload)
+
+    async def _stream_response(self, request: web.Request,
+                               pipeline: ModelPipeline, req, token,
+                               chat: bool, model: str) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        rid = req.request_id
+        created = int(time.time())
+
+        def chunk(delta_text: Optional[str], finish: Optional[str],
+                  first: bool = False) -> bytes:
+            if chat:
+                delta: Dict[str, Any] = {}
+                if first:
+                    delta["role"] = "assistant"
+                if delta_text:
+                    delta["content"] = delta_text
+                choice = {"index": 0, "delta": delta, "finish_reason": finish}
+                obj = {"id": rid, "object": "chat.completion.chunk",
+                       "created": created, "model": model, "choices": [choice]}
+            else:
+                obj = {"id": rid, "object": "text_completion",
+                       "created": created, "model": model,
+                       "choices": [{"index": 0, "text": delta_text or "",
+                                    "finish_reason": finish}]}
+            return f"data: {json.dumps(obj)}\n\n".encode()
+
+        first = True
+        disconnected = False
+        try:
+            async for d in pipeline.generate_deltas(req, token=token):
+                if d.text or d.finish_reason or first:
+                    await resp.write(chunk(d.text, d.finish_reason, first))
+                    first = False
+                if d.finish_reason:
+                    break
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            token.kill()  # client went away; stop the engine
+            disconnected = True
+        except Exception as e:
+            logger.exception("stream failed")
+            err = {"error": {"message": str(e), "type": "server_error"}}
+            try:
+                await resp.write(f"data: {json.dumps(err)}\n\n".encode())
+            except ConnectionResetError:
+                disconnected = True
+        if not disconnected:
+            try:
+                await resp.write_eof()
+            except ConnectionResetError:
+                pass
+        return resp
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> "HttpService":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        logger.info("HTTP service on %s:%d", self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,4 @@
+from .engine import MockEngine, MockEngineArgs
+from .worker import MockerWorker
+
+__all__ = ["MockEngine", "MockEngineArgs", "MockerWorker"]

@@ -1,0 +1,64 @@
+"""`python -m dynamo_tpu.mocker` — run one or more mocker workers.
+
+Ref: components/src/dynamo/mocker/main.py.  Canonical GPU/TPU-free backend
+for frontend/router/planner testing.
+"""
+
+import argparse
+import asyncio
+import logging
+
+from ..runtime import DistributedRuntime
+from .engine import MockEngineArgs
+from .worker import MockerWorker
+
+
+def build_args() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dynamo_tpu.mocker")
+    p.add_argument("--model-name", default="mock-model")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="mocker")
+    p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--num-blocks", type=int, default=4096)
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--max-batch-tokens", type=int, default=8192)
+    p.add_argument("--speedup-ratio", type=float, default=1.0)
+    p.add_argument("--no-prefix-caching", action="store_true")
+    p.add_argument("--num-workers", type=int, default=1)
+    p.add_argument("--migration-limit", type=int, default=0)
+    p.add_argument("--role", default="both", choices=["both", "prefill", "decode"])
+    return p
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    args = build_args().parse_args()
+    engine_args = MockEngineArgs(
+        model_name=args.model_name,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        max_num_seqs=args.max_num_seqs,
+        max_batch_tokens=args.max_batch_tokens,
+        speedup_ratio=args.speedup_ratio,
+        enable_prefix_caching=not args.no_prefix_caching,
+        role=args.role,
+    )
+    rt = await DistributedRuntime.detached().start()
+    workers = []
+    for _ in range(args.num_workers):
+        w = MockerWorker(rt, engine_args, namespace=args.namespace,
+                         component=args.component,
+                         migration_limit=args.migration_limit)
+        workers.append(await w.start())
+    print(f"ready workers={[w.served.instance_id for w in workers]}", flush=True)
+    try:
+        await rt.root_token.wait_killed()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    for w in workers:
+        await w.close()
+    await rt.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

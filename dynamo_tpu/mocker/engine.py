@@ -1,0 +1,291 @@
+"""The mock engine: a vLLM-style continuous-batching scheduler, simulated.
+
+Ref: lib/mocker (create_engine src/engine.rs:18, MockEngineArgs README:20-40,
+scheduler src/scheduler/vllm/).  No accelerator: token generation is
+deterministic pseudo-random, step latency comes from a polynomial timing
+model, but the *scheduling behavior* is faithful — paged KV cache with prefix
+reuse, chunked prefill, decode batching, capacity-based admission, preemption
+on OOM, KV stored/removed events.  This is the keystone test fixture
+(SURVEY.md §4): router/frontend/planner are fully testable against it on CPU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Dict, List, Optional
+
+from ..protocols import LLMEngineOutput, PreprocessedRequest
+from ..tokens import TokenBlockSequence
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class MockEngineArgs:
+    model_name: str = "mock-model"
+    block_size: int = 64
+    num_blocks: int = 4096
+    max_num_seqs: int = 64
+    max_batch_tokens: int = 8192  # chunked-prefill budget per step
+    enable_prefix_caching: bool = True
+    enable_chunked_prefill: bool = True
+    vocab_size: int = 32000
+    eos_token_id: int = 2
+    # timing model (seconds): step = base + per_prefill_tok*p + per_decode_seq*d
+    base_step_s: float = 0.002
+    prefill_s_per_token: float = 0.00002
+    decode_s_per_seq: float = 0.0002
+    speedup_ratio: float = 1.0  # >1 runs faster than "real time"
+    # disagg role: "both" | "prefill" | "decode"
+    role: str = "both"
+
+
+@dataclass
+class _Seq:
+    request_id: str
+    request: PreprocessedRequest
+    blocks: TokenBlockSequence
+    out_queue: asyncio.Queue
+    num_prompt_tokens: int
+    prefill_pos: int = 0  # tokens prefetched so far (chunked prefill)
+    generated: int = 0
+    cached_blocks: int = 0
+    finished: bool = False
+    rng: random.Random = field(default_factory=random.Random)
+
+
+class MockEngine:
+    """Continuous-batching scheduler over the simulated KV cache."""
+
+    def __init__(self, args: MockEngineArgs,
+                 kv_event_publisher=None):
+        from .kv_cache_sim import KvCacheSim
+
+        self.args = args
+        self.cache = KvCacheSim(args.num_blocks, args.enable_prefix_caching)
+        self.publisher = kv_event_publisher
+        self.waiting: List[_Seq] = []
+        self.running: List[_Seq] = []
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+        # FPM-style counters
+        self.metrics = {
+            "steps": 0, "prefill_tokens": 0, "decode_tokens": 0,
+            "preemptions": 0, "cache_hit_blocks": 0, "cache_lookup_blocks": 0,
+        }
+
+    # -- public API -------------------------------------------------------
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    @property
+    def num_active_seqs(self) -> int:
+        return len(self.running) + len(self.waiting)
+
+    def kv_usage(self) -> float:
+        return self.cache.used_blocks / max(1, self.cache.num_blocks)
+
+    async def generate(
+        self, request: PreprocessedRequest, token=None
+    ) -> AsyncIterator[LLMEngineOutput]:
+        """Enqueue a request and stream engine outputs (one token per item)."""
+        self.start()
+        seq = _Seq(
+            request_id=request.request_id,
+            request=request,
+            blocks=TokenBlockSequence(
+                request.token_ids, self.args.block_size,
+                salt=(request.lora_name or "").encode(),
+            ),
+            out_queue=asyncio.Queue(),
+            num_prompt_tokens=len(request.token_ids),
+            rng=random.Random(
+                request.sampling.seed
+                if request.sampling.seed is not None
+                else hash(request.request_id) & 0x7FFFFFFF
+            ),
+        )
+        self.waiting.append(seq)
+        self._wake.set()
+        try:
+            while True:
+                if token is not None:
+                    get = asyncio.ensure_future(seq.out_queue.get())
+                    stop = asyncio.ensure_future(token.wait_stopped())
+                    done, pending = await asyncio.wait(
+                        {get, stop}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    for p in pending:
+                        p.cancel()
+                    if get not in done:
+                        self._cancel_seq(seq)
+                        yield LLMEngineOutput(finish_reason="cancelled")
+                        return
+                    item = get.result()
+                else:
+                    item = await seq.out_queue.get()
+                yield item
+                if item.finish_reason is not None:
+                    return
+        finally:
+            if not seq.finished:
+                self._cancel_seq(seq)
+
+    async def clear_kv_blocks(self) -> int:
+        removed = self.cache.clear()
+        if self.publisher is not None:
+            await self.publisher.cleared()
+        return len(removed)
+
+    # -- internals --------------------------------------------------------
+    def _cancel_seq(self, seq: _Seq) -> None:
+        seq.finished = True
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+        if seq in self.running:
+            self.running.remove(seq)
+            res = self.cache.free(seq.request_id)
+            self._publish(res)
+
+    def _publish(self, res) -> None:
+        if self.publisher is None or res is None:
+            return
+        if res.stored:
+            asyncio.ensure_future(self.publisher.stored(res.stored))
+        if res.removed:
+            asyncio.ensure_future(self.publisher.removed(res.removed))
+
+    async def _loop(self) -> None:
+        try:
+            while not self._closed:
+                if not self.running and not self.waiting:
+                    self._wake.clear()
+                    await self._wake.wait()
+                    continue
+                await self._step()
+        except asyncio.CancelledError:
+            pass
+
+    def _try_admit(self) -> None:
+        while self.waiting and len(self.running) < self.args.max_num_seqs:
+            seq = self.waiting[0]
+            hashes = seq.blocks.block_hashes
+            total = seq.blocks.num_blocks or 1
+            self.metrics["cache_lookup_blocks"] += len(hashes)
+            res = self.cache.allocate(seq.request_id, hashes, total)
+            if res is None:
+                break  # capacity; keep FIFO order
+            self.metrics["cache_hit_blocks"] += res.cached_blocks
+            seq.cached_blocks = res.cached_blocks
+            # prefix-cached tokens skip prefill compute
+            seq.prefill_pos = min(
+                res.cached_blocks * self.args.block_size, seq.num_prompt_tokens
+            )
+            self._publish(res)
+            self.waiting.pop(0)
+            self.running.append(seq)
+
+    async def _step(self) -> None:
+        self._try_admit()
+        if not self.running:
+            await asyncio.sleep(0)  # let admissions catch up
+            return
+
+        budget = self.args.max_batch_tokens
+        prefill_tokens = 0
+        decode_seqs: List[_Seq] = []
+
+        for seq in list(self.running):
+            remaining_prefill = seq.num_prompt_tokens - seq.prefill_pos
+            if remaining_prefill > 0:
+                chunk = (
+                    min(remaining_prefill, budget)
+                    if self.args.enable_chunked_prefill
+                    else remaining_prefill
+                )
+                if chunk <= 0:
+                    continue
+                seq.prefill_pos += chunk
+                prefill_tokens += chunk
+                budget -= chunk
+            else:
+                decode_seqs.append(seq)
+
+        # simulated step latency
+        step_s = (
+            self.args.base_step_s
+            + prefill_tokens * self.args.prefill_s_per_token
+            + len(decode_seqs) * self.args.decode_s_per_seq
+        ) / max(self.args.speedup_ratio, 1e-6)
+        await asyncio.sleep(step_s)
+
+        self.metrics["steps"] += 1
+        self.metrics["prefill_tokens"] += prefill_tokens
+
+        for seq in decode_seqs:
+            tok = self._next_token(seq)
+            completed = seq.blocks.append(tok)
+            partial = seq.blocks.partial_len()
+            res = self.cache.grow(
+                seq.request_id, completed, need_new_block=(partial == 1)
+            )
+            if res is None:
+                # OOM: preempt back to waiting, replay prefill later
+                self.metrics["preemptions"] += 1
+                self.running.remove(seq)
+                free_res = self.cache.free(seq.request_id)
+                self._publish(free_res)
+                seq.prefill_pos = 0
+                self.waiting.insert(0, seq)
+                continue
+            self._publish(res)
+            seq.generated += 1
+            self.metrics["decode_tokens"] += 1
+
+            finish = self._finish_reason(seq, tok)
+            out = LLMEngineOutput(
+                token_ids=[tok],
+                finish_reason=finish,
+                metrics={
+                    "kv_usage": self.kv_usage(),
+                    "active_seqs": len(self.running),
+                } if finish else None,
+            )
+            seq.out_queue.put_nowait(out)
+            if finish is not None:
+                seq.finished = True
+                self.running.remove(seq)
+                res = self.cache.free(seq.request_id)
+                self._publish(res)
+
+    def _next_token(self, seq: _Seq) -> int:
+        # deterministic pseudo-random stream; occasionally the EOS token
+        r = seq.rng
+        if not seq.request.stop.ignore_eos and r.random() < 0.005:
+            return self.args.eos_token_id
+        return r.randrange(3, self.args.vocab_size)
+
+    def _finish_reason(self, seq: _Seq, tok: int) -> Optional[str]:
+        st = seq.request.stop
+        if not st.ignore_eos and tok == self.args.eos_token_id:
+            return "stop"
+        if tok in (st.stop_token_ids or []):
+            return "stop"
+        if seq.generated >= st.max_tokens:
+            return "length"
+        total = seq.num_prompt_tokens + seq.generated
+        # context window guard
+        return None if total < 10**9 else "length"

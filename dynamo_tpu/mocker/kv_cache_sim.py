@@ -1,0 +1,174 @@
+"""Simulated paged KV cache with prefix caching and LRU eviction.
+
+Ref: lib/mocker/src/kv_manager/ and src/cache/ — block-granular cache keyed
+by PositionalLineageHash: an admitted sequence reuses cached full blocks
+(prefix cache hit), allocates fresh blocks for the rest, and on free its
+blocks stay cached (refcount 0, LRU-evictable) until capacity pressure evicts
+them.  Every store/evict is reported so the worker can publish KV events.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass
+class CacheStepResult:
+    stored: List[int] = field(default_factory=list)  # newly stored full-block PLHs
+    removed: List[int] = field(default_factory=list)  # evicted PLHs
+    cached_blocks: int = 0  # prefix-cache hits for this allocation
+
+
+class KvCacheSim:
+    def __init__(self, num_blocks: int, enable_prefix_caching: bool = True):
+        self.num_blocks = num_blocks
+        self.enable_prefix_caching = enable_prefix_caching
+        self.free_blocks = num_blocks
+        # hash -> refcount of cached full blocks
+        self._ref: Dict[int, int] = {}
+        # refcount==0 cached blocks in LRU order (evictable)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # per-sequence holdings
+        self._seq_full: Dict[str, List[int]] = {}
+        self._seq_partial: Dict[str, int] = {}  # count of unhashed blocks held
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - self.free_blocks
+
+    @property
+    def evictable_blocks(self) -> int:
+        return len(self._lru)
+
+    def can_allocate(self, n_new: int) -> bool:
+        return n_new <= self.free_blocks + self.evictable_blocks
+
+    def _evict(self, n: int, out: CacheStepResult) -> bool:
+        while n > 0:
+            if not self._lru:
+                return False
+            h, _ = self._lru.popitem(last=False)
+            del self._ref[h]
+            self.free_blocks += 1
+            out.removed.append(h)
+            n -= 1
+        return True
+
+    # -- sequence lifecycle ----------------------------------------------
+    def lookup(self, block_hashes: Sequence[int]) -> int:
+        """Number of leading blocks already cached (prefix match)."""
+        if not self.enable_prefix_caching:
+            return 0
+        n = 0
+        for h in block_hashes:
+            if h in self._ref:
+                n += 1
+            else:
+                break
+        return n
+
+    def allocate(
+        self,
+        seq_id: str,
+        block_hashes: Sequence[int],
+        total_blocks: int,
+    ) -> Optional[CacheStepResult]:
+        """Admit a sequence: reuse cached prefix blocks, allocate the rest.
+
+        ``block_hashes`` are the PLHs of the prompt's full blocks;
+        ``total_blocks`` includes the trailing partial block.  Returns None if
+        capacity (after eviction) is insufficient.
+        """
+        out = CacheStepResult()
+        hit = self.lookup(block_hashes)
+        n_new = total_blocks - hit
+        if n_new > self.free_blocks + self.evictable_blocks:
+            return None
+        if n_new > self.free_blocks:
+            if not self._evict(n_new - self.free_blocks, out):
+                return None
+
+        # pin the cache hits
+        for h in block_hashes[:hit]:
+            self._pin(h)
+        # allocate + store the remaining full blocks; an eviction hole can
+        # leave later blocks still cached — pin those instead of re-storing
+        for h in block_hashes[hit:]:
+            if h in self._ref:
+                self._pin(h)
+                continue
+            self.free_blocks -= 1
+            self._ref[h] = 1
+            out.stored.append(h)
+        # partial blocks are held but unhashed
+        n_partial = total_blocks - len(block_hashes)
+        self.free_blocks -= n_partial
+
+        self._seq_full[seq_id] = list(block_hashes)
+        self._seq_partial[seq_id] = n_partial
+        out.cached_blocks = hit
+        return out
+
+    def _pin(self, h: int) -> None:
+        rc = self._ref.get(h, 0)
+        if rc == 0:
+            self._lru.pop(h, None)
+        self._ref[h] = rc + 1
+
+    def grow(self, seq_id: str, completed_hash: Optional[int],
+             need_new_block: bool) -> Optional[CacheStepResult]:
+        """Decode-step growth: optionally a partial block became full
+        (``completed_hash``), optionally a new partial block is needed."""
+        out = CacheStepResult()
+        if completed_hash is not None:
+            # the partial block the seq held gains its identity; the physical
+            # slot it occupies is unchanged
+            self._seq_partial[seq_id] -= 1
+            self._seq_full[seq_id].append(completed_hash)
+            if completed_hash in self._ref:
+                # identical block already cached (e.g. same seed replay):
+                # pin it so eviction can't take it out from under us; the
+                # seq's partial slot is returned
+                self._pin(completed_hash)
+                self.free_blocks += 1
+            else:
+                self._ref[completed_hash] = 1
+                out.stored.append(completed_hash)
+        if need_new_block:
+            if self.free_blocks < 1 and not self._evict(1, out):
+                return None
+            self.free_blocks -= 1
+            self._seq_partial[seq_id] += 1
+        return out
+
+    def free(self, seq_id: str) -> CacheStepResult:
+        """Release a sequence. Full blocks stay cached (LRU); partials drop."""
+        out = CacheStepResult()
+        for h in self._seq_full.pop(seq_id, []):
+            rc = self._ref.get(h, 1) - 1
+            if rc <= 0:
+                if self.enable_prefix_caching:
+                    self._ref[h] = 0
+                    self._lru[h] = None
+                    self._lru.move_to_end(h)
+                else:
+                    del self._ref[h]
+                    self.free_blocks += 1
+                    out.removed.append(h)
+            else:
+                self._ref[h] = rc
+        self.free_blocks += self._seq_partial.pop(seq_id, 0)
+        return out
+
+    def clear(self) -> List[int]:
+        """Drop everything (ref: clear_kv_blocks endpoint)."""
+        removed = list(self._ref.keys())
+        self._ref.clear()
+        self._lru.clear()
+        self._seq_full.clear()
+        self._seq_partial.clear()
+        self.free_blocks = self.num_blocks
+        return removed

@@ -1,0 +1,17 @@
+from .llm import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from .model_card import ModelDeploymentCard
+
+__all__ = [
+    "FinishReason",
+    "LLMEngineOutput",
+    "ModelDeploymentCard",
+    "PreprocessedRequest",
+    "SamplingOptions",
+    "StopConditions",
+]

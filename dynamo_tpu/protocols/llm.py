@@ -1,0 +1,150 @@
+"""Engine-facing request/response protocol.
+
+Ref: lib/llm/src/protocols/ — `PreprocessedRequest` is what the frontend's
+preprocessor emits and every engine backend (mocker, JAX) consumes;
+`LLMEngineOutput` is the per-step stream item flowing back.  These cross the
+request plane as msgpack dicts, so each type round-trips via to_dict/from_dict
+with only wire-safe values (ints ≤ 64 bit, strings, lists).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+FinishReason = str  # "stop" | "length" | "eos" | "cancelled" | "error"
+
+
+@dataclass
+class SamplingOptions:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    seed: Optional[int] = None
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "temperature": self.temperature,
+            "top_p": self.top_p,
+            "top_k": self.top_k,
+            "seed": self.seed,
+            "frequency_penalty": self.frequency_penalty,
+            "presence_penalty": self.presence_penalty,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "SamplingOptions":
+        return SamplingOptions(
+            temperature=d.get("temperature", 1.0),
+            top_p=d.get("top_p", 1.0),
+            top_k=d.get("top_k", 0),
+            seed=d.get("seed"),
+            frequency_penalty=d.get("frequency_penalty", 0.0),
+            presence_penalty=d.get("presence_penalty", 0.0),
+        )
+
+
+@dataclass
+class StopConditions:
+    max_tokens: int = 16
+    stop: List[str] = field(default_factory=list)
+    stop_token_ids: List[int] = field(default_factory=list)
+    ignore_eos: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "max_tokens": self.max_tokens,
+            "stop": self.stop,
+            "stop_token_ids": self.stop_token_ids,
+            "ignore_eos": self.ignore_eos,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "StopConditions":
+        return StopConditions(
+            max_tokens=d.get("max_tokens", 16),
+            stop=d.get("stop", []),
+            stop_token_ids=d.get("stop_token_ids", []),
+            ignore_eos=d.get("ignore_eos", False),
+        )
+
+
+@dataclass
+class PreprocessedRequest:
+    """Tokenized request, ready for an engine (ref: protocols PreprocessedRequest)."""
+
+    token_ids: List[int]
+    model: str = ""
+    request_id: str = ""
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stop: StopConditions = field(default_factory=StopConditions)
+    lora_name: Optional[str] = None
+    # disaggregation: set by the prefill worker, consumed by decode
+    disaggregated_params: Optional[Dict[str, Any]] = None
+    # annotations requested by the client (e.g. request tracing)
+    annotations: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "token_ids": list(self.token_ids),
+            "model": self.model,
+            "request_id": self.request_id,
+            "sampling": self.sampling.to_dict(),
+            "stop": self.stop.to_dict(),
+            "lora_name": self.lora_name,
+            "disaggregated_params": self.disaggregated_params,
+            "annotations": self.annotations,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PreprocessedRequest":
+        return PreprocessedRequest(
+            token_ids=list(d.get("token_ids", [])),
+            model=d.get("model", ""),
+            request_id=d.get("request_id", ""),
+            sampling=SamplingOptions.from_dict(d.get("sampling", {})),
+            stop=StopConditions.from_dict(d.get("stop", {})),
+            lora_name=d.get("lora_name"),
+            disaggregated_params=d.get("disaggregated_params"),
+            annotations=d.get("annotations", []),
+        )
+
+
+@dataclass
+class LLMEngineOutput:
+    """One stream item from an engine: a batch of new tokens (usually 1).
+
+    Ref: protocols LLMEngineOutput / BackendOutput.  `kv_transfer_params`
+    carries disagg metadata on the prefill response's final item.
+    """
+
+    token_ids: List[int] = field(default_factory=list)
+    finish_reason: Optional[FinishReason] = None
+    cum_log_prob: Optional[float] = None
+    kv_transfer_params: Optional[Dict[str, Any]] = None
+    # engine-side observability (FPM): step latency, queue depth, etc.
+    metrics: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"token_ids": list(self.token_ids)}
+        if self.finish_reason is not None:
+            d["finish_reason"] = self.finish_reason
+        if self.cum_log_prob is not None:
+            d["cum_log_prob"] = self.cum_log_prob
+        if self.kv_transfer_params is not None:
+            d["kv_transfer_params"] = self.kv_transfer_params
+        if self.metrics is not None:
+            d["metrics"] = self.metrics
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "LLMEngineOutput":
+        return LLMEngineOutput(
+            token_ids=list(d.get("token_ids", [])),
+            finish_reason=d.get("finish_reason"),
+            cum_log_prob=d.get("cum_log_prob"),
+            kv_transfer_params=d.get("kv_transfer_params"),
+            metrics=d.get("metrics"),
+        )

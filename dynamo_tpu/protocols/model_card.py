@@ -1,0 +1,77 @@
+"""ModelDeploymentCard: everything a frontend needs to serve a model.
+
+Ref: lib/llm/src/model_card.rs:821 — published by workers under
+`v1/mdc/{namespace}/{model_slug}` (ref :110) and consumed by the frontend's
+ModelWatcher.  Carries tokenizer identity, chat template, KV block size,
+context length, and runtime config (capacity hints for routing/planning).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..runtime.discovery import MDC_PREFIX
+
+
+def model_slug(name: str) -> str:
+    return name.replace("/", "--")
+
+
+@dataclass
+class ModelDeploymentCard:
+    name: str
+    namespace: str = "dynamo"
+    component: str = "backend"
+    endpoint: str = "generate"
+    model_type: str = "chat"  # chat | completions | embedding | encoder
+    # tokenizer: {"type": "byte"} or {"type": "hf", "path"/"json": ...}
+    tokenizer: Dict[str, Any] = field(default_factory=lambda: {"type": "byte"})
+    chat_template: Optional[str] = None
+    context_length: int = 8192
+    kv_cache_block_size: int = 64
+    migration_limit: int = 0
+    runtime_config: Dict[str, Any] = field(default_factory=dict)
+
+    def key(self) -> str:
+        return f"{MDC_PREFIX}/{self.namespace}/{model_slug(self.name)}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "namespace": self.namespace,
+            "component": self.component,
+            "endpoint": self.endpoint,
+            "model_type": self.model_type,
+            "tokenizer": self.tokenizer,
+            "chat_template": self.chat_template,
+            "context_length": self.context_length,
+            "kv_cache_block_size": self.kv_cache_block_size,
+            "migration_limit": self.migration_limit,
+            "runtime_config": self.runtime_config,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ModelDeploymentCard":
+        return ModelDeploymentCard(
+            name=d["name"],
+            namespace=d.get("namespace", "dynamo"),
+            component=d.get("component", "backend"),
+            endpoint=d.get("endpoint", "generate"),
+            model_type=d.get("model_type", "chat"),
+            tokenizer=d.get("tokenizer", {"type": "byte"}),
+            chat_template=d.get("chat_template"),
+            context_length=d.get("context_length", 8192),
+            kv_cache_block_size=d.get("kv_cache_block_size", 64),
+            migration_limit=d.get("migration_limit", 0),
+            runtime_config=d.get("runtime_config", {}),
+        )
+
+
+async def register_model(runtime, card: ModelDeploymentCard) -> None:
+    """Publish the MDC (ref: lib/bindings/python/rust/lib.rs:368 register_model)."""
+    await runtime.discovery.put(card.key(), card.to_dict())
+
+
+async def deregister_model(runtime, card: ModelDeploymentCard) -> None:
+    await runtime.discovery.delete(card.key())

@@ -1,0 +1,3 @@
+from .events import KvCacheEvent, KvEventPublisher, kv_event_subject
+
+__all__ = ["KvCacheEvent", "KvEventPublisher", "kv_event_subject"]

@@ -1,0 +1,132 @@
+"""KV event protocol + worker-side publisher.
+
+Ref: lib/llm/src/kv_router/publisher/mod.rs:121 (KvEventPublisher) and
+lib/kv-router/src/indexer/local.rs:205 (LocalKvIndexer ring buffer).
+
+Workers publish `stored` / `removed` block events on the event plane under
+`kv_events.{namespace}.{component}`.  Events carry monotonically increasing
+per-worker ids so routers can detect gaps; the publisher mirrors recent events
+into a local ring buffer and serves a `kv_events_replay` endpoint so a router
+that missed events (or just started) can recover without a full engine dump.
+
+PLHs are 128-bit, which exceeds msgpack's integer range — on the wire they are
+16-byte big-endian `bytes`; in memory they are ints.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+KV_EVENT_SUBJECT_PREFIX = "kv_events"
+
+
+def hash_to_wire(h: int) -> bytes:
+    return int(h).to_bytes(16, "big")
+
+
+def wire_to_hash(b) -> int:
+    if isinstance(b, int):
+        return b
+    return int.from_bytes(b, "big")
+
+
+@dataclass
+class KvCacheEvent:
+    """One batch of block stores or removals on one worker."""
+
+    worker_id: int
+    event_id: int
+    op: str  # "stored" | "removed" | "cleared"
+    block_hashes: List[int] = field(default_factory=list)
+    # for "stored": parent hash of the first block (lineage anchor), if any
+    parent_hash: Optional[int] = None
+    dp_rank: int = 0
+    tier: str = "g1"  # g1=HBM, g2=host, g3=disk, g4=object store
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "worker_id": self.worker_id,
+            "event_id": self.event_id,
+            "op": self.op,
+            "block_hashes": [hash_to_wire(h) for h in self.block_hashes],
+            "parent_hash": (
+                hash_to_wire(self.parent_hash) if self.parent_hash is not None else None
+            ),
+            "dp_rank": self.dp_rank,
+            "tier": self.tier,
+        }
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "KvCacheEvent":
+        ph = d.get("parent_hash")
+        return KvCacheEvent(
+            worker_id=d["worker_id"],
+            event_id=d["event_id"],
+            op=d["op"],
+            block_hashes=[wire_to_hash(b) for b in d.get("block_hashes", [])],
+            parent_hash=wire_to_hash(ph) if ph is not None else None,
+            dp_rank=d.get("dp_rank", 0),
+            tier=d.get("tier", "g1"),
+        )
+
+
+def kv_event_subject(namespace: str, component: str) -> str:
+    return f"{KV_EVENT_SUBJECT_PREFIX}.{namespace}.{component}"
+
+
+class KvEventPublisher:
+    """Assigns monotonic event ids, publishes, and keeps a replay ring."""
+
+    def __init__(self, runtime, namespace: str, component: str, worker_id: int,
+                 dp_rank: int = 0, ring_size: int = 4096):
+        self.runtime = runtime
+        self.subject = kv_event_subject(namespace, component)
+        self.worker_id = worker_id
+        self.dp_rank = dp_rank
+        self._next_id = 0
+        self._ring: deque[KvCacheEvent] = deque(maxlen=ring_size)
+
+    def _mk(self, op: str, block_hashes: Sequence[int],
+            parent_hash: Optional[int], tier: str) -> KvCacheEvent:
+        ev = KvCacheEvent(
+            worker_id=self.worker_id,
+            event_id=self._next_id,
+            op=op,
+            block_hashes=list(block_hashes),
+            parent_hash=parent_hash,
+            dp_rank=self.dp_rank,
+            tier=tier,
+        )
+        self._next_id += 1
+        self._ring.append(ev)
+        return ev
+
+    async def stored(self, block_hashes: Sequence[int],
+                     parent_hash: Optional[int] = None, tier: str = "g1") -> None:
+        if not block_hashes:
+            return
+        ev = self._mk("stored", block_hashes, parent_hash, tier)
+        await self.runtime.event_plane.publish(self.subject, ev.to_wire())
+
+    async def removed(self, block_hashes: Sequence[int], tier: str = "g1") -> None:
+        if not block_hashes:
+            return
+        ev = self._mk("removed", block_hashes, None, tier)
+        await self.runtime.event_plane.publish(self.subject, ev.to_wire())
+
+    async def cleared(self) -> None:
+        ev = self._mk("cleared", [], None, "g1")
+        await self.runtime.event_plane.publish(self.subject, ev.to_wire())
+
+    # -- recovery (ref: router-design.md:186-195 gap recovery) -------------
+    def replay_since(self, since_event_id: int) -> List[Dict[str, Any]]:
+        return [e.to_wire() for e in self._ring if e.event_id >= since_event_id]
+
+    async def replay_handler(self, payload, ctx):
+        """Endpoint handler: router asks for events >= since_event_id."""
+        since = int(payload.get("since_event_id", 0)) if payload else 0
+        for wire_ev in self.replay_since(since):
+            yield wire_ev

@@ -1,0 +1,216 @@
+"""Frontend e2e: OpenAI HTTP ↔ mocker workers over the real request plane.
+
+Model: the reference's tests/router/test_router_e2e_with_mockers.py shape —
+full pipeline, no accelerator.
+"""
+
+import asyncio
+import json
+import uuid
+
+import aiohttp
+
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig
+
+
+def fresh_runtime() -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+async def start_stack(n_workers=1, model_name="test-model", **engine_kw):
+    rt = await fresh_runtime().start()
+    args = MockEngineArgs(model_name=model_name, block_size=4,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0, **engine_kw)
+    workers = []
+    for _ in range(n_workers):
+        workers.append(await MockerWorker(rt, args).start())
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1", port=0).start()
+    port = service._runner.addresses[0][1]
+    # wait for the watcher to pick the model up
+    for _ in range(100):
+        if manager.get(model_name):
+            break
+        await asyncio.sleep(0.02)
+    assert manager.get(model_name) is not None
+    return rt, workers, watcher, service, f"http://127.0.0.1:{port}"
+
+
+async def stop_stack(rt, workers, watcher, service):
+    await service.close()
+    await watcher.close()
+    for w in workers:
+        await w.close()
+    await rt.shutdown()
+
+
+async def test_models_and_chat_completion():
+    rt, workers, watcher, service, url = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{url}/v1/models") as r:
+                data = await r.json()
+                assert [m["id"] for m in data["data"]] == ["test-model"]
+
+            body = {
+                "model": "test-model",
+                "messages": [{"role": "user", "content": "hello there"}],
+                "max_tokens": 8,
+                "ignore_eos": True,
+            }
+            async with s.post(f"{url}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["object"] == "chat.completion"
+                assert data["usage"]["completion_tokens"] == 8
+                assert data["choices"][0]["message"]["content"]
+                assert data["choices"][0]["finish_reason"] == "length"
+    finally:
+        await stop_stack(rt, workers, watcher, service)
+
+
+async def test_chat_streaming_sse():
+    rt, workers, watcher, service, url = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "test-model",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 5,
+                "stream": True,
+                "ignore_eos": True,
+            }
+            chunks = []
+            async with s.post(f"{url}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    payload = line[len("data: "):]
+                    if payload == "[DONE]":
+                        chunks.append("DONE")
+                        break
+                    chunks.append(json.loads(payload))
+            assert chunks[-1] == "DONE"
+            deltas = [c for c in chunks if c != "DONE"]
+            assert deltas[0]["choices"][0]["delta"].get("role") == "assistant"
+            assert deltas[-1]["choices"][0]["finish_reason"] == "length"
+            assert any(c["choices"][0]["delta"].get("content") for c in deltas)
+    finally:
+        await stop_stack(rt, workers, watcher, service)
+
+
+async def test_completions_endpoint():
+    rt, workers, watcher, service, url = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "test-model", "prompt": "once upon",
+                    "max_tokens": 4, "ignore_eos": True}
+            async with s.post(f"{url}/v1/completions", json=body) as r:
+                data = await r.json()
+                assert r.status == 200
+                assert data["object"] == "text_completion"
+                assert data["usage"]["completion_tokens"] == 4
+    finally:
+        await stop_stack(rt, workers, watcher, service)
+
+
+async def test_error_paths():
+    rt, workers, watcher, service, url = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions",
+                              json={"model": "nope", "messages": []}) as r:
+                assert r.status == 404
+            async with s.post(f"{url}/v1/chat/completions",
+                              data=b"not json") as r:
+                assert r.status == 400
+            async with s.post(f"{url}/v1/chat/completions",
+                              json={"model": "test-model",
+                                    "messages": "bad"}) as r:
+                assert r.status == 400
+    finally:
+        await stop_stack(rt, workers, watcher, service)
+
+
+async def test_migration_on_worker_failure():
+    """A flaky worker dies mid-stream; migration replays onto a healthy one."""
+    rt = await fresh_runtime().start()
+    ns = rt.namespace("dynamo")
+    comp = ns.component("mocker")
+
+    from dynamo_tpu.protocols import (LLMEngineOutput, ModelDeploymentCard,
+                                      PreprocessedRequest)
+    from dynamo_tpu.protocols.model_card import register_model
+
+    async def flaky_handler(payload, ctx):
+        yield LLMEngineOutput(token_ids=[101]).to_dict()
+        yield LLMEngineOutput(token_ids=[102]).to_dict()
+        raise RuntimeError("connection lost (worker died)")
+
+    async def healthy_handler(payload, ctx):
+        req = PreprocessedRequest.from_dict(payload)
+        # replayed prompt must include the two already-emitted tokens
+        assert req.token_ids[-2:] == [101, 102]
+        for t in range(req.stop.max_tokens - 1):
+            yield LLMEngineOutput(token_ids=[200 + t]).to_dict()
+        yield LLMEngineOutput(token_ids=[299],
+                              finish_reason="length").to_dict()
+
+    await comp.endpoint("generate").serve_endpoint(flaky_handler, instance_id=1)
+
+    rt2 = DistributedRuntime(config=rt.config, cluster_id=rt.cluster_id)
+    await rt2.start()
+    await rt2.namespace("dynamo").component("mocker").endpoint(
+        "generate").serve_endpoint(healthy_handler, instance_id=2)
+
+    card = ModelDeploymentCard(name="m", component="mocker",
+                               migration_limit=3)
+    await register_model(rt, card)
+
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    for _ in range(100):
+        if manager.get("m"):
+            break
+        await asyncio.sleep(0.02)
+    pipeline = manager.get("m")
+    client = pipeline.client
+    await client.wait_for_instances()
+    for _ in range(100):
+        if len(client.instances) == 2:
+            break
+        await asyncio.sleep(0.02)
+
+    from dynamo_tpu.protocols import StopConditions
+
+    req = PreprocessedRequest(token_ids=[1, 2, 3], request_id="mig-1",
+                              stop=StopConditions(max_tokens=6,
+                                                  ignore_eos=True))
+    # force first attempt onto the flaky worker via a route hook
+    attempts = []
+
+    async def route(r, avoid=()):
+        choice = 1 if 1 not in avoid else 2
+        attempts.append(choice)
+        return choice
+
+    pipeline.migration.route = route
+    tokens = []
+    async for out in pipeline.migration.generate(req):
+        tokens.extend(out.token_ids)
+    # 2 tokens from flaky + 4 remaining from healthy (6 total budget)
+    assert attempts == [1, 2]
+    assert tokens[:2] == [101, 102]
+    assert len(tokens) == 6
+
+    await watcher.close()
+    await rt2.shutdown()
+    await rt.shutdown()

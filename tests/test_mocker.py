@@ -1,0 +1,128 @@
+"""Mock engine tests: scheduling, prefix caching, KV events, cancellation."""
+
+import asyncio
+import uuid
+
+from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.mocker.kv_cache_sim import KvCacheSim
+from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime import CancellationToken
+from dynamo_tpu.tokens import compute_block_hashes
+
+
+def make_args(**kw):
+    defaults = dict(block_size=4, num_blocks=64, base_step_s=0.0005,
+                    prefill_s_per_token=0.0, decode_s_per_seq=0.0)
+    defaults.update(kw)
+    return MockEngineArgs(**defaults)
+
+
+def req(tokens, max_tokens=8, rid=None, seed=0, ignore_eos=True):
+    return PreprocessedRequest(
+        token_ids=tokens,
+        request_id=rid or uuid.uuid4().hex,
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=ignore_eos),
+    )
+
+
+# --------------------------- KvCacheSim unit ---------------------------
+
+
+def test_cache_prefix_hit_and_eviction():
+    sim = KvCacheSim(num_blocks=8)
+    hs = compute_block_hashes(list(range(16)), 4)  # 4 full blocks
+    res = sim.allocate("a", hs, total_blocks=4)
+    assert res is not None and len(res.stored) == 4 and res.cached_blocks == 0
+    # same prefix again: full hit
+    res2 = sim.allocate("b", hs, total_blocks=4)
+    assert res2 is not None and res2.cached_blocks == 4 and not res2.stored
+    sim.free("a")
+    sim.free("b")
+    # blocks remain cached for reuse
+    assert sim.lookup(hs) == 4
+    # fill the cache with new sequences; old blocks get evicted (LRU)
+    hs2 = compute_block_hashes(list(range(100, 132)), 4)  # 8 blocks
+    res3 = sim.allocate("c", hs2, total_blocks=8)
+    assert res3 is not None
+    assert len(res3.removed) == 4  # evicted the old cached blocks
+    assert sim.lookup(hs) == 0
+
+
+def test_cache_capacity_refusal():
+    sim = KvCacheSim(num_blocks=4)
+    hs = compute_block_hashes(list(range(32)), 4)  # 8 blocks > capacity
+    assert sim.allocate("a", hs, total_blocks=8) is None
+
+
+# --------------------------- engine behavior ---------------------------
+
+
+async def test_engine_generates_and_finishes():
+    eng = MockEngine(make_args())
+    outs = []
+    async for out in eng.generate(req(list(range(10)), max_tokens=5)):
+        outs.append(out)
+    assert len(outs) == 5
+    assert all(len(o.token_ids) == 1 for o in outs)
+    assert outs[-1].finish_reason == "length"
+    assert outs[-1].metrics is not None
+    await eng.close()
+
+
+async def test_engine_prefix_cache_hits_across_requests():
+    eng = MockEngine(make_args())
+    prompt = list(range(40))  # 10 blocks of 4
+    async for _ in eng.generate(req(prompt, max_tokens=2, seed=1)):
+        pass
+    hit0 = eng.metrics["cache_hit_blocks"]
+    async for _ in eng.generate(req(prompt, max_tokens=2, seed=2)):
+        pass
+    assert eng.metrics["cache_hit_blocks"] >= hit0 + 10
+    await eng.close()
+
+
+async def test_engine_concurrent_requests():
+    eng = MockEngine(make_args(max_num_seqs=8))
+    async def run_one(i):
+        n = 0
+        async for out in eng.generate(req(list(range(i * 7, i * 7 + 12)),
+                                          max_tokens=6)):
+            n += len(out.token_ids)
+        return n
+    counts = await asyncio.gather(*[run_one(i) for i in range(6)])
+    assert all(c == 6 for c in counts)
+    await eng.close()
+
+
+async def test_engine_cancellation():
+    eng = MockEngine(make_args(decode_s_per_seq=0.01))
+    token = CancellationToken()
+    got = []
+
+    async def consume():
+        async for out in eng.generate(req(list(range(8)), max_tokens=10_000),
+                                      token=token):
+            got.append(out)
+
+    task = asyncio.create_task(consume())
+    await asyncio.sleep(0.3)
+    token.stop()
+    await asyncio.wait_for(task, timeout=5)
+    assert got and got[-1].finish_reason == "cancelled"
+    assert eng.running == [] and eng.waiting == []
+    await eng.close()
+
+
+async def test_engine_deterministic_with_seed():
+    eng = MockEngine(make_args())
+    async def run(seed):
+        r = req(list(range(8)), max_tokens=6)
+        r.sampling.seed = seed
+        return [o.token_ids[0] async for o in eng.generate(r)
+                if o.token_ids]
+    a = await run(42)
+    b = await run(42)
+    c = await run(43)
+    assert a == b
+    assert a != c
+    await eng.close()
