@@ -1,0 +1,100 @@
+"""Headline benchmark: decode throughput of the native JAX engine hot path.
+
+Runs on whatever accelerator JAX finds (one v5e chip under the driver).
+Measures steady-state batched paged-decode throughput on the llama-1b
+flagship preset and compares against the HBM-bandwidth roofline for the same
+shapes — decode is bandwidth-bound, so `vs_baseline` is the fraction of the
+theoretically attainable tokens/sec/chip this implementation achieves
+(BASELINE.md has no reference numbers to beat; the north star is tokens/sec/
+chip parity, which roofline fraction tracks hardware-independently).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": f}
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+
+BATCH = 8
+CTX = 512            # context tokens per sequence during decode
+BLOCK = 16
+STEPS = 64
+WARMUP = 8
+
+# v5e: ~819 GB/s HBM BW; CPU fallback number is irrelevant (vs_baseline only
+# meaningful on TPU)
+HBM_GBPS = 819.0
+
+
+def main() -> None:
+    cfg = llama.PRESETS["llama-1b"]
+    max_blocks = CTX // BLOCK + STEPS // BLOCK + 2
+    num_blocks = BATCH * max_blocks + 1
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kv = tuple(
+        jnp.zeros((cfg.n_layers, num_blocks, BLOCK, cfg.n_kv_heads,
+                   cfg.head_dim), cfg.dtype)
+        for _ in range(2)
+    )
+    rng = np.random.default_rng(0)
+    tables = np.zeros((BATCH, max_blocks), np.int32)
+    for b in range(BATCH):
+        tables[b] = 1 + b * max_blocks + np.arange(max_blocks)
+    tables = jnp.asarray(tables)
+
+    def decode_step(params, kv, tokens, positions, tables, ctx_lens):
+        logits, kv = llama.decode(params, cfg, kv, tokens, positions,
+                                  tables, ctx_lens)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+    step = jax.jit(decode_step, donate_argnums=(1,))
+
+    tokens = jnp.asarray(rng.integers(3, cfg.vocab_size, BATCH, np.int32))
+    ctx_lens = jnp.full((BATCH,), CTX, jnp.int32)
+
+    # warmup + compile.  NOTE: on this image's tunneled "axon" platform,
+    # block_until_ready doesn't actually block — only a host transfer
+    # round-trips — so timing brackets an on-device pipelined loop with a
+    # single final fetch (which is also how a local-TPU serving loop runs:
+    # sampled ids chain on device).
+    for i in range(WARMUP):
+        tokens, kv = step(params, kv, tokens, ctx_lens + i, tables,
+                          ctx_lens + i)
+    np.asarray(tokens)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        tokens, kv = step(params, kv, tokens, ctx_lens + WARMUP + i, tables,
+                          ctx_lens + WARMUP + i)
+    np.asarray(tokens)  # forces completion of the whole dependent chain
+    dt = time.perf_counter() - t0
+
+    tps = BATCH * STEPS / dt
+
+    # bandwidth roofline for these shapes (per decoded token):
+    #   params read once per step, amortized over the batch
+    #   + this seq's KV context read (K and V)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    param_bytes = n_params * 2
+    kv_bytes = (cfg.n_layers * (CTX + WARMUP + STEPS / 2)
+                * cfg.n_kv_heads * cfg.head_dim * 2 * 2)
+    bytes_per_token = param_bytes / BATCH + kv_bytes
+    roofline_tps = HBM_GBPS * 1e9 / bytes_per_token
+
+    print(json.dumps({
+        "metric": "llama-1b paged decode throughput (B=8, ctx=512, bf16)",
+        "value": round(tps, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tps / roofline_tps, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+from .block_allocator import BlockAllocator
+from .config import EngineConfig
+from .core import JaxEngine
+
+__all__ = ["BlockAllocator", "EngineConfig", "JaxEngine"]

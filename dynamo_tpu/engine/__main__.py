@@ -1,0 +1,62 @@
+"""`python -m dynamo_tpu.engine` — run a JAX engine worker.
+
+The TPU-native equivalent of `python -m dynamo.vllm`
+(ref: components/src/dynamo/vllm/main.py:114).
+"""
+
+import argparse
+import asyncio
+import logging
+
+from ..runtime import DistributedRuntime
+from .config import EngineConfig
+from .worker import JaxEngineWorker
+
+
+def build_args() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dynamo_tpu.engine")
+    p.add_argument("--model", default="tiny", help="model preset name")
+    p.add_argument("--model-name", default="", help="served model name")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--max-blocks-per-seq", type=int, default=64)
+    p.add_argument("--max-num-seqs", type=int, default=8)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--no-prefix-caching", action="store_true")
+    p.add_argument("--migration-limit", type=int, default=3)
+    return p
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    args = build_args().parse_args()
+    config = EngineConfig(
+        model=args.model,
+        model_name=args.model_name,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        max_blocks_per_seq=args.max_blocks_per_seq,
+        max_num_seqs=args.max_num_seqs,
+        tp=args.tp,
+        dp=args.dp,
+        enable_prefix_caching=not args.no_prefix_caching,
+    )
+    rt = await DistributedRuntime.detached().start()
+    worker = await JaxEngineWorker(
+        rt, config, namespace=args.namespace, component=args.component,
+        migration_limit=args.migration_limit,
+    ).start()
+    print(f"ready instance_id={worker.served.instance_id}", flush=True)
+    try:
+        await rt.root_token.wait_killed()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await worker.close()
+    await rt.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

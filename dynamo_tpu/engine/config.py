@@ -1,0 +1,50 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from ..models.llama import PRESETS, LlamaConfig
+from ..parallel.mesh import MeshConfig
+
+
+@dataclass
+class EngineConfig:
+    model: str = "tiny"  # preset name (models/llama.py PRESETS)
+    model_config: Optional[LlamaConfig] = None
+    model_name: str = ""  # served model name; defaults to preset name
+
+    # paged KV cache
+    block_size: int = 16          # tokens per block == PLH hashing block size
+    num_blocks: int = 512         # physical blocks (id 0 is garbage)
+    max_blocks_per_seq: int = 64  # max context = block_size * this
+    enable_prefix_caching: bool = True
+
+    # batching
+    max_num_seqs: int = 8
+    prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
+
+    # parallelism
+    dp: int = 1
+    tp: int = 1
+
+    eos_token_id: int = 2
+    seed: int = 0
+
+    def resolve_model(self) -> LlamaConfig:
+        if self.model_config is not None:
+            return self.model_config
+        if self.model not in PRESETS:
+            raise ValueError(
+                f"unknown model preset {self.model!r}; have {sorted(PRESETS)}"
+            )
+        return PRESETS[self.model]
+
+    @property
+    def served_name(self) -> str:
+        return self.model_name or self.resolve_model().name
+
+    @property
+    def max_context(self) -> int:
+        return self.block_size * self.max_blocks_per_seq

@@ -1,0 +1,493 @@
+"""The JAX engine core: continuous batching over a paged KV cache.
+
+This is the component the reference does NOT have — it delegates token
+generation to vLLM/SGLang/TRT-LLM (SURVEY.md §7 scope delta).  Design, for
+XLA's compile-once/execute-many model:
+
+  * two jitted programs: `prefill` (per padded-length bucket, one sequence)
+    and `decode` (fixed batch = max_num_seqs, inactive slots masked to the
+    garbage block).  No data-dependent shapes ever reach XLA.
+  * the KV cache is donated through every step, so updates are in-place in
+    HBM; only sampled token ids (B int32) cross back to the host per step.
+  * host-side scheduler (this file) admits requests, manages the block
+    allocator and PLH bookkeeping, streams tokens, and publishes KV events —
+    mirroring the vLLM-scheduler behaviors the mocker simulates.
+  * prefix-cache hits skip prefill compute for matched blocks: the prefill
+    program attends to cached context through the block table (unified
+    chunked-prefill/prefix-reuse path, ops/paged_attention.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..parallel.mesh import MeshConfig, kv_cache_spec, make_mesh, shard_params
+from ..protocols import LLMEngineOutput, PreprocessedRequest
+from ..tokens import TokenBlockSequence
+from .block_allocator import BlockAllocator
+from .config import EngineConfig
+from .sampler import sample_tokens
+
+logger = logging.getLogger(__name__)
+
+
+def _set_result_safe(fut: asyncio.Future, value) -> None:
+    if not fut.done():
+        fut.set_result(value)
+
+
+@dataclass
+class _Slot:
+    index: int
+    request: PreprocessedRequest
+    seq: TokenBlockSequence
+    out_q: asyncio.Queue
+    block_table: np.ndarray  # [max_blocks_per_seq] int32
+    ctx_len: int = 0         # tokens materialized in the cache
+    last_token: int = 0
+    generated: int = 0
+    committed_blocks: int = 0
+    sampling_seed: int = 0
+    finished: bool = False
+    cancel_requested: bool = False
+    cached_tokens: int = 0   # prefix-cache reuse (for metrics)
+    enqueued_t: float = 0.0
+    first_token_t: float = 0.0
+
+
+class JaxEngine:
+    def __init__(self, config: EngineConfig, params=None, mesh=None,
+                 kv_event_sink=None):
+        """kv_event_sink: optional callable(stored: list[int], removed: list[int])
+        -> awaitable, invoked with PLH batches as the cache mutates."""
+        self.config = config
+        self.model_cfg = config.resolve_model()
+        self.mesh = mesh if mesh is not None else make_mesh(
+            MeshConfig(dp=config.dp, tp=config.tp)
+        )
+        self.kv_event_sink = kv_event_sink
+        self.allocator = BlockAllocator(
+            config.num_blocks, config.enable_prefix_caching
+        )
+
+        with self.mesh:
+            if params is None:
+                params = llama.init_params(
+                    self.model_cfg, jax.random.PRNGKey(config.seed)
+                )
+            self.params = shard_params(params, self.mesh)
+            self.kv = self._init_kv_cache()
+
+        self._jit_decode = jax.jit(
+            partial(self._decode_impl, self.model_cfg), donate_argnums=(1,)
+        )
+        self._jit_prefill = jax.jit(
+            partial(self._prefill_impl, self.model_cfg), donate_argnums=(1,)
+        )
+
+        self.waiting: List[_Slot] = []
+        self._clear_requests: List[asyncio.Future] = []
+        self._qlock = threading.Lock()  # guards `waiting` across threads
+        self._slots: List[Optional[_Slot]] = [None] * config.max_num_seqs
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._loop_ref: Optional[asyncio.AbstractEventLoop] = None
+        self._closed = False
+        self.metrics: Dict[str, Any] = {
+            "steps": 0, "prefill_tokens": 0, "decode_tokens": 0,
+            "cache_hit_tokens": 0, "preemptions": 0, "step_time_s": 0.0,
+        }
+
+    # -- cache ------------------------------------------------------------
+    def _init_kv_cache(self):
+        m = self.model_cfg
+        c = self.config
+        shape = (m.n_layers, c.num_blocks, c.block_size, m.n_kv_heads,
+                 m.head_dim)
+        sharding = NamedSharding(self.mesh, kv_cache_spec())
+        zeros = partial(jnp.zeros, shape, m.dtype)
+        k = jax.jit(zeros, out_shardings=sharding)()
+        v = jax.jit(zeros, out_shardings=sharding)()
+        return (k, v)
+
+    # -- jitted programs --------------------------------------------------
+    @staticmethod
+    def _decode_impl(model_cfg, params, kv, tokens, positions, block_tables,
+                     ctx_lens, seeds, steps, temps, top_ks, top_ps):
+        logits, kv = llama.decode(
+            params, model_cfg, kv, tokens, positions, block_tables, ctx_lens
+        )
+        next_tokens = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
+        return next_tokens, kv
+
+    @staticmethod
+    def _prefill_impl(model_cfg, params, kv, tokens, positions, block_table,
+                      ctx_len, true_len, seed, temp, top_k, top_p):
+        logits, kv = llama.prefill(
+            params, model_cfg, kv, tokens, positions, block_table,
+            ctx_len, true_len,
+        )
+        tok = sample_tokens(
+            logits[None], seed[None], jnp.zeros((1,), jnp.int32),
+            temp[None], top_k[None], top_p[None],
+        )[0]
+        return tok, kv
+
+    # -- request entry ----------------------------------------------------
+    def start(self) -> None:
+        if self._task is None:
+            self._loop_ref = asyncio.get_running_loop()
+            self._task = asyncio.create_task(self._loop())
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self._fail_all_streams()
+
+    def _fail_all_streams(self) -> None:
+        """Terminate every in-flight stream (shutdown or loop crash)."""
+        err = LLMEngineOutput(finish_reason="error")
+        with self._qlock:
+            stuck = list(self.waiting) + [
+                s for s in self._slots if s is not None
+            ]
+            self.waiting.clear()
+        for slot in stuck:
+            if not slot.finished:
+                slot.finished = True
+                slot.out_q.put_nowait(err)
+
+    @property
+    def num_active_seqs(self) -> int:
+        return sum(s is not None for s in self._slots) + len(self.waiting)
+
+    def kv_usage(self) -> float:
+        return self.allocator.usage()
+
+    async def generate(
+        self, request: PreprocessedRequest, token=None
+    ) -> AsyncIterator[LLMEngineOutput]:
+        self.start()
+        if len(request.token_ids) >= self.config.max_context:
+            yield LLMEngineOutput(finish_reason="error")
+            return
+        slot = _Slot(
+            index=-1,
+            request=request,
+            seq=TokenBlockSequence(
+                request.token_ids, self.config.block_size,
+                salt=(request.lora_name or "").encode(),
+            ),
+            out_q=asyncio.Queue(),
+            block_table=np.zeros(self.config.max_blocks_per_seq, np.int32),
+            sampling_seed=(
+                request.sampling.seed
+                if request.sampling.seed is not None
+                else hash(request.request_id) & 0x7FFFFFFF
+            ),
+            enqueued_t=time.monotonic(),
+        )
+        with self._qlock:
+            self.waiting.append(slot)
+        self._wake.set()
+        from ..runtime.aio import CANCELLED, next_or_cancel
+
+        try:
+            while True:
+                item = await next_or_cancel(
+                    slot.out_q,
+                    token.stopped_event if token is not None else None,
+                )
+                if item is CANCELLED:
+                    slot.cancel_requested = True
+                    self._wake.set()
+                    yield LLMEngineOutput(finish_reason="cancelled")
+                    return
+                yield item
+                if item.finish_reason is not None:
+                    return
+        finally:
+            if not slot.finished:
+                # actual teardown happens on the scheduler thread
+                slot.cancel_requested = True
+                self._wake.set()
+
+    def _process_cancellations(self) -> None:
+        """Runs on the scheduler thread at the top of every step."""
+        with self._qlock:
+            for slot in list(self.waiting):
+                if slot.cancel_requested:
+                    self.waiting.remove(slot)
+                    slot.finished = True
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.cancel_requested:
+                slot.finished = True
+                self._slots[i] = None
+                self._emit_events(self.allocator.free(self._seq_id(slot)))
+
+    def _seq_id(self, slot: _Slot) -> str:
+        return slot.request.request_id
+
+    def _emit_events(self, res) -> None:
+        """Thread-safe KV event emission (called from the scheduler thread)."""
+        if res is None or self.kv_event_sink is None:
+            return
+        stored = getattr(res, "stored", [])
+        removed = getattr(res, "removed", [])
+        if (stored or removed) and self._loop_ref is not None:
+            coro = self.kv_event_sink(list(stored), list(removed))
+            self._loop_ref.call_soon_threadsafe(asyncio.ensure_future, coro)
+
+    async def clear_kv_blocks(self) -> int:
+        """Drop the reusable prefix cache (active sequences keep their
+        blocks).  Runs on the scheduler thread to avoid racing it."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._clear_requests.append(fut)
+        self._wake.set()
+        # if the scheduler loop is idle/unstarted, serve immediately
+        if self._task is None:
+            self._do_clear()
+        removed = await fut
+        if self.kv_event_sink is not None and removed:
+            await self.kv_event_sink([], removed)
+        return len(removed)
+
+    def _do_clear(self) -> None:
+        removed = self.allocator.clear_cached()
+        while self._clear_requests:
+            fut = self._clear_requests.pop(0)
+            if self._loop_ref is not None:
+                self._loop_ref.call_soon_threadsafe(
+                    _set_result_safe, fut, removed
+                )
+            else:
+                _set_result_safe(fut, removed)
+
+    # -- scheduler loop ---------------------------------------------------
+    async def _loop(self) -> None:
+        try:
+            while not self._closed:
+                if self._clear_requests:
+                    self._do_clear()  # loop thread; scheduler step not running
+                busy = any(s is not None for s in self._slots)
+                if not busy and not self.waiting:
+                    self._wake.clear()
+                    if self._clear_requests:
+                        continue
+                    await self._wake.wait()
+                    continue
+                t0 = time.monotonic()
+                await asyncio.to_thread(self._sched_step)
+                self.metrics["step_time_s"] = time.monotonic() - t0
+                self.metrics["steps"] += 1
+                await asyncio.sleep(0)  # yield to the event loop
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("engine loop crashed")
+            self._fail_all_streams()
+            raise
+
+    def _sched_step(self) -> None:
+        """One scheduler iteration, entirely on the worker thread."""
+        self._process_cancellations()
+        self._admit_and_prefill()
+        if any(s is not None for s in self._slots):
+            self._decode_step()
+
+    # -- prefill ----------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        return self.config.prefill_buckets[-1]
+
+    def _admit_and_prefill(self) -> None:
+        with self._qlock:
+            if not self.waiting:
+                return
+            free_idx = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if free_idx is None:
+                return
+            slot = self.waiting[0]
+            c = self.config
+            prompt_len = len(slot.seq)
+            hashes = slot.seq.block_hashes
+            # never reuse the whole prompt: the last token must be computed
+            # to produce first-token logits
+            cap_blocks = max(0, (prompt_len - 1) // c.block_size)
+            res = self.allocator.allocate(
+                self._seq_id(slot), hashes[:cap_blocks], slot.seq.num_blocks
+            )
+            if res is None:
+                return  # capacity: stay in queue (FIFO)
+            self.waiting.pop(0)
+        self._emit_events(res)
+        slot.index = free_idx
+        self._slots[free_idx] = slot
+        bids = res.block_ids
+        slot.block_table[: len(bids)] = bids
+        slot.committed_blocks = res.cached_blocks
+        cached_tokens = res.cached_blocks * c.block_size
+        slot.cached_tokens = cached_tokens
+        self.metrics["cache_hit_tokens"] += cached_tokens
+        slot.ctx_len = cached_tokens
+
+        # chunked prefill of the uncached suffix
+        table_dev = jnp.asarray(slot.block_table)
+        max_chunk = self.config.prefill_buckets[-1]
+        pos = cached_tokens
+        tok = 0
+        while pos < prompt_len:
+            chunk = min(max_chunk, prompt_len - pos)
+            bucket = self._bucket_for(chunk)
+            toks = np.zeros(bucket, np.int32)
+            toks[:chunk] = slot.seq.tokens[pos: pos + chunk]
+            positions = pos + np.arange(bucket, dtype=np.int32)
+            s = slot.request.sampling
+            tok, self.kv = self._jit_prefill(
+                self.params, self.kv,
+                jnp.asarray(toks), jnp.asarray(positions), table_dev,
+                jnp.int32(pos), jnp.int32(chunk),
+                jnp.int32(slot.sampling_seed),
+                jnp.float32(s.temperature), jnp.int32(s.top_k),
+                jnp.float32(s.top_p),
+            )
+            self.metrics["prefill_tokens"] += chunk
+            pos += chunk
+        slot.ctx_len = prompt_len
+        # register any full prompt blocks that weren't already cached
+        self._commit_full_blocks(slot)
+        first = int(tok)
+        slot.first_token_t = time.monotonic()
+        self._push_token(slot, first)
+
+    # -- decode -----------------------------------------------------------
+    def _decode_step(self) -> None:
+        c = self.config
+        B = c.max_num_seqs
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+        # every active slot needs a block for position ctx_len
+        for slot in active:
+            nblocks = int(np.count_nonzero(slot.block_table))
+            if slot.ctx_len >= nblocks * c.block_size:
+                grow = self.allocator.append_block(self._seq_id(slot))
+                self._emit_events(grow)
+                if grow.block_id is None:
+                    self._preempt(slot)
+                    continue
+                slot.block_table[nblocks] = grow.block_id
+
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        ctx_lens = np.zeros(B, np.int32)
+        tables = np.zeros((B, c.max_blocks_per_seq), np.int32)
+        seeds = np.zeros(B, np.int32)
+        steps = np.zeros(B, np.int32)
+        temps = np.zeros(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        top_ps = np.ones(B, np.float32)
+        for s in active:
+            i = s.index
+            tokens[i] = s.last_token
+            positions[i] = s.ctx_len
+            ctx_lens[i] = s.ctx_len
+            tables[i] = s.block_table
+            seeds[i] = s.sampling_seed
+            steps[i] = s.generated + 1
+            temps[i] = s.request.sampling.temperature
+            top_ks[i] = s.request.sampling.top_k
+            top_ps[i] = s.request.sampling.top_p
+
+        next_tokens, self.kv = self._jit_decode(
+            self.params, self.kv,
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(ctx_lens), jnp.asarray(seeds), jnp.asarray(steps),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+        )
+        next_tokens = np.asarray(next_tokens)
+        for s in active:
+            s.ctx_len += 1
+            self.metrics["decode_tokens"] += 1
+            self._push_token(s, int(next_tokens[s.index]))
+
+    def _commit_full_blocks(self, slot: _Slot) -> None:
+        """Register newly-completed full blocks under their PLH."""
+        while slot.committed_blocks < slot.seq.num_full_blocks:
+            idx = slot.committed_blocks
+            h = slot.seq.block_hashes[idx]
+            res = self.allocator.commit_block(self._seq_id(slot), idx, h)
+            self._emit_events(res)
+            slot.committed_blocks += 1
+
+    def _push_token(self, slot: _Slot, tok: int) -> None:
+        """Append a generated token, stream it, handle finish."""
+        slot.seq.append(tok)
+        slot.last_token = tok
+        slot.generated += 1
+        self._commit_full_blocks(slot)
+        finish = self._finish_reason(slot, tok)
+        out = LLMEngineOutput(
+            token_ids=[tok],
+            finish_reason=finish,
+            metrics=(
+                {"kv_usage": self.kv_usage(),
+                 "cached_tokens": slot.cached_tokens,
+                 "ttft_s": slot.first_token_t - slot.enqueued_t}
+                if finish else None
+            ),
+        )
+        if self._loop_ref is not None:
+            self._loop_ref.call_soon_threadsafe(slot.out_q.put_nowait, out)
+        if finish is not None:
+            slot.finished = True
+            if slot.index >= 0:
+                self._slots[slot.index] = None
+            self._emit_events(self.allocator.free(self._seq_id(slot)))
+
+    def _preempt(self, slot: _Slot) -> None:
+        """KV OOM: drop the slot's blocks and re-enqueue with full replay."""
+        self.metrics["preemptions"] += 1
+        self._slots[slot.index] = None
+        self._emit_events(self.allocator.free(self._seq_id(slot)))
+        slot.index = -1
+        slot.ctx_len = 0
+        slot.committed_blocks = 0
+        slot.block_table[:] = 0
+        with self._qlock:
+            self.waiting.insert(0, slot)
+
+    def _finish_reason(self, slot: _Slot, tok: int) -> Optional[str]:
+        st = slot.request.stop
+        if not st.ignore_eos and tok == self.config.eos_token_id:
+            return "stop"
+        if tok in (st.stop_token_ids or []):
+            return "stop"
+        if slot.generated >= st.max_tokens:
+            return "length"
+        if slot.ctx_len + 1 >= self.config.max_context:
+            return "length"
+        return None

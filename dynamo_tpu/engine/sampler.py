@@ -1,0 +1,66 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+Runs inside the jitted decode/prefill step so only the sampled token ids
+cross back to the host.  All parameters are per-slot arrays so one compiled
+program serves heterogeneous batches (mixing greedy and sampled requests).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jax.Array,        # [B, vocab] fp32
+    seeds: jax.Array,         # [B] int32 per-request seed
+    steps: jax.Array,         # [B] int32 decode step counter (rng stream)
+    temperature: jax.Array,   # [B] fp32; <=0 means greedy
+    top_k: jax.Array,         # [B] int32; 0 disables
+    top_p: jax.Array,         # [B] fp32; >=1 disables
+) -> jax.Array:
+    """Returns sampled token ids [B]."""
+    B, V = logits.shape
+
+    def one(lg, seed, step, temp, tk, tp):
+        greedy = jnp.argmax(lg)
+
+        def do_sample(_):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+            scaled = lg / jnp.maximum(temp, 1e-6)
+            # sort once; both top-k and top-p masks come from the sorted view
+            sorted_lg = jnp.sort(scaled)[::-1]
+            ranks = jnp.argsort(jnp.argsort(-scaled))  # rank of each token
+            # top-k mask
+            k_eff = jnp.where(tk > 0, tk, V)
+            keep_k = ranks < k_eff
+            # top-p (nucleus) mask over the sorted distribution
+            probs_sorted = jax.nn.softmax(sorted_lg)
+            cum = jnp.cumsum(probs_sorted)
+            # keep the smallest set with cumulative prob >= top_p; the first
+            # token is always kept
+            keep_sorted = jnp.concatenate(
+                [jnp.array([True]), cum[:-1] < tp]
+            )
+            keep_p = keep_sorted[ranks]
+            masked = jnp.where(keep_k & keep_p, scaled, NEG_INF)
+            return jax.random.categorical(key, masked)
+
+        return jax.lax.cond(temp <= 0.0, lambda _: greedy, do_sample,
+                            operand=None)
+
+    return jax.vmap(one)(logits, seeds, steps, temperature, top_k, top_p)
+
+
+def apply_penalties(
+    logits: jax.Array,          # [B, vocab]
+    token_counts: jax.Array,    # [B, vocab] int32: counts in generated output
+    frequency_penalty: jax.Array,  # [B]
+    presence_penalty: jax.Array,   # [B]
+) -> jax.Array:
+    lf = logits
+    lf = lf - frequency_penalty[:, None] * token_counts.astype(jnp.float32)
+    lf = lf - presence_penalty[:, None] * (token_counts > 0).astype(jnp.float32)
+    return lf

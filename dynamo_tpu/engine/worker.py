@@ -1,9 +1,10 @@
-"""Mocker worker: registers a simulated engine as a real Dynamo-style worker.
+"""JAX engine worker: serves the engine under the standard worker contract.
 
-Ref: components/src/dynamo/mocker/main.py:63 — the worker contract every
-backend implements (SURVEY.md §7): serve `generate` (+ `clear_kv_blocks`),
-publish the ModelDeploymentCard, emit KV events and periodic load metrics.
-The JAX engine worker implements this same contract against real TPUs.
+Same contract as the mocker worker (ref model:
+components/src/dynamo/vllm/worker_factory.py): generate / clear_kv_blocks /
+kv_events_replay endpoints, MDC publication, KV events, periodic load
+metrics.  The router cannot tell a JAX engine from a simulated one — which is
+the point of the contract.
 """
 
 from __future__ import annotations
@@ -13,65 +14,78 @@ import logging
 from typing import Optional
 
 from ..protocols import LLMEngineOutput, ModelDeploymentCard, PreprocessedRequest
-from ..protocols.model_card import register_model
+from ..protocols.model_card import deregister_model, register_model
 from ..router.events import KvEventPublisher
 from ..runtime import DistributedRuntime
-from .engine import MockEngine, MockEngineArgs
+from ..runtime.discovery import new_instance_id
+from .config import EngineConfig
+from .core import JaxEngine
 
 logger = logging.getLogger(__name__)
 
 LOAD_SUBJECT_PREFIX = "load_metrics"
 
 
-class MockerWorker:
-    def __init__(self, runtime: DistributedRuntime, args: MockEngineArgs,
-                 namespace: str = "dynamo", component: str = "mocker",
-                 migration_limit: int = 0):
+class JaxEngineWorker:
+    def __init__(self, runtime: DistributedRuntime, config: EngineConfig,
+                 namespace: str = "dynamo", component: str = "backend",
+                 migration_limit: int = 3,
+                 tokenizer_cfg: Optional[dict] = None,
+                 params=None):
         self.runtime = runtime
-        self.args = args
+        self.config = config
         self.namespace = namespace
         self.component = component
         self.migration_limit = migration_limit
+        self.tokenizer_cfg = tokenizer_cfg or {
+            "type": "mock", "vocab_size": config.resolve_model().vocab_size
+        }
+        self._params = params
+        self.engine: Optional[JaxEngine] = None
         self.publisher: Optional[KvEventPublisher] = None
-        self.engine: Optional[MockEngine] = None
         self.served = None
+        self._aux_served = []
         self._load_task: Optional[asyncio.Task] = None
 
     @property
     def card(self) -> ModelDeploymentCard:
+        m = self.config.resolve_model()
         return ModelDeploymentCard(
-            name=self.args.model_name,
+            name=self.config.served_name,
             namespace=self.namespace,
             component=self.component,
             endpoint="generate",
-            tokenizer={"type": "byte"},
-            kv_cache_block_size=self.args.block_size,
+            tokenizer=self.tokenizer_cfg,
+            context_length=min(m.max_context, self.config.max_context),
+            kv_cache_block_size=self.config.block_size,
             migration_limit=self.migration_limit,
             runtime_config={
-                "total_kv_blocks": self.args.num_blocks,
-                "max_num_seqs": self.args.max_num_seqs,
-                "role": self.args.role,
+                "total_kv_blocks": self.config.num_blocks,
+                "max_num_seqs": self.config.max_num_seqs,
+                "model_preset": self.config.model,
+                "tp": self.config.tp,
+                "dp": self.config.dp,
             },
         )
 
-    async def start(self) -> "MockerWorker":
+    async def start(self) -> "JaxEngineWorker":
         rt = self.runtime
-        ns = rt.namespace(self.namespace)
-        comp = ns.component(self.component)
-        gen_ep = comp.endpoint("generate")
-
-        # instance id first so the publisher tags events correctly
-        from ..runtime.discovery import new_instance_id
-
         instance_id = new_instance_id()
         self.publisher = KvEventPublisher(
             rt, self.namespace, self.component, worker_id=instance_id
         )
-        self.engine = MockEngine(self.args, kv_event_publisher=self.publisher)
+
+        async def kv_event_sink(stored, removed):
+            if stored:
+                await self.publisher.stored(stored)
+            if removed:
+                await self.publisher.removed(removed)
+
+        self.engine = JaxEngine(self.config, params=self._params,
+                                kv_event_sink=kv_event_sink)
 
         async def generate_handler(payload, ctx):
             request = PreprocessedRequest.from_dict(payload)
-            assert self.engine is not None
             async for out in self.engine.generate(request, token=ctx.token):
                 yield out.to_dict()
 
@@ -79,42 +93,39 @@ class MockerWorker:
             n = await self.engine.clear_kv_blocks()
             yield {"cleared_blocks": n}
 
-        self.served = await gen_ep.serve_endpoint(
+        comp = rt.namespace(self.namespace).component(self.component)
+        self.served = await comp.endpoint("generate").serve_endpoint(
             generate_handler,
-            metadata={"model": self.args.model_name, "role": self.args.role},
+            metadata={"model": self.config.served_name},
             instance_id=instance_id,
         )
         self._aux_served = [
             await comp.endpoint("clear_kv_blocks").serve_endpoint(
-                clear_handler, instance_id=instance_id
-            ),
+                clear_handler, instance_id=instance_id),
             await comp.endpoint("kv_events_replay").serve_endpoint(
-                self.publisher.replay_handler, instance_id=instance_id
-            ),
+                self.publisher.replay_handler, instance_id=instance_id),
         ]
         await register_model(rt, self.card, instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
-        logger.info("mocker worker %d serving model %s",
-                    instance_id, self.args.model_name)
+        logger.info("jax engine worker %d serving %s (tp=%d)",
+                    instance_id, self.config.served_name, self.config.tp)
         return self
 
     async def _load_loop(self) -> None:
-        """Periodic load metrics for least-loaded / KV routing cost inputs."""
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"
         while True:
-            await asyncio.sleep(0.25)
+            await asyncio.sleep(0.5)
             if self.engine is None or self.served is None:
                 continue
             await self.runtime.event_plane.publish(subject, {
                 "worker_id": self.served.instance_id,
                 "active_seqs": self.engine.num_active_seqs,
                 "kv_usage": self.engine.kv_usage(),
-                "kv_total_blocks": self.engine.cache.num_blocks,
+                "kv_total_blocks": self.config.num_blocks,
+                "engine_metrics": dict(self.engine.metrics),
             })
 
     async def close(self) -> None:
-        from ..protocols.model_card import deregister_model
-
         if self._load_task is not None:
             self._load_task.cancel()
         if self.engine is not None:
@@ -122,7 +133,7 @@ class MockerWorker:
         if self.served is not None:
             await deregister_model(self.runtime, self.card,
                                    self.served.instance_id)
-        for served in getattr(self, "_aux_served", []):
+        for served in self._aux_served:
             await served.shutdown()
         if self.served is not None:
             await self.served.shutdown()

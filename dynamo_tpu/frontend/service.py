@@ -54,7 +54,9 @@ class ModelWatcher:
         self.make_route = make_route
         self._cancel = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
-        self._clients: Dict[str, Any] = {}
+        self._clients: Dict[str, Any] = {}        # model name -> client
+        self._key_to_name: Dict[str, str] = {}    # discovery key -> model name
+        self._model_keys: Dict[str, set] = {}     # model name -> live keys
 
     async def start(self) -> "ModelWatcher":
         if self._task is None:
@@ -68,15 +70,19 @@ class ModelWatcher:
             ):
                 try:
                     if ev.type == "put" and ev.value:
-                        await self._add(ModelDeploymentCard.from_dict(ev.value))
+                        await self._add(
+                            ev.key, ModelDeploymentCard.from_dict(ev.value)
+                        )
                     elif ev.type == "delete":
-                        self._remove_by_key(ev.key)
+                        await self._remove_by_key(ev.key)
                 except Exception:
                     logger.exception("model watcher failed applying %s", ev)
         except asyncio.CancelledError:
             pass
 
-    async def _add(self, mdc: ModelDeploymentCard) -> None:
+    async def _add(self, key: str, mdc: ModelDeploymentCard) -> None:
+        self._key_to_name[key] = mdc.name
+        self._model_keys.setdefault(mdc.name, set()).add(key)
         existing = self.manager.models.get(mdc.name)
         if existing is not None:
             if existing.mdc.to_dict() == mdc.to_dict():
@@ -98,24 +104,31 @@ class ModelWatcher:
         if self.make_route is not None:
             route = await self.make_route(mdc, client)
         self.manager.models[mdc.name] = ModelPipeline(mdc, client, route=route)
-        self._clients[mdc.key()] = (client, mdc.name)
+        self._clients[mdc.name] = client
         logger.info("model %s registered (endpoint %s/%s/%s)",
                     mdc.name, mdc.namespace, mdc.component, mdc.endpoint)
 
-    def _remove_by_key(self, key: str) -> None:
-        ent = self._clients.pop(key, None)
-        if ent is None:
+    async def _remove_by_key(self, key: str) -> None:
+        name = self._key_to_name.pop(key, None)
+        if name is None:
             return
-        client, name = ent
+        keys = self._model_keys.get(name)
+        if keys is not None:
+            keys.discard(key)
+            if keys:
+                return  # other workers still serve this model
+        self._model_keys.pop(name, None)
         self.manager.models.pop(name, None)
-        asyncio.ensure_future(client.close())
-        logger.info("model %s deregistered", name)
+        client = self._clients.pop(name, None)
+        if client is not None:
+            await client.close()
+        logger.info("model %s deregistered (last worker gone)", name)
 
     async def close(self) -> None:
         self._cancel.set()
         if self._task is not None:
             self._task.cancel()
-        for client, _name in self._clients.values():
+        for client in self._clients.values():
             await client.close()
 
 

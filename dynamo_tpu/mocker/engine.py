@@ -90,6 +90,14 @@ class MockEngine:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        # terminate in-flight streams instead of leaving consumers hanging
+        err = LLMEngineOutput(finish_reason="error")
+        for seq in self.waiting + self.running:
+            if not seq.finished:
+                seq.finished = True
+                seq.out_queue.put_nowait(err)
+        self.waiting.clear()
+        self.running.clear()
 
     @property
     def num_active_seqs(self) -> int:
@@ -120,23 +128,18 @@ class MockEngine:
         )
         self.waiting.append(seq)
         self._wake.set()
+        from ..runtime.aio import CANCELLED, next_or_cancel
+
         try:
             while True:
-                if token is not None:
-                    get = asyncio.ensure_future(seq.out_queue.get())
-                    stop = asyncio.ensure_future(token.wait_stopped())
-                    done, pending = await asyncio.wait(
-                        {get, stop}, return_when=asyncio.FIRST_COMPLETED
-                    )
-                    for p in pending:
-                        p.cancel()
-                    if get not in done:
-                        self._cancel_seq(seq)
-                        yield LLMEngineOutput(finish_reason="cancelled")
-                        return
-                    item = get.result()
-                else:
-                    item = await seq.out_queue.get()
+                item = await next_or_cancel(
+                    seq.out_queue,
+                    token.stopped_event if token is not None else None,
+                )
+                if item is CANCELLED:
+                    self._cancel_seq(seq)
+                    yield LLMEngineOutput(finish_reason="cancelled")
+                    return
                 yield item
                 if item.finish_reason is not None:
                     return
@@ -145,9 +148,9 @@ class MockEngine:
                 self._cancel_seq(seq)
 
     async def clear_kv_blocks(self) -> int:
-        removed = self.cache.clear()
-        if self.publisher is not None:
-            await self.publisher.cleared()
+        removed = self.cache.clear_cached()
+        if self.publisher is not None and removed:
+            await self.publisher.removed(removed)
         return len(removed)
 
     # -- internals --------------------------------------------------------

@@ -163,12 +163,13 @@ class KvCacheSim:
         self.free_blocks += self._seq_partial.pop(seq_id, 0)
         return out
 
-    def clear(self) -> List[int]:
-        """Drop everything (ref: clear_kv_blocks endpoint)."""
-        removed = list(self._ref.keys())
-        self._ref.clear()
-        self._lru.clear()
-        self._seq_full.clear()
-        self._seq_partial.clear()
-        self.free_blocks = self.num_blocks
+    def clear_cached(self) -> List[int]:
+        """Drop every unreferenced cached block; active sequences keep
+        theirs (ref: clear_kv_blocks endpoint)."""
+        removed: List[int] = []
+        while self._lru:
+            h, _ = self._lru.popitem(last=False)
+            del self._ref[h]
+            self.free_blocks += 1
+            removed.append(h)
         return removed

@@ -1,0 +1,3 @@
+from .llama import LlamaConfig, init_params, PRESETS
+
+__all__ = ["LlamaConfig", "init_params", "PRESETS"]

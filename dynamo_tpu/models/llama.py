@@ -1,0 +1,256 @@
+"""Llama-family decoder as functional JAX code over a paged KV cache.
+
+Covers the dense families in BASELINE.md configs (Llama-3 8B/70B, Qwen3
+dense via qk_norm).  Pure functions over a params pytree — no Module
+framework — so pjit/GSPMD shardings (parallel/mesh.py) and donation apply
+cleanly.  Forward passes read/write KV through the paged cache ops in
+ops/paged_attention.py; everything is static-shape for XLA.
+
+Weights are bf16 by default (MXU-native); activations bf16 with fp32 for
+norms/softmax accumulation.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.paged_attention import (
+    paged_attention_decode,
+    paged_prefill_attention,
+    write_prompt_kv,
+    write_token_kv,
+)
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    name: str = "tiny"
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    head_dim: int = 64
+    ffn_dim: int = 1408
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    qk_norm: bool = False  # Qwen3-style per-head q/k RMSNorm
+    tie_embeddings: bool = False
+    max_context: int = 8192
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+
+PRESETS: Dict[str, LlamaConfig] = {
+    # test-scale
+    "tiny": LlamaConfig(),
+    "tiny-gqa": LlamaConfig(name="tiny-gqa", n_heads=8, n_kv_heads=2),
+    # benchmark-scale (single v5e chip fits ~1-2B bf16 + KV)
+    "llama-1b": LlamaConfig(
+        name="llama-1b", vocab_size=128256, d_model=2048, n_layers=16,
+        n_heads=32, n_kv_heads=8, head_dim=64, ffn_dim=8192,
+        max_context=131072,
+    ),
+    # target configs (multi-chip; shapes from the public architectures)
+    "llama-8b": LlamaConfig(
+        name="llama-8b", vocab_size=128256, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
+        max_context=131072,
+    ),
+    "llama-70b": LlamaConfig(
+        name="llama-70b", vocab_size=128256, d_model=8192, n_layers=80,
+        n_heads=64, n_kv_heads=8, head_dim=128, ffn_dim=28672,
+        max_context=131072,
+    ),
+    "qwen3-32b": LlamaConfig(
+        name="qwen3-32b", vocab_size=151936, d_model=5120, n_layers=64,
+        n_heads=64, n_kv_heads=8, head_dim=128, ffn_dim=25600,
+        qk_norm=True, rope_theta=1000000.0, max_context=40960,
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Random-init parameter pytree (weight loading fills the same tree)."""
+
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else 1.0 / math.sqrt(shape[0])
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            cfg.dtype
+        )
+
+    keys = jax.random.split(key, cfg.n_layers + 3)
+    params: Dict[str, Any] = {
+        "embedding": dense(keys[0], (cfg.vocab_size, cfg.d_model), scale=0.02),
+        "final_norm": {"norm": jnp.ones((cfg.d_model,), jnp.float32)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[1], (cfg.d_model, cfg.vocab_size))
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + i], 8)
+        layer = {
+            "attn_norm": {"norm": jnp.ones((cfg.d_model,), jnp.float32)},
+            "mlp_norm": {"norm": jnp.ones((cfg.d_model,), jnp.float32)},
+            "wq": dense(k[0], (cfg.d_model, cfg.q_dim)),
+            "wk": dense(k[1], (cfg.d_model, cfg.kv_dim)),
+            "wv": dense(k[2], (cfg.d_model, cfg.kv_dim)),
+            "wo": dense(k[3], (cfg.q_dim, cfg.d_model)),
+            "w_gate": dense(k[4], (cfg.d_model, cfg.ffn_dim)),
+            "w_up": dense(k[5], (cfg.d_model, cfg.ffn_dim)),
+            "w_down": dense(k[6], (cfg.ffn_dim, cfg.d_model)),
+        }
+        if cfg.qk_norm:
+            layer["q_norm"] = {"norm": jnp.ones((cfg.head_dim,), jnp.float32)}
+            layer["k_norm"] = {"norm": jnp.ones((cfg.head_dim,), jnp.float32)}
+        layers.append(layer)
+    params["layers"] = layers
+    return params
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding.  x: [..., seq, heads, head_dim], positions: [..., seq]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, half]
+    cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _qkv(layer, cfg: LlamaConfig, x: jax.Array, positions: jax.Array):
+    """x: [..., seq, d_model] -> q [..., seq, nh, hd], k/v [..., seq, nkv, hd]."""
+    *lead, seq, _ = x.shape
+    q = (x @ layer["wq"]).reshape(*lead, seq, cfg.n_heads, cfg.head_dim)
+    k = (x @ layer["wk"]).reshape(*lead, seq, cfg.n_kv_heads, cfg.head_dim)
+    v = (x @ layer["wv"]).reshape(*lead, seq, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, layer["q_norm"]["norm"], cfg.rms_eps)
+        k = rms_norm(k, layer["k_norm"]["norm"], cfg.rms_eps)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp(layer, x: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer[
+        "w_down"
+    ]
+
+
+def _logits(params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
+    x = rms_norm(x, params["final_norm"]["norm"], cfg.rms_eps)
+    if cfg.tie_embeddings:
+        return (x @ params["embedding"].T).astype(jnp.float32)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# prefill: T_new prompt tokens attend to cached context + themselves (causal)
+# ---------------------------------------------------------------------------
+
+
+def prefill(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    kv_cache: Tuple[jax.Array, jax.Array],
+    token_ids: jax.Array,      # [T_pad] int32 (one sequence, padded)
+    positions: jax.Array,      # [T_pad] int32, absolute positions
+    block_table: jax.Array,    # [max_blocks] int32, physical block ids
+    ctx_len: jax.Array,        # scalar int32: tokens already cached (prefix)
+    true_len: jax.Array,       # scalar int32: valid tokens in token_ids
+):
+    """Run the prompt (or a prefill chunk) through the model.
+
+    Supports prefix-cache hits and chunked prefill uniformly: the new tokens
+    attend to `ctx_len` cached tokens (read via the block table) plus
+    themselves causally.  Writes the new tokens' K/V into the paged cache.
+    Returns (logits_at_last_valid [vocab], updated kv_cache).
+    """
+    k_cache, v_cache = kv_cache
+    x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
+        q, k, v = _qkv(layer, cfg, h, positions)
+        k_cache, v_cache = write_prompt_kv(
+            k_cache, v_cache, li, k, v, block_table, ctx_len, true_len
+        )
+        attn = paged_prefill_attention(
+            q, k, v, k_cache, v_cache, li, block_table, ctx_len, true_len
+        )
+        x = x + attn.reshape(x.shape[0], cfg.q_dim) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+    last = jnp.maximum(true_len - 1, 0)
+    logits = _logits(params, cfg, x[last])
+    return logits, (k_cache, v_cache)
+
+
+# ---------------------------------------------------------------------------
+# decode: one token per active slot, batched
+# ---------------------------------------------------------------------------
+
+
+def decode(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    kv_cache: Tuple[jax.Array, jax.Array],
+    token_ids: jax.Array,      # [B] int32, last sampled token per slot
+    positions: jax.Array,      # [B] int32
+    block_tables: jax.Array,   # [B, max_blocks] int32
+    ctx_lens: jax.Array,       # [B] int32, tokens in cache BEFORE this step
+):
+    """One decode step for B slots.  Writes each token's K/V, attends over
+    the paged context, returns (logits [B, vocab], updated kv_cache)."""
+    k_cache, v_cache = kv_cache
+    x = params["embedding"][token_ids].astype(cfg.dtype)  # [B, d]
+    pos1 = positions[:, None]  # [B, 1] for rope
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
+        q, k, v = _qkv(layer, cfg, h[:, None, :], pos1)  # [B,1,nh,hd]
+        k_cache, v_cache = write_token_kv(
+            k_cache, v_cache, li, k[:, 0], v[:, 0], block_tables, ctx_lens
+        )
+        attn = paged_attention_decode(
+            q[:, 0], k_cache, v_cache, li, block_tables, ctx_lens + 1
+        )  # [B, nh, hd]
+        x = x + attn.reshape(x.shape[0], cfg.q_dim) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
+        x = x + _mlp(layer, h)
+    logits = _logits(params, cfg, x)  # [B, vocab]
+    return logits, (k_cache, v_cache)

@@ -1,0 +1,13 @@
+from .paged_attention import (
+    paged_attention_decode,
+    paged_prefill_attention,
+    write_prompt_kv,
+    write_token_kv,
+)
+
+__all__ = [
+    "paged_attention_decode",
+    "paged_prefill_attention",
+    "write_prompt_kv",
+    "write_token_kv",
+]

@@ -1,0 +1,184 @@
+"""Paged KV-cache attention ops.
+
+The TPU replacement for the reference's only first-party GPU kernels
+(lib/kvbm-kernels/cuda/tensor_kernels.cu — block gather/scatter) plus the
+paged attention the reference delegates to vLLM/TRT-LLM.
+
+Cache layout (per tensor): [n_layers, num_blocks, block_size, n_kv_heads,
+head_dim] — block_size*n_kv_heads in the sublane dims and head_dim=lane dim,
+bf16, sharded over tp on the kv_heads axis (parallel/mesh.py:kv_cache_spec).
+
+Conventions:
+  * physical block 0 is the GARBAGE block: inactive slots' writes land there
+    and are never read; allocators hand out ids >= 1.
+  * all shapes are static; sequence validity is carried by ctx_len/true_len
+    scalars and enforced with masks, so XLA compiles one program per bucket.
+
+These are the jnp reference implementations — numerically exact, fully
+fused-able by XLA.  ops/pallas_paged_attention.py provides the hand-tiled
+fast path for decode; the two are interchangeable and cross-checked in
+tests/test_paged_attention.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# cache writes (block scatter)
+# ---------------------------------------------------------------------------
+
+
+def write_prompt_kv(
+    k_cache: jax.Array,  # [L, nblocks, bs, nkv, hd]
+    v_cache: jax.Array,
+    layer: int,
+    k: jax.Array,        # [T, nkv, hd] new tokens' keys
+    v: jax.Array,
+    block_table: jax.Array,  # [max_blocks] int32
+    ctx_len: jax.Array,      # scalar: tokens already in cache
+    true_len: jax.Array,     # scalar: valid entries of k/v
+) -> Tuple[jax.Array, jax.Array]:
+    T = k.shape[0]
+    bs = k_cache.shape[2]
+    pos = ctx_len + jnp.arange(T, dtype=jnp.int32)  # absolute positions
+    blocks = block_table[pos // bs]                 # [T]
+    offsets = pos % bs
+    valid = jnp.arange(T) < true_len
+    # invalid rows scatter to the garbage block
+    blocks = jnp.where(valid, blocks, 0)
+    k_cache = k_cache.at[layer, blocks, offsets].set(
+        k.astype(k_cache.dtype), mode="drop"
+    )
+    v_cache = v_cache.at[layer, blocks, offsets].set(
+        v.astype(v_cache.dtype), mode="drop"
+    )
+    return k_cache, v_cache
+
+
+def write_token_kv(
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    layer: int,
+    k: jax.Array,            # [B, nkv, hd]
+    v: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks]
+    ctx_lens: jax.Array,      # [B] position to write (== current length)
+) -> Tuple[jax.Array, jax.Array]:
+    bs = k_cache.shape[2]
+    B = k.shape[0]
+    blocks = block_tables[jnp.arange(B), ctx_lens // bs]  # [B]
+    offsets = ctx_lens % bs
+    k_cache = k_cache.at[layer, blocks, offsets].set(
+        k.astype(k_cache.dtype), mode="drop"
+    )
+    v_cache = v_cache.at[layer, blocks, offsets].set(
+        v.astype(v_cache.dtype), mode="drop"
+    )
+    return k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# attention reads
+# ---------------------------------------------------------------------------
+
+
+def _gather_ctx(cache: jax.Array, layer: int,
+                block_table: jax.Array) -> jax.Array:
+    """[L,nb,bs,nkv,hd] + [max_blocks] -> [max_blocks*bs, nkv, hd]."""
+    g = cache[layer, block_table]  # [max_blocks, bs, nkv, hd]
+    mb, bs, nkv, hd = g.shape
+    return g.reshape(mb * bs, nkv, hd)
+
+
+def _gqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
+    """q [.., nh, hd] x k [S, nkv, hd] -> scores [.., nh, S] with GQA."""
+    nh = q.shape[-2]
+    nkv = k.shape[-2]
+    group = nh // nkv
+    qg = q.reshape(*q.shape[:-2], nkv, group, q.shape[-1])
+    s = jnp.einsum("...kgh,skh->...kgs", qg.astype(jnp.float32),
+                   k.astype(jnp.float32))
+    return s.reshape(*q.shape[:-2], nh, k.shape[0])
+
+
+def _gqa_out(p: jax.Array, v: jax.Array) -> jax.Array:
+    """p [.., nh, S] x v [S, nkv, hd] -> out [.., nh, hd]."""
+    nh = p.shape[-2]
+    nkv = v.shape[-2]
+    group = nh // nkv
+    pg = p.reshape(*p.shape[:-2], nkv, group, p.shape[-1])
+    o = jnp.einsum("...kgs,skh->...kgh", pg, v.astype(jnp.float32))
+    return o.reshape(*p.shape[:-2], nh, v.shape[-1])
+
+
+def paged_prefill_attention(
+    q: jax.Array,        # [T, nh, hd] (rope applied)
+    k: jax.Array,        # [T, nkv, hd] this chunk's keys
+    v: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    layer: int,
+    block_table: jax.Array,
+    ctx_len: jax.Array,   # cached tokens this chunk attends to
+    true_len: jax.Array,  # valid tokens in the chunk
+) -> jax.Array:
+    """Chunk tokens attend to (cached context) ++ (chunk, causally).
+
+    One code path serves plain prefill (ctx_len=0), prefix-cache hits and
+    chunked prefill (ctx_len>0) — the unified form that lets the engine reuse
+    blocks the router already counted as overlap.
+    """
+    T, nh, hd = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+
+    k_ctx = _gather_ctx(k_cache, layer, block_table)  # [S, nkv, hd]
+    v_ctx = _gather_ctx(v_cache, layer, block_table)
+    S = k_ctx.shape[0]
+
+    s_ctx = _gqa_scores(q, k_ctx) * scale            # [T, nh, S]
+    ctx_mask = (jnp.arange(S) < ctx_len)[None, None, :]
+    s_ctx = jnp.where(ctx_mask, s_ctx, NEG_INF)
+
+    s_self = _gqa_scores(q, k) * scale               # [T, nh, T]
+    i = jnp.arange(T)[:, None, None]
+    j = jnp.arange(T)[None, None, :]
+    causal = (j <= i) & (j < true_len)
+    s_self = jnp.where(causal, s_self, NEG_INF)
+
+    s = jnp.concatenate([s_ctx, s_self], axis=-1)    # [T, nh, S+T]
+    p = jax.nn.softmax(s, axis=-1)
+    out = _gqa_out(p[..., :S], v_ctx) + _gqa_out(p[..., S:], v)
+    return out.astype(q.dtype)
+
+
+def paged_attention_decode(
+    q: jax.Array,            # [B, nh, hd]
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    layer: int,
+    block_tables: jax.Array,  # [B, max_blocks]
+    kv_lens: jax.Array,       # [B] valid tokens (incl. the one just written)
+) -> jax.Array:
+    """Single-token batched paged attention (the decode hot loop)."""
+    B, nh, hd = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+
+    def one(qb, table, kvlen):
+        kb = _gather_ctx(k_cache, layer, table)  # [S, nkv, hd]
+        vb = _gather_ctx(v_cache, layer, table)
+        s = _gqa_scores(qb, kb) * scale          # [nh, S]
+        mask = (jnp.arange(kb.shape[0]) < kvlen)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return _gqa_out(p, vb)                   # [nh, hd]
+
+    out = jax.vmap(one)(q, block_tables, kv_lens)
+    return out.astype(q.dtype)

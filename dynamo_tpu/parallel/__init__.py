@@ -1,0 +1,3 @@
+from .mesh import MeshConfig, make_mesh, param_sharding_rules
+
+__all__ = ["MeshConfig", "make_mesh", "param_sharding_rules"]

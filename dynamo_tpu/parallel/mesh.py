@@ -1,0 +1,99 @@
+"""Device mesh + sharding policy for the JAX engine.
+
+TPU-first design: intra-model parallelism is expressed as NamedSharding over
+a (dp, tp) mesh and compiled by XLA into ICI collectives — the equivalent of
+the engine-internal NCCL TP the reference passes through to vLLM/TRT-LLM
+(SURVEY.md §2.4).  Axes:
+
+  dp — data parallel: replicas of the model, each with its own KV cache and
+       its own routing identity (WorkerWithDpRank in the router).
+  tp — tensor parallel: attention heads / MLP hidden / vocab sharded; KV
+       cache sharded over kv_heads.
+
+Expert parallel ("ep", MoE) reuses the tp axis by default; sequence-parallel
+long-context sharding lives in ops/ring_attention.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if cfg is None:
+        cfg = MeshConfig(dp=1, tp=len(devices))
+    if cfg.num_devices > len(devices):
+        raise ValueError(
+            f"mesh needs {cfg.num_devices} devices, have {len(devices)}"
+        )
+    dev_array = np.array(devices[: cfg.num_devices]).reshape(cfg.dp, cfg.tp)
+    return Mesh(dev_array, axis_names=("dp", "tp"))
+
+
+def param_sharding_rules() -> dict:
+    """Parameter PartitionSpecs by logical name (Llama-family layout).
+
+    Column-parallel projections shard the output feature dim; row-parallel
+    shard the input dim so XLA inserts a psum on the way out — the standard
+    Megatron layout mapped onto GSPMD.
+    """
+    return {
+        "embedding": P("tp", None),        # [vocab, d_model]
+        "wq": P(None, "tp"),               # [d_model, q_heads*hd]
+        "wk": P(None, "tp"),               # [d_model, kv_heads*hd]
+        "wv": P(None, "tp"),
+        "wo": P("tp", None),               # [q_heads*hd, d_model]
+        "w_gate": P(None, "tp"),           # [d_model, ffn]
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),           # [ffn, d_model]
+        "norm": P(None),
+        "lm_head": P(None, "tp"),          # [d_model, vocab]
+        # MoE (expert-sharded over tp)
+        "moe_gate": P(None, None),
+        "moe_w_gate": P("tp", None, None),  # [experts, d_model, ffn]
+        "moe_w_up": P("tp", None, None),
+        "moe_w_down": P("tp", None, None),
+    }
+
+
+def shard_params(params, mesh: Mesh):
+    """Apply the sharding rules to a parameter pytree.
+
+    The rule key is the innermost dict key on the leaf's path (pytree
+    structure — lists of layers etc. — is preserved)."""
+    rules = param_sharding_rules()
+
+    def put(path, leaf):
+        name = None
+        for k in reversed(path):
+            key = getattr(k, "key", None)
+            if isinstance(key, str):
+                name = key
+                break
+        spec = rules.get(name, P())
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(put, params)
+
+
+def kv_cache_spec() -> P:
+    """KV cache [layers, blocks, block_size, kv_heads, head_dim]: shard the
+    kv_heads axis over tp (same split as the attention heads)."""
+    return P(None, None, None, "tp", None)

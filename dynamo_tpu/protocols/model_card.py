@@ -33,8 +33,12 @@ class ModelDeploymentCard:
     migration_limit: int = 0
     runtime_config: Dict[str, Any] = field(default_factory=dict)
 
-    def key(self) -> str:
-        return f"{MDC_PREFIX}/{self.namespace}/{model_slug(self.name)}"
+    def key(self, instance_id: Optional[int] = None) -> str:
+        """MDC discovery key.  Per-worker keys (with instance_id) let many
+        workers serve one model: the frontend drops the model only when the
+        LAST worker's card disappears."""
+        base = f"{MDC_PREFIX}/{self.namespace}/{model_slug(self.name)}"
+        return f"{base}/{instance_id}" if instance_id is not None else base
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -68,10 +72,12 @@ class ModelDeploymentCard:
         )
 
 
-async def register_model(runtime, card: ModelDeploymentCard) -> None:
+async def register_model(runtime, card: ModelDeploymentCard,
+                         instance_id: Optional[int] = None) -> None:
     """Publish the MDC (ref: lib/bindings/python/rust/lib.rs:368 register_model)."""
-    await runtime.discovery.put(card.key(), card.to_dict())
+    await runtime.discovery.put(card.key(instance_id), card.to_dict())
 
 
-async def deregister_model(runtime, card: ModelDeploymentCard) -> None:
-    await runtime.discovery.delete(card.key())
+async def deregister_model(runtime, card: ModelDeploymentCard,
+                           instance_id: Optional[int] = None) -> None:
+    await runtime.discovery.delete(card.key(instance_id))

@@ -28,6 +28,11 @@ class CancellationToken:
     def child(self) -> "CancellationToken":
         return CancellationToken(parent=self)
 
+    @property
+    def stopped_event(self) -> asyncio.Event:
+        """The underlying stop event (for queue-vs-cancel races, aio.py)."""
+        return self._stop
+
     def stop(self) -> None:
         if not self._stop.is_set():
             self._stop.set()

@@ -59,7 +59,9 @@ class ServedEndpoint:
     async def shutdown(self) -> None:
         rt = self.endpoint.runtime
         await rt.discovery.delete(self.instance.key())
-        rt.request_server.deregister_handler(self.endpoint.path)
+        rt.request_server.deregister_handler(
+            self.endpoint.path, self.instance.instance_id
+        )
 
 
 class Endpoint:
@@ -93,7 +95,7 @@ class Endpoint:
             address=address,
             metadata=metadata or {},
         )
-        rt.request_server.register_handler(self.path, handler)
+        rt.request_server.register_handler(self.path, handler, iid)
         await rt.discovery.put(instance.key(), instance.to_dict())
         logger.info("serving endpoint %s as instance %d @ %s",
                     self.path, iid, address)
@@ -179,7 +181,8 @@ class Client:
         self.router.on_dispatch(inst.instance_id)
         try:
             async for item in self.runtime.request_client.stream(
-                inst.address, self.endpoint.path, payload, ctx=ctx, token=token
+                inst.address, self.endpoint.path, payload, ctx=ctx,
+                token=token, instance_id=inst.instance_id,
             ):
                 yield item
         finally:

@@ -45,18 +45,39 @@ class RequestPlaneServer:
                  root_token: Optional[CancellationToken] = None):
         self.host = host
         self.port = port
-        self._handlers: Dict[str, Handler] = {}
+        # path -> instance_id -> handler.  Several instances of one endpoint
+        # can share a process's server; requests carry the target iid.
+        self._handlers: Dict[str, Dict[Optional[int], Handler]] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._root = root_token or CancellationToken()
         self.address: Optional[str] = None
         self._conn_tasks: set[asyncio.Task] = set()
         self._start_lock: Optional[asyncio.Lock] = None
 
-    def register_handler(self, path: str, handler: Handler) -> None:
-        self._handlers[path] = handler
+    def register_handler(self, path: str, handler: Handler,
+                         instance_id: Optional[int] = None) -> None:
+        self._handlers.setdefault(path, {})[instance_id] = handler
 
-    def deregister_handler(self, path: str) -> None:
-        self._handlers.pop(path, None)
+    def deregister_handler(self, path: str,
+                           instance_id: Optional[int] = None) -> None:
+        by_iid = self._handlers.get(path)
+        if by_iid is None:
+            return
+        by_iid.pop(instance_id, None)
+        if not by_iid:
+            self._handlers.pop(path, None)
+
+    def _resolve_handler(self, path: str,
+                         instance_id: Optional[int]) -> Optional[Handler]:
+        by_iid = self._handlers.get(path)
+        if not by_iid:
+            return None
+        h = by_iid.get(instance_id)
+        if h is not None:
+            return h
+        if len(by_iid) == 1:
+            return next(iter(by_iid.values()))
+        return None
 
     async def start(self) -> str:
         if self._start_lock is None:
@@ -132,7 +153,7 @@ class RequestPlaneServer:
                            token: CancellationToken) -> None:
         rid = frame["id"]
         path = frame.get("path", "")
-        handler = self._handlers.get(path)
+        handler = self._resolve_handler(path, frame.get("iid"))
 
         async def send(obj: Dict[str, Any]) -> None:
             async with write_lock:
@@ -225,6 +246,7 @@ class RequestPlaneClient:
         payload: Any,
         ctx: Optional[Dict[str, Any]] = None,
         token: Optional[CancellationToken] = None,
+        instance_id: Optional[int] = None,
     ) -> AsyncIterator[Any]:
         """Issue a request; yields stream items; raises EngineError on remote
         error.  If `token` stops/kills mid-stream, a cancel frame is sent; if
@@ -248,7 +270,7 @@ class RequestPlaneClient:
         try:
             async with conn.write_lock:
                 await write_frame(conn.writer, {
-                    "t": "req", "id": rid, "path": path,
+                    "t": "req", "id": rid, "path": path, "iid": instance_id,
                     "payload": payload, "ctx": ctx or {},
                 })
             cancel_sent = False

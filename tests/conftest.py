@@ -18,6 +18,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# this image's axon TPU plugin prepends itself to jax_platforms regardless of
+# JAX_PLATFORMS; force the CPU backend explicitly for tests
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import asyncio

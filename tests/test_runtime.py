@@ -115,6 +115,32 @@ async def test_direct_routing():
         await client.close()
 
 
+async def test_two_instances_one_process_direct_dispatch():
+    """Two instances of one endpoint in one process must dispatch by
+    instance id, not whoever registered last."""
+    async def ha(payload, ctx):
+        yield {"who": "a"}
+
+    async def hb(payload, ctx):
+        yield {"who": "b"}
+
+    async with fresh_runtime() as rt:
+        ep = rt.namespace("ns").component("w").endpoint("generate")
+        await ep.serve_endpoint(ha, instance_id=1)
+        await ep.serve_endpoint(hb, instance_id=2)
+        client = await ep.client().start()
+        await client.wait_for_instances()
+        for _ in range(50):
+            if len(client.instances) == 2:
+                break
+            await asyncio.sleep(0.02)
+        got_a = [i async for i in client.direct({}, 1)]
+        got_b = [i async for i in client.direct({}, 2)]
+        assert got_a == [{"who": "a"}]
+        assert got_b == [{"who": "b"}]
+        await client.close()
+
+
 async def test_cancellation_stops_stream():
     started = asyncio.Event()
 
