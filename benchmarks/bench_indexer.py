@@ -1,0 +1,66 @@
+"""KV indexer microbenchmark.
+
+Reference claim to compare against: >10M events+requests/s, p99 <10µs
+(lib/kv-router/src/indexer/README.md:5, on its CPU).  Prints events/s,
+matches/s and p99 latency for the Python and C++ indexers.
+"""
+
+import random
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from dynamo_tpu.router.indexer import PyKvIndexer  # noqa: E402
+
+
+def bench(ix, n_workers=16, n_events=20000, blocks_per_event=16,
+          n_queries=20000, query_len=64):
+    rng = random.Random(7)
+    universe = [(i << 70) | (i * 2654435761 + 17) for i in range(50000)]
+
+    batches = []
+    for _ in range(n_events):
+        start = rng.randrange(0, len(universe) - blocks_per_event)
+        batches.append((rng.randrange(n_workers),
+                        universe[start:start + blocks_per_event]))
+    t0 = time.perf_counter()
+    for w, chunk in batches:
+        ix.apply_stored(w, chunk)
+    ev_dt = time.perf_counter() - t0
+    events_per_s = n_events * blocks_per_event / ev_dt
+
+    queries = []
+    for _ in range(n_queries):
+        start = rng.randrange(0, len(universe) - query_len)
+        queries.append(universe[start:start + query_len])
+    lat = []
+    t0 = time.perf_counter()
+    for q in queries:
+        t1 = time.perf_counter()
+        ix.find_matches(q)
+        lat.append(time.perf_counter() - t1)
+    q_dt = time.perf_counter() - t0
+    queries_per_s = n_queries / q_dt
+    p50 = statistics.median(lat) * 1e6
+    p99 = statistics.quantiles(lat, n=100)[98] * 1e6
+    return events_per_s, queries_per_s, p50, p99
+
+
+def main():
+    rows = [("python", PyKvIndexer())]
+    try:
+        from dynamo_tpu.router.native_indexer import NativeKvIndexer
+
+        rows.append(("c++", NativeKvIndexer()))
+    except ImportError:
+        print("(native indexer not built: make -C native)")
+    for name, ix in rows:
+        ev, q, p50, p99 = bench(ix)
+        print(f"{name:7s} events: {ev/1e6:7.2f}M blocks/s   "
+              f"queries: {q/1e3:7.1f}k/s   p50 {p50:6.1f}µs  p99 {p99:6.1f}µs")
+
+
+if __name__ == "__main__":
+    main()

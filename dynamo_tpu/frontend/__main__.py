@@ -13,10 +13,9 @@ def build_args() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("dynamo_tpu.frontend")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
-    # "kv" joins the choices when the KV router lands (M3)
     p.add_argument(
         "--router-mode", default="round_robin",
-        choices=["random", "round_robin", "least_loaded", "p2c"],
+        choices=["random", "round_robin", "least_loaded", "p2c", "kv"],
     )
     p.add_argument("--busy-threshold", type=int, default=None)
     p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)

@@ -19,12 +19,15 @@ from .preprocessor import OpenAIPreprocessor
 
 logger = logging.getLogger(__name__)
 
-MIGRATABLE_MARKERS = ("connection lost", "no handler", "worker draining")
+MIGRATABLE_MARKERS = ("connection lost", "no handler", "worker draining",
+                      "not found")
 
 
-def is_migratable(err: EngineError) -> bool:
+def is_migratable(err: Exception) -> bool:
     """Worker-death errors are retryable on another instance; user
-    cancellations and model errors are not (ref: migration.rs:60-75)."""
+    cancellations and model errors are not (ref: migration.rs:60-75).
+    'not found' covers the pick-vs-lease-expiry race (instance vanished
+    between routing and dispatch)."""
     msg = str(err).lower()
     return any(m in msg for m in MIGRATABLE_MARKERS)
 
@@ -51,39 +54,49 @@ class MigrationOperator:
         attempts = 0
         emitted: list[int] = []
         avoid: set[int] = set()
-        while True:
-            req = request
-            if emitted:
-                req = replace(
-                    request,
-                    token_ids=list(request.token_ids) + emitted,
-                    stop=replace(request.stop,
-                                 max_tokens=request.stop.max_tokens - len(emitted)),
-                )
-            instance_id = None
-            if self.route is not None:
-                instance_id = await self.route(req, avoid=avoid)
-            try:
-                async for item in self.client.generate(
-                    req.to_dict(), instance_id=instance_id, token=token
-                ):
-                    out = LLMEngineOutput.from_dict(item)
-                    emitted.extend(out.token_ids)
-                    yield out
-                return
-            except EngineError as e:
-                if (token is not None and token.is_stopped()):
-                    raise
-                if attempts >= self.migration_limit or not is_migratable(e):
-                    raise
-                attempts += 1
-                if instance_id is not None:
-                    avoid.add(instance_id)
-                logger.warning(
-                    "migrating request %s (attempt %d/%d) after: %s",
-                    request.request_id, attempts, self.migration_limit, e,
-                )
-                await asyncio.sleep(0.05)
+        route = self.route
+        try:
+            while True:
+                req = request
+                if emitted:
+                    req = replace(
+                        request,
+                        token_ids=list(request.token_ids) + emitted,
+                        stop=replace(request.stop,
+                                     max_tokens=request.stop.max_tokens - len(emitted)),
+                    )
+                instance_id = None
+                if route is not None:
+                    instance_id = await route(req, avoid=avoid)
+                try:
+                    first = True
+                    async for item in self.client.generate(
+                        req.to_dict(), instance_id=instance_id, token=token
+                    ):
+                        out = LLMEngineOutput.from_dict(item)
+                        if first and out.token_ids:
+                            first = False
+                            if hasattr(route, "mark_prefill_completed"):
+                                route.mark_prefill_completed(request.request_id)
+                        emitted.extend(out.token_ids)
+                        yield out
+                    return
+                except (EngineError, RuntimeError) as e:
+                    if (token is not None and token.is_stopped()):
+                        raise
+                    if attempts >= self.migration_limit or not is_migratable(e):
+                        raise
+                    attempts += 1
+                    if instance_id is not None:
+                        avoid.add(instance_id)
+                    logger.warning(
+                        "migrating request %s (attempt %d/%d) after: %s",
+                        request.request_id, attempts, self.migration_limit, e,
+                    )
+                    await asyncio.sleep(0.05)
+        finally:
+            if hasattr(route, "complete"):
+                route.complete(request.request_id)
 
 
 @dataclass

@@ -118,16 +118,25 @@ class ModelWatcher:
             if keys:
                 return  # other workers still serve this model
         self._model_keys.pop(name, None)
-        self.manager.models.pop(name, None)
+        pipeline = self.manager.models.pop(name, None)
+        await self._close_route(pipeline)
         client = self._clients.pop(name, None)
         if client is not None:
             await client.close()
         logger.info("model %s deregistered (last worker gone)", name)
 
+    @staticmethod
+    async def _close_route(pipeline) -> None:
+        route = getattr(getattr(pipeline, "migration", None), "route", None)
+        if route is not None and hasattr(route, "close"):
+            await route.close()
+
     async def close(self) -> None:
         self._cancel.set()
         if self._task is not None:
             self._task.cancel()
+        for pipeline in self.manager.models.values():
+            await self._close_route(pipeline)
         for client in self._clients.values():
             await client.close()
 

@@ -1,3 +1,19 @@
 from .events import KvCacheEvent, KvEventPublisher, kv_event_subject
+from .indexer import PyKvIndexer, make_indexer
+from .kv_router import KvRouter, make_kv_route_factory
+from .selector import DefaultWorkerSelector, KvRouterConfig, WorkerState
+from .sequences import ActiveSequences
 
-__all__ = ["KvCacheEvent", "KvEventPublisher", "kv_event_subject"]
+__all__ = [
+    "ActiveSequences",
+    "DefaultWorkerSelector",
+    "KvCacheEvent",
+    "KvEventPublisher",
+    "KvRouter",
+    "KvRouterConfig",
+    "PyKvIndexer",
+    "WorkerState",
+    "kv_event_subject",
+    "make_indexer",
+    "make_kv_route_factory",
+]

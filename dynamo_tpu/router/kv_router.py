@@ -1,0 +1,221 @@
+"""KvRouter: KV-cache-aware instance selection.
+
+Ref: lib/llm/src/kv_router.rs:201 (find_best_match) + kv_router/scheduler.rs.
+Subscribes to the KV event stream and per-worker load metrics, maintains the
+indexer + slot manager, and picks the best worker for each request:
+
+    overlap = indexer.find_matches(request PLHs)       (hot loop #1)
+    logit   = overlap_weight*(blocks-overlap) + active_blocks
+    pick    = argmin / softmax-temperature sample
+
+Event-stream gaps are recovered through the worker's `kv_events_replay`
+endpoint; dead workers (instance delete) are purged from the index.
+Implements the frontend's route-hook protocol: awaitable pick(request, avoid)
+plus completion callbacks for slot accounting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from ..protocols import ModelDeploymentCard, PreprocessedRequest
+from ..runtime import Client, DistributedRuntime
+from ..tokens import compute_block_hashes_for_request
+from .events import KvCacheEvent, kv_event_subject
+from .indexer import make_indexer
+from .selector import DefaultWorkerSelector, KvRouterConfig, WorkerState
+from .sequences import ActiveSequences
+
+logger = logging.getLogger(__name__)
+
+
+class KvRouter:
+    def __init__(self, runtime: DistributedRuntime, namespace: str,
+                 component: str, client: Client,
+                 block_size: int = 64,
+                 config: Optional[KvRouterConfig] = None):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.client = client  # generate-endpoint client (instance discovery)
+        self.block_size = block_size
+        self.indexer = make_indexer()
+        self.selector = DefaultWorkerSelector(config)
+        self.sequences = ActiveSequences()
+        self.states: Dict[int, WorkerState] = {}
+        self._cancel = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+        self._replay_client: Optional[Client] = None
+        self._known_workers: set[int] = set()
+        self._recovering: set[int] = set()   # workers with replay in flight
+        self._recover_tasks: set[asyncio.Task] = set()  # strong refs
+
+    async def start(self) -> "KvRouter":
+        self._tasks = [
+            asyncio.create_task(self._event_loop()),
+            asyncio.create_task(self._load_loop()),
+            asyncio.create_task(self._instance_watch_loop()),
+        ]
+        ep = (self.runtime.namespace(self.namespace)
+              .component(self.component).endpoint("kv_events_replay"))
+        self._replay_client = await ep.client().start()
+        return self
+
+    async def close(self) -> None:
+        self._cancel.set()
+        for t in list(self._tasks) + list(self._recover_tasks):
+            t.cancel()
+        if self._replay_client is not None:
+            await self._replay_client.close()
+        # self.client is owned by the ModelWatcher, not closed here
+
+    # -- event ingestion (hot loop #3 in the reference) --------------------
+    async def _event_loop(self) -> None:
+        subject = kv_event_subject(self.namespace, self.component)
+        try:
+            async for _subj, payload in self.runtime.event_plane.subscribe(
+                subject, cancel=self._cancel
+            ):
+                self._apply_event(KvCacheEvent.from_wire(payload))
+        except asyncio.CancelledError:
+            pass
+
+    def _apply_event(self, ev: KvCacheEvent) -> None:
+        last = self.indexer.last_event_id.get(ev.worker_id)
+        if (last is not None and ev.event_id > last + 1
+                and ev.worker_id not in self._recovering):
+            # missed events: recover from the worker's ring buffer (hold a
+            # strong task ref — the loop only keeps weak ones)
+            self._recovering.add(ev.worker_id)
+            task = asyncio.ensure_future(self._recover(ev.worker_id, last + 1))
+            self._recover_tasks.add(task)
+            task.add_done_callback(self._recover_tasks.discard)
+        self.indexer.last_event_id[ev.worker_id] = max(
+            ev.event_id, last if last is not None else -1
+        )
+        if ev.op == "stored":
+            self.indexer.apply_stored(ev.worker_id, ev.block_hashes)
+        elif ev.op == "removed":
+            self.indexer.apply_removed(ev.worker_id, ev.block_hashes)
+        elif ev.op == "cleared":
+            self.indexer.clear_worker(ev.worker_id)
+
+    async def _recover(self, worker_id: int, since: int) -> None:
+        if self._replay_client is None:
+            self._recovering.discard(worker_id)
+            return
+        try:
+            async for wire_ev in self._replay_client.generate(
+                {"since_event_id": since}, instance_id=worker_id
+            ):
+                ev = KvCacheEvent.from_wire(wire_ev)
+                if ev.op == "stored":
+                    self.indexer.apply_stored(ev.worker_id, ev.block_hashes)
+                elif ev.op == "removed":
+                    self.indexer.apply_removed(ev.worker_id, ev.block_hashes)
+            logger.info("recovered kv events for worker %d since %d",
+                        worker_id, since)
+        except Exception:
+            logger.warning("kv event recovery failed for worker %d; "
+                           "dropping its index", worker_id, exc_info=True)
+            self.indexer.remove_worker(worker_id)
+        finally:
+            self._recovering.discard(worker_id)
+
+    async def _load_loop(self) -> None:
+        subject = f"load_metrics.{self.namespace}.{self.component}"
+        try:
+            async for _subj, payload in self.runtime.event_plane.subscribe(
+                subject, cancel=self._cancel
+            ):
+                w = payload.get("worker_id")
+                if w is None:
+                    continue
+                st = self.states.setdefault(w, WorkerState())
+                st.kv_usage = payload.get("kv_usage", 0.0)
+                st.kv_total_blocks = payload.get("kv_total_blocks", 0)
+        except asyncio.CancelledError:
+            pass
+
+    async def _instance_watch_loop(self) -> None:
+        """Purge dead workers from the index when their lease disappears."""
+        ticks = 0
+        try:
+            while not self._cancel.is_set():
+                await asyncio.sleep(0.5)
+                ticks += 1
+                if ticks % 60 == 0:  # crashed-client slot bookkeeping reaper
+                    reaped = self.sequences.reap_stale()
+                    if reaped:
+                        logger.info("reaped %d stale routed requests", reaped)
+                live = set(self.client.instance_ids)
+                if not live and not self._known_workers:
+                    continue
+                for gone in self._known_workers - live:
+                    logger.info("worker %d gone; purging from KV index", gone)
+                    self.indexer.remove_worker(gone)
+                    self.sequences.remove_worker(gone)
+                    self.states.pop(gone, None)
+                self._known_workers = live
+        except asyncio.CancelledError:
+            pass
+
+    # -- routing (route-hook protocol for MigrationOperator) ---------------
+    async def __call__(self, request: PreprocessedRequest,
+                       avoid: Optional[set] = None) -> Optional[int]:
+        return await self.pick(request, avoid=avoid)
+
+    async def pick(self, request: PreprocessedRequest,
+                   avoid: Optional[set] = None) -> Optional[int]:
+        workers = self.client.instance_ids
+        if not workers:
+            await self.client.wait_for_instances()
+            workers = self.client.instance_ids
+        hashes = compute_block_hashes_for_request(
+            request.token_ids, self.block_size, lora_name=request.lora_name
+        )
+        overlaps = self.indexer.find_matches(hashes)
+        request_blocks = (len(request.token_ids) + self.block_size - 1) \
+            // self.block_size
+        # refresh decode-load estimates from the slot manager
+        for w in workers:
+            st = self.states.setdefault(w, WorkerState())
+            st.active_blocks = self.sequences.active_blocks(w)
+        choice = self.selector.select(
+            workers, request_blocks, overlaps, self.states, avoid=avoid
+        )
+        if choice is not None:
+            self.sequences.add_request(
+                request.request_id, choice,
+                request_blocks + (request.stop.max_tokens
+                                  // self.block_size),
+                overlaps.get(choice, 0),
+            )
+        return choice
+
+    def mark_prefill_completed(self, request_id: str) -> None:
+        self.sequences.mark_prefill_completed(request_id)
+
+    def complete(self, request_id: str) -> None:
+        self.sequences.free(request_id)
+
+
+def make_kv_route_factory(runtime: DistributedRuntime, *,
+                          overlap_score_weight: float = 1.0,
+                          temperature: float = 0.0):
+    """Frontend hook: build one KvRouter per discovered model."""
+
+    async def factory(mdc: ModelDeploymentCard, client: Client) -> KvRouter:
+        router = KvRouter(
+            runtime, mdc.namespace, mdc.component, client,
+            block_size=mdc.kv_cache_block_size,
+            config=KvRouterConfig(
+                overlap_score_weight=overlap_score_weight,
+                temperature=temperature,
+            ),
+        )
+        return await router.start()
+
+    return factory

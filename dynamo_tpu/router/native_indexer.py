@@ -1,0 +1,112 @@
+"""ctypes binding for the C++ KV indexer (native/indexer.cc).
+
+Same interface as indexer.PyKvIndexer; `make_indexer()` prefers this when
+the shared library is built (`make -C native`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Sequence
+
+_LIB_ENV = "DYN_NATIVE_LIB"
+
+
+def _find_lib() -> str:
+    cand = [os.environ.get(_LIB_ENV, "")]
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(os.path.dirname(here))
+    cand.append(os.path.join(root, "native", "libdynamo_native.so"))
+    for c in cand:
+        if c and os.path.exists(c):
+            return c
+    raise ImportError("libdynamo_native.so not built (make -C native)")
+
+
+_lib = ctypes.CDLL(_find_lib())
+_lib.kvi_new.restype = ctypes.c_void_p
+_lib.kvi_free.argtypes = [ctypes.c_void_p]
+_lib.kvi_apply_stored.argtypes = [
+    ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int,
+]
+_lib.kvi_apply_removed.argtypes = _lib.kvi_apply_stored.argtypes
+_lib.kvi_remove_worker.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+_lib.kvi_find_matches.restype = ctypes.c_int
+_lib.kvi_find_matches.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int,
+]
+_lib.kvi_num_blocks.restype = ctypes.c_uint64
+_lib.kvi_num_blocks.argtypes = [ctypes.c_void_p]
+_lib.kvi_worker_block_count.restype = ctypes.c_int64
+_lib.kvi_worker_block_count.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+
+def _pack(hashes: Sequence[int]):
+    """128-bit ints -> contiguous u64 pairs.  Byte order doesn't matter as
+    long as it's consistent (the C++ side only hashes/compares keys), so one
+    to_bytes per hash + a buffer copy beats per-word shifting."""
+    n = len(hashes)
+    buf = b"".join(h.to_bytes(16, "big") for h in hashes)
+    arr = (ctypes.c_uint64 * (2 * n)).from_buffer_copy(buf)
+    return arr, n
+
+
+class NativeKvIndexer:
+    MAX_MATCH_WORKERS = 1024
+
+    def __init__(self) -> None:
+        self._ptr = _lib.kvi_new()
+        self._workers: set[int] = set()
+        self.last_event_id: Dict[int, int] = {}
+        self._out_w = (ctypes.c_int64 * self.MAX_MATCH_WORKERS)()
+        self._out_o = (ctypes.c_int32 * self.MAX_MATCH_WORKERS)()
+
+    def __del__(self) -> None:
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            _lib.kvi_free(ptr)
+            self._ptr = None
+
+    def apply_stored(self, worker_id: int, hashes: Sequence[int]) -> None:
+        if not hashes:
+            return
+        arr, n = _pack(hashes)
+        _lib.kvi_apply_stored(self._ptr, worker_id, arr, n)
+        self._workers.add(worker_id)
+
+    def apply_removed(self, worker_id: int, hashes: Sequence[int]) -> None:
+        if not hashes:
+            return
+        arr, n = _pack(hashes)
+        _lib.kvi_apply_removed(self._ptr, worker_id, arr, n)
+
+    def remove_worker(self, worker_id: int) -> None:
+        _lib.kvi_remove_worker(self._ptr, worker_id)
+        self._workers.discard(worker_id)
+        self.last_event_id.pop(worker_id, None)
+
+    def clear_worker(self, worker_id: int) -> None:
+        _lib.kvi_remove_worker(self._ptr, worker_id)
+
+    def find_matches(self, hashes: Sequence[int]) -> Dict[int, int]:
+        if not hashes:
+            return {}
+        arr, n = _pack(hashes)
+        out_w, out_o = self._out_w, self._out_o
+        k = _lib.kvi_find_matches(self._ptr, arr, n, out_w, out_o,
+                                  self.MAX_MATCH_WORKERS)
+        return {out_w[i]: out_o[i] for i in range(k) if out_o[i] > 0}
+
+    def worker_block_count(self, worker_id: int) -> int:
+        return int(_lib.kvi_worker_block_count(self._ptr, worker_id))
+
+    @property
+    def num_blocks(self) -> int:
+        return int(_lib.kvi_num_blocks(self._ptr))
+
+    @property
+    def workers(self) -> List[int]:
+        return list(self._workers)

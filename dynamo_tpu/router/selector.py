@@ -1,0 +1,76 @@
+"""Worker selection cost function.
+
+Ref: lib/kv-router/src/scheduling/selector.rs:100-265 (DefaultWorkerSelector)
+and docs/design-docs/router-design.md:58-75.  Cost per worker:
+
+    logit = overlap_weight * prefill_cost + decode_cost
+    prefill_cost = request_blocks - overlap_blocks        (blocks to compute)
+    decode_cost  = potential_active_blocks                (load on the worker)
+
+Lower is better.  temperature == 0 picks argmin (deterministic); > 0 samples
+from softmax(-logit / temperature), spreading hot prefixes across replicas.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+
+@dataclass
+class KvRouterConfig:
+    overlap_score_weight: float = 1.0
+    temperature: float = 0.0
+    # workers above this KV utilization are deprioritized hard
+    busy_kv_threshold: float = 0.95
+
+
+@dataclass
+class WorkerState:
+    active_blocks: float = 0.0   # slot-manager estimate of decode load
+    kv_usage: float = 0.0        # from load_metrics events
+    kv_total_blocks: int = 0
+
+
+class DefaultWorkerSelector:
+    def __init__(self, config: Optional[KvRouterConfig] = None):
+        self.config = config or KvRouterConfig()
+        self._rng = random.Random(0xD1A)
+
+    def select(
+        self,
+        workers: Sequence[int],
+        request_blocks: int,
+        overlaps: Dict[int, int],
+        states: Dict[int, "WorkerState"],
+        avoid: Optional[set] = None,
+    ) -> Optional[int]:
+        cfg = self.config
+        candidates = [w for w in workers if not avoid or w not in avoid]
+        if not candidates:
+            candidates = list(workers)
+        if not candidates:
+            return None
+        logits = {}
+        for w in candidates:
+            overlap = overlaps.get(w, 0)
+            st = states.get(w) or WorkerState()
+            prefill_cost = max(0, request_blocks - overlap)
+            decode_cost = st.active_blocks
+            logit = cfg.overlap_score_weight * prefill_cost + decode_cost
+            if st.kv_usage >= cfg.busy_kv_threshold:
+                logit += 1e6  # effectively last resort
+            logits[w] = logit
+
+        if cfg.temperature <= 0.0:
+            best = min(logits.values())
+            ties = [w for w, l in logits.items() if l == best]
+            return self._rng.choice(ties)
+        # softmax over -logit/T
+        mn = min(logits.values())
+        weights = [
+            math.exp(-(logits[w] - mn) / cfg.temperature) for w in candidates
+        ]
+        return self._rng.choices(candidates, weights=weights, k=1)[0]

@@ -1,0 +1,245 @@
+"""KV router tests: indexer semantics (Python + C++ cross-check), selector,
+slot manager, and KV-routing e2e against mocker workers."""
+
+import asyncio
+import os
+import random
+import uuid
+
+import pytest
+
+from dynamo_tpu.router.indexer import PyKvIndexer
+from dynamo_tpu.router.selector import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+    WorkerState,
+)
+from dynamo_tpu.router.sequences import ActiveSequences
+
+
+def H(i: int) -> int:
+    return (i << 70) | (i * 2654435761 + 17)
+
+
+def make_indexers():
+    out = [PyKvIndexer()]
+    try:
+        from dynamo_tpu.router.native_indexer import NativeKvIndexer
+
+        out.append(NativeKvIndexer())
+    except ImportError:
+        pass
+    return out
+
+
+def test_native_indexer_available():
+    """The C++ indexer must be built in this repo (make -C native)."""
+    from dynamo_tpu.router.native_indexer import NativeKvIndexer  # noqa: F401
+
+
+def test_indexer_semantics_match():
+    """Python and C++ indexers agree on randomized event sequences."""
+    indexers = make_indexers()
+    assert len(indexers) == 2, "native indexer missing"
+    rng = random.Random(42)
+    workers = [11, 22, 33, 44]
+    universe = [H(i) for i in range(200)]
+    for step in range(300):
+        op = rng.random()
+        w = rng.choice(workers)
+        if op < 0.6:
+            start = rng.randrange(0, 150)
+            chunk = universe[start : start + rng.randrange(1, 20)]
+            for ix in indexers:
+                ix.apply_stored(w, chunk)
+        elif op < 0.9:
+            start = rng.randrange(0, 180)
+            chunk = universe[start : start + rng.randrange(1, 10)]
+            for ix in indexers:
+                ix.apply_removed(w, chunk)
+        else:
+            for ix in indexers:
+                ix.remove_worker(w)
+        if step % 10 == 0:
+            q_start = rng.randrange(0, 100)
+            q = universe[q_start : q_start + rng.randrange(1, 40)]
+            results = [ix.find_matches(q) for ix in indexers]
+            assert results[0] == results[1], f"divergence at step {step}"
+    assert indexers[0].num_blocks == indexers[1].num_blocks
+
+
+def test_indexer_prefix_walk():
+    ix = PyKvIndexer()
+    hs = [H(i) for i in range(8)]
+    ix.apply_stored(1, hs[:6])
+    ix.apply_stored(2, hs[:3])
+    ix.apply_stored(3, hs[2:5])  # no prefix from 0 -> no overlap
+    m = ix.find_matches(hs)
+    assert m == {1: 6, 2: 3}
+    # a hole stops everyone
+    ix.apply_removed(1, [hs[1]])
+    m = ix.find_matches(hs)
+    assert m == {1: 1, 2: 3}
+
+
+def test_selector_prefers_overlap_and_load():
+    sel = DefaultWorkerSelector(KvRouterConfig(temperature=0.0))
+    states = {1: WorkerState(active_blocks=0), 2: WorkerState(active_blocks=0)}
+    # worker 2 has 8 of 10 blocks cached -> cheaper
+    assert sel.select([1, 2], 10, {2: 8}, states) == 2
+    # ...unless it's heavily loaded
+    states[2].active_blocks = 100
+    assert sel.select([1, 2], 10, {2: 8}, states) == 1
+    # avoid set wins over cost
+    assert sel.select([1, 2], 10, {2: 8}, states, avoid={1}) == 2
+    # busy-KV threshold pushes a worker to last resort
+    states[2].active_blocks = 0
+    states[2].kv_usage = 0.99
+    assert sel.select([1, 2], 10, {2: 8}, states) == 1
+
+
+def test_active_sequences_accounting():
+    from dynamo_tpu.router.sequences import PREFILL_WEIGHT as W
+
+    seqs = ActiveSequences()
+    seqs.add_request("r1", 1, blocks=10, overlap_blocks=4)
+    seqs.add_request("r2", 1, blocks=5, overlap_blocks=0)
+    seqs.add_request("r3", 2, blocks=7, overlap_blocks=7)
+    # worker 1: decode 15, pending prefill 6+5; worker 2: full overlap
+    assert seqs.active_blocks(1) == 15 + W * 11
+    assert seqs.active_blocks(2) == 7
+    assert seqs.active_requests(1) == 2
+    # prefill completion drops the prefill charge, keeps the KV charge
+    seqs.mark_prefill_completed("r1")
+    assert seqs.active_blocks(1) == 15 + W * 5
+    seqs.mark_prefill_completed("r1")  # idempotent
+    assert seqs.active_blocks(1) == 15 + W * 5
+    seqs.free("r1")
+    assert seqs.active_blocks(1) == 5 + W * 5
+    seqs.free("r2")  # freed before prefill done: both charges released
+    assert seqs.active_blocks(1) == 0
+    seqs.remove_worker(2)
+    assert seqs.active_blocks(2) == 0
+    assert seqs.active_requests() == 0
+
+
+# ---------------------------------------------------------------------------
+# e2e: KV-aware routing across mocker workers
+# ---------------------------------------------------------------------------
+
+
+async def test_kv_routing_e2e_prefers_warm_worker():
+    """Warm a prefix on one worker; KV-routed repeats must go there."""
+    from dynamo_tpu.frontend import ModelManager, ModelWatcher
+    from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+    from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.router.kv_router import make_kv_route_factory
+    from dynamo_tpu.runtime import (
+        DistributedRuntime,
+        RouterMode,
+        RuntimeConfig,
+    )
+
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex
+    ).start()
+    args = MockEngineArgs(model_name="m", block_size=4, base_step_s=0.0005,
+                          prefill_s_per_token=0.0, decode_s_per_seq=0.0)
+    w1 = await MockerWorker(rt, args).start()
+    w2 = await MockerWorker(rt, args).start()
+
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        rt, manager, router_mode=RouterMode.KV,
+        make_route=make_kv_route_factory(rt),
+    ).start()
+    for _ in range(100):
+        if manager.get("m"):
+            break
+        await asyncio.sleep(0.02)
+    pipeline = manager.get("m")
+    await pipeline.client.wait_for_instances()
+    for _ in range(100):
+        if len(pipeline.client.instances) == 2:
+            break
+        await asyncio.sleep(0.02)
+
+    prompt = list(range(40))  # 10 blocks
+
+    def req(rid):
+        return PreprocessedRequest(
+            token_ids=prompt, request_id=rid,
+            stop=StopConditions(max_tokens=2, ignore_eos=True),
+        )
+
+    # warm worker 1 directly
+    async for _ in pipeline.client.generate(
+        req("warm").to_dict(), instance_id=w1.served.instance_id
+    ):
+        pass
+    # let the KV events land in the router's indexer
+    router = pipeline.migration.route
+    for _ in range(100):
+        if router.indexer.worker_block_count(w1.served.instance_id) >= 10:
+            break
+        await asyncio.sleep(0.02)
+    assert router.indexer.worker_block_count(w1.served.instance_id) >= 10
+
+    # KV-routed requests with the same prefix must pick the warm worker
+    for i in range(4):
+        picked = await router.pick(req(f"route{i}"))
+        router.complete(f"route{i}")
+        assert picked == w1.served.instance_id
+
+    # a totally different prompt should balance by load, not stick to w1
+    cold = PreprocessedRequest(
+        token_ids=list(range(500, 540)), request_id="cold",
+        stop=StopConditions(max_tokens=2, ignore_eos=True),
+    )
+    # load w1 with fake in-flight requests
+    for i in range(4):
+        router.sequences.add_request(f"fake{i}", w1.served.instance_id, 20, 0)
+    picked = await router.pick(cold)
+    assert picked == w2.served.instance_id
+
+    await watcher.close()
+    await w1.close()
+    await w2.close()
+    await rt.shutdown()
+
+
+async def test_kv_router_event_gap_recovery():
+    """Drop an event on the floor; the router recovers via replay endpoint."""
+    from dynamo_tpu.protocols import PreprocessedRequest
+    from dynamo_tpu.router.events import KvEventPublisher
+    from dynamo_tpu.router.kv_router import KvRouter
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex
+    ).start()
+    comp = rt.namespace("ns").component("w")
+    pub = KvEventPublisher(rt, "ns", "w", worker_id=7)
+    await comp.endpoint("kv_events_replay").serve_endpoint(
+        pub.replay_handler, instance_id=7
+    )
+    gen_client = await comp.endpoint("generate").client().start()
+    router = await KvRouter(rt, "ns", "w", gen_client, block_size=4).start()
+    await asyncio.sleep(0.05)
+
+    hs = [H(i) for i in range(10)]
+    await pub.stored(hs[:3])          # event 0: delivered
+    ev1 = pub._mk("stored", hs[3:6], None, "g1")  # event 1: NOT published
+    await pub.stored(hs[6:10])        # event 2: delivered -> gap detected
+    for _ in range(100):
+        if router.indexer.worker_block_count(7) >= 10:
+            break
+        await asyncio.sleep(0.02)
+    assert router.indexer.worker_block_count(7) == 10
+    m = router.indexer.find_matches(hs)
+    assert m == {7: 10}
+
+    await router.close()
+    await rt.shutdown()
