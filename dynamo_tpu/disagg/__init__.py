@@ -1,0 +1,10 @@
+from .prefill_router import ConditionalDisaggConfig, PrefillOrchestrator
+from .transfer import KvBlockPayload, deserialize_kv, serialize_kv
+
+__all__ = [
+    "ConditionalDisaggConfig",
+    "KvBlockPayload",
+    "PrefillOrchestrator",
+    "deserialize_kv",
+    "serialize_kv",
+]

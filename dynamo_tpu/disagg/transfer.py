@@ -1,0 +1,96 @@
+"""KV-block transfer: the TPU-native replacement for NIXL.
+
+Reference model (docs/design-docs/kvbm-design.md:171-230, disagg-serving.md:
+17-21): prefill and decode exchange *serialized layout metadata* plus the
+block payload; the decode side owns the pull.  On GPU the payload moves
+VRAM→VRAM over UCX/NVLink/IB.  Here the transfer rides the request plane as
+a host-staged stream (device→host→TCP→host→device) with an explicit layout
+header — correct on any topology.  On multi-slice TPU deployments the same
+protocol carries only metadata and the payload path is swapped for ICI/DCN
+device-to-device transfer (jax transfer server / collective_permute); the
+host-staged path remains the DCN fallback.
+
+Resharding falls out of the design: payloads are *logical* blocks
+[layers, n_blocks, block_size, kv_heads, head_dim] gathered to host from
+whatever tp-sharding the prefill engine used, and re-sharded on inject by
+the decode engine's GSPMD layout — prefill TP ≠ decode TP needs no special
+case (the reference calls this out as a headline feature).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+try:
+    import ml_dtypes  # jax dependency; provides numpy bfloat16
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+_DTYPES = {"float32": np.float32, "float16": np.float16}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        if _BF16 is None:
+            raise ValueError("bfloat16 payload needs ml_dtypes")
+        return _BF16
+    return np.dtype(_DTYPES[name])
+
+
+@dataclass
+class KvBlockPayload:
+    """One chunk of KV blocks with its layout header."""
+
+    k: np.ndarray  # [layers, n_blocks, block_size, kv_heads, head_dim]
+    v: np.ndarray
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+
+def serialize_kv(k: np.ndarray, v: np.ndarray) -> Dict[str, Any]:
+    """Payload → wire dict (msgpack-safe: bytes + plain lists)."""
+    assert k.shape == v.shape
+    return {
+        "shape": list(k.shape),
+        "dtype": k.dtype.name,
+        "k": k.tobytes(),
+        "v": v.tobytes(),
+    }
+
+
+def deserialize_kv(wire: Dict[str, Any]) -> KvBlockPayload:
+    shape = tuple(wire["shape"])
+    dt = _np_dtype(wire["dtype"])
+    k = np.frombuffer(wire["k"], dtype=dt).reshape(shape)
+    v = np.frombuffer(wire["v"], dtype=dt).reshape(shape)
+    return KvBlockPayload(k=k, v=v)
+
+
+def make_transfer_params(
+    *,
+    instance_id: int,
+    request_id: str,
+    prompt_len: int,
+    first_token: int,
+    block_size: int,
+    num_layers: int,
+    engine: str = "jax",
+) -> Dict[str, Any]:
+    """kv_transfer_params attached to the prefill response (the analogue of
+    vLLM's NIXL block-id metadata / TRT-LLM's opaque_state,
+    disagg-serving.md:53-61)."""
+    return {
+        "engine": engine,
+        "instance_id": instance_id,
+        "request_id": request_id,
+        "prompt_len": prompt_len,
+        "first_token": first_token,
+        "block_size": block_size,
+        "num_layers": num_layers,
+    }

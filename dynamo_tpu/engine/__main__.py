@@ -27,6 +27,8 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--no-prefix-caching", action="store_true")
     p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--role", default="both",
+                   choices=["both", "prefill", "decode"])
     return p
 
 
@@ -43,6 +45,7 @@ async def main() -> None:
         tp=args.tp,
         dp=args.dp,
         enable_prefix_caching=not args.no_prefix_caching,
+        role=args.role,
     )
     rt = await DistributedRuntime.detached().start()
     worker = await JaxEngineWorker(

@@ -29,6 +29,10 @@ class EngineConfig:
     dp: int = 1
     tp: int = 1
 
+    # disaggregation role: "both" serves agg traffic; "prefill" workers run
+    # prefill-only hops and park KV; "decode" workers pull and decode
+    role: str = "both"
+
     eos_token_id: int = 2
     seed: int = 0
 

@@ -65,19 +65,38 @@ class _Slot:
     cached_tokens: int = 0   # prefix-cache reuse (for metrics)
     enqueued_t: float = 0.0
     first_token_t: float = 0.0
+    # disaggregation
+    disagg_prefill: bool = False       # prefill-only; park KV for pulling
+    preloaded_k: Optional[np.ndarray] = None  # [L, nblk, bs, nkv, hd]
+    preloaded_v: Optional[np.ndarray] = None
+    preloaded_first_token: Optional[int] = None
+
+
+@dataclass
+class _Parked:
+    """A finished disagg prefill whose KV awaits pulling by decode."""
+
+    seq_id: str
+    block_ids: list
+    prompt_len: int
+    expires_t: float
 
 
 class JaxEngine:
     def __init__(self, config: EngineConfig, params=None, mesh=None,
-                 kv_event_sink=None):
-        """kv_event_sink: optional callable(stored: list[int], removed: list[int])
-        -> awaitable, invoked with PLH batches as the cache mutates."""
+                 kv_event_sink=None, kv_pull_fn=None):
+        """kv_event_sink: optional callable(stored, removed) -> awaitable,
+        invoked with PLH batches as the cache mutates.
+        kv_pull_fn: optional async callable(disaggregated_params) ->
+        (k, v, prompt_len) pulling a remote prefill's KV blocks (set by the
+        worker; the engine stays transport-agnostic)."""
         self.config = config
         self.model_cfg = config.resolve_model()
         self.mesh = mesh if mesh is not None else make_mesh(
             MeshConfig(dp=config.dp, tp=config.tp)
         )
         self.kv_event_sink = kv_event_sink
+        self.kv_pull_fn = kv_pull_fn
         self.allocator = BlockAllocator(
             config.num_blocks, config.enable_prefix_caching
         )
@@ -96,9 +115,14 @@ class JaxEngine:
         self._jit_prefill = jax.jit(
             partial(self._prefill_impl, self.model_cfg), donate_argnums=(1,)
         )
+        self._jit_inject = jax.jit(self._inject_impl, donate_argnums=(0,))
 
         self.waiting: List[_Slot] = []
-        self._clear_requests: List[asyncio.Future] = []
+        self._sched_calls: List[tuple] = []  # (fn, future) run between steps
+        self._parked: Dict[str, _Parked] = {}
+        self.parked_ttl_s = 120.0
+        # identity advertised in kv_transfer_params (set by the worker)
+        self.transfer_identity: Dict[str, Any] = {}
         self._qlock = threading.Lock()  # guards `waiting` across threads
         self._slots: List[Optional[_Slot]] = [None] * config.max_num_seqs
         self._wake = asyncio.Event()
@@ -131,6 +155,15 @@ class JaxEngine:
         )
         next_tokens = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
         return next_tokens, kv
+
+    @staticmethod
+    def _inject_impl(kv, kb, vb, ids):
+        """Scatter pulled KV blocks into the cache (ids padded with 0 write
+        harmlessly into the garbage block)."""
+        k, v = kv
+        k = k.at[:, ids].set(kb.astype(k.dtype))
+        v = v.at[:, ids].set(vb.astype(v.dtype))
+        return (k, v)
 
     @staticmethod
     def _prefill_impl(model_cfg, params, kv, tokens, positions, block_table,
@@ -186,6 +219,19 @@ class JaxEngine:
         if len(request.token_ids) >= self.config.max_context:
             yield LLMEngineOutput(finish_reason="error")
             return
+        preloaded = None
+        dp = request.disaggregated_params
+        if dp is not None and dp.get("engine") == "jax":
+            if self.kv_pull_fn is None:
+                logger.warning("disaggregated_params but no kv_pull_fn; "
+                               "falling back to local prefill")
+            else:
+                try:
+                    preloaded = await self.kv_pull_fn(dp)
+                except Exception:
+                    logger.warning("KV pull failed for %s; local prefill "
+                                   "fallback", request.request_id,
+                                   exc_info=True)
         slot = _Slot(
             index=-1,
             request=request,
@@ -202,6 +248,12 @@ class JaxEngine:
             ),
             enqueued_t=time.monotonic(),
         )
+        from ..protocols.llm import DISAGG_ANNOTATION
+
+        slot.disagg_prefill = DISAGG_ANNOTATION in (request.annotations or [])
+        if preloaded is not None:
+            slot.preloaded_k, slot.preloaded_v, _plen = preloaded
+            slot.preloaded_first_token = dp.get("first_token")
         with self._qlock:
             self.waiting.append(slot)
         self._wake.set()
@@ -253,43 +305,105 @@ class JaxEngine:
             coro = self.kv_event_sink(list(stored), list(removed))
             self._loop_ref.call_soon_threadsafe(asyncio.ensure_future, coro)
 
-    async def clear_kv_blocks(self) -> int:
-        """Drop the reusable prefix cache (active sequences keep their
-        blocks).  Runs on the scheduler thread to avoid racing it."""
+    def _call_on_scheduler(self, fn) -> asyncio.Future:
+        """Run `fn()` between scheduler steps (the allocator and KV cache are
+        owned by the scheduler; cross-thread access would race donation)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._clear_requests.append(fut)
+        self._sched_calls.append((fn, fut))
         self._wake.set()
-        # if the scheduler loop is idle/unstarted, serve immediately
-        if self._task is None:
-            self._do_clear()
-        removed = await fut
+        if self._task is None or self._task.done():
+            # no live loop to drain for us (unstarted, crashed, or closed)
+            self._drain_sched_calls()
+        return fut
+
+    def _drain_sched_calls(self) -> None:
+        while self._sched_calls:
+            fn, fut = self._sched_calls.pop(0)
+            try:
+                result = fn()
+            except Exception as e:  # surface to the caller
+                err = e
+
+                def set_exc(f=fut, err=err):
+                    if not f.done():
+                        f.set_exception(err)
+
+                if self._loop_ref is not None:
+                    self._loop_ref.call_soon_threadsafe(set_exc)
+                else:
+                    set_exc()
+            else:
+                if self._loop_ref is not None:
+                    self._loop_ref.call_soon_threadsafe(
+                        _set_result_safe, fut, result
+                    )
+                else:
+                    _set_result_safe(fut, result)
+
+    async def clear_kv_blocks(self) -> int:
+        """Drop the reusable prefix cache (active sequences keep theirs)."""
+        removed = await self._call_on_scheduler(self.allocator.clear_cached)
         if self.kv_event_sink is not None and removed:
             await self.kv_event_sink([], removed)
         return len(removed)
 
-    def _do_clear(self) -> None:
-        removed = self.allocator.clear_cached()
-        while self._clear_requests:
-            fut = self._clear_requests.pop(0)
-            if self._loop_ref is not None:
-                self._loop_ref.call_soon_threadsafe(
-                    _set_result_safe, fut, removed
-                )
-            else:
-                _set_result_safe(fut, removed)
+    # -- disaggregation: parked prefills + KV extraction -------------------
+    async def extract_parked_kv(self, request_id: str):
+        """Gather a parked prefill's KV blocks to host (decode side pulls).
+
+        Returns (k, v, prompt_len): numpy [L, n_blocks, bs, nkv, hd]."""
+
+        def gather():
+            parked = self._parked.get(request_id)
+            if parked is None:
+                raise KeyError(f"no parked KV for request {request_id!r}")
+            ids = jnp.asarray(np.asarray(parked.block_ids, np.int32))
+            k, v = self.kv
+            kb = np.asarray(k[:, ids])
+            vb = np.asarray(v[:, ids])
+            return kb, vb, parked.prompt_len
+
+        return await self._call_on_scheduler(gather)
+
+    async def release_parked(self, request_id: str) -> None:
+        def release():
+            parked = self._parked.pop(request_id, None)
+            if parked is not None:
+                self._emit_events(self.allocator.free(parked.seq_id))
+
+        await self._call_on_scheduler(release)
+
+    def _reap_parked(self) -> None:
+        now = time.monotonic()
+        for rid in [r for r, p in self._parked.items()
+                    if now > p.expires_t]:
+            logger.warning("parked KV for %s expired unpulled", rid)
+            parked = self._parked.pop(rid)
+            self._emit_events(self.allocator.free(parked.seq_id))
 
     # -- scheduler loop ---------------------------------------------------
     async def _loop(self) -> None:
         try:
             while not self._closed:
-                if self._clear_requests:
-                    self._do_clear()  # loop thread; scheduler step not running
+                if self._sched_calls:
+                    # heavy calls (KV gathers) run off the event loop; no
+                    # scheduler step is in flight while we await this
+                    await asyncio.to_thread(self._drain_sched_calls)
+                self._reap_parked()
                 busy = any(s is not None for s in self._slots)
                 if not busy and not self.waiting:
                     self._wake.clear()
-                    if self._clear_requests:
+                    if self._sched_calls:
                         continue
-                    await self._wake.wait()
+                    if self._parked:
+                        # wake periodically so the parked-KV TTL reaper runs
+                        # even on an otherwise idle worker
+                        try:
+                            await asyncio.wait_for(self._wake.wait(), 5.0)
+                        except asyncio.TimeoutError:
+                            pass
+                    else:
+                        await self._wake.wait()
                     continue
                 t0 = time.monotonic()
                 await asyncio.to_thread(self._sched_step)
@@ -350,6 +464,10 @@ class JaxEngine:
         self.metrics["cache_hit_tokens"] += cached_tokens
         slot.ctx_len = cached_tokens
 
+        # disagg decode: scatter the pulled KV instead of computing prefill
+        if slot.preloaded_k is not None and self._try_inject(slot):
+            return
+
         # chunked prefill of the uncached suffix
         table_dev = jnp.asarray(slot.block_table)
         max_chunk = self.config.prefill_buckets[-1]
@@ -377,7 +495,103 @@ class JaxEngine:
         self._commit_full_blocks(slot)
         first = int(tok)
         slot.first_token_t = time.monotonic()
+        if slot.disagg_prefill:
+            self._park_prefilled(slot, first)
+            return
         self._push_token(slot, first)
+
+    def _try_inject(self, slot: _Slot) -> bool:
+        """Scatter pulled KV blocks; returns False to fall back to local
+        prefill on layout mismatch."""
+        seq_id = self._seq_id(slot)
+        block_ids = self.allocator.seq_block_ids(seq_id)
+        kb, vb = slot.preloaded_k, slot.preloaded_v
+        if kb.shape[0] != self.model_cfg.n_layers or \
+                kb.shape[1] != len(block_ids) or \
+                kb.shape[2] != self.config.block_size:
+            logger.warning("pulled KV layout %s mismatches engine "
+                           "(layers=%d blocks=%d bs=%d); local prefill",
+                           kb.shape, self.model_cfg.n_layers, len(block_ids),
+                           self.config.block_size)
+            return False
+        # pad block count to a pow2 bucket to bound recompiles; padded ids
+        # target the garbage block
+        n = len(block_ids)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        ids = np.zeros(bucket, np.int32)
+        ids[:n] = block_ids
+        pad = ((0, 0), (0, bucket - n)) + ((0, 0),) * (kb.ndim - 2)
+        kb_p = np.pad(kb, pad)
+        vb_p = np.pad(vb, pad)
+        self.kv = self._jit_inject(
+            self.kv, jnp.asarray(kb_p), jnp.asarray(vb_p), jnp.asarray(ids)
+        )
+        prompt_len = len(slot.seq)
+        slot.ctx_len = prompt_len
+        slot.cached_tokens = prompt_len  # skipped compute entirely
+        self._commit_full_blocks(slot)
+        slot.first_token_t = time.monotonic()
+        first = slot.preloaded_first_token
+        if first is None:
+            # transfer metadata lacked the first token: recompute from the
+            # last prompt position (cache already holds prompt[:-1])
+            table_dev = jnp.asarray(slot.block_table)
+            s = slot.request.sampling
+            toks = np.zeros(self.config.prefill_buckets[0], np.int32)
+            toks[0] = slot.seq.tokens[-1]
+            positions = (prompt_len - 1) + np.arange(
+                self.config.prefill_buckets[0], dtype=np.int32)
+            tok, self.kv = self._jit_prefill(
+                self.params, self.kv, jnp.asarray(toks),
+                jnp.asarray(positions), table_dev,
+                jnp.int32(prompt_len - 1), jnp.int32(1),
+                jnp.int32(slot.sampling_seed), jnp.float32(s.temperature),
+                jnp.int32(s.top_k), jnp.float32(s.top_p),
+            )
+            first = int(tok)
+        slot.preloaded_k = slot.preloaded_v = None
+        self.metrics["cache_hit_tokens"] += prompt_len
+        self._push_token(slot, int(first))
+        return True
+
+    def _park_prefilled(self, slot: _Slot, first_token: int) -> None:
+        """Disagg prefill done: keep the KV, hand back transfer metadata."""
+        from ..disagg.transfer import make_transfer_params
+
+        seq_id = self._seq_id(slot)
+        rid = slot.request.request_id
+        self._parked[rid] = _Parked(
+            seq_id=seq_id,
+            block_ids=list(self.allocator.seq_block_ids(seq_id)),
+            prompt_len=slot.ctx_len,
+            expires_t=time.monotonic() + self.parked_ttl_s,
+        )
+        self._commit_full_blocks(slot)
+        slot.finished = True
+        if slot.index >= 0:
+            self._slots[slot.index] = None
+            slot.index = -1
+        params = make_transfer_params(
+            instance_id=self.transfer_identity.get("instance_id", 0),
+            request_id=rid,
+            prompt_len=self._parked[rid].prompt_len,
+            first_token=first_token,
+            block_size=self.config.block_size,
+            num_layers=self.model_cfg.n_layers,
+        )
+        params.update({k: v for k, v in self.transfer_identity.items()
+                       if k != "instance_id"})
+        out = LLMEngineOutput(
+            token_ids=[first_token], finish_reason="stop",
+            kv_transfer_params=params,
+            metrics={"ttft_s": slot.first_token_t - slot.enqueued_t},
+        )
+        if self._loop_ref is not None:
+            self._loop_ref.call_soon_threadsafe(slot.out_q.put_nowait, out)
+        else:
+            slot.out_q.put_nowait(out)
 
     # -- decode -----------------------------------------------------------
     def _decode_step(self) -> None:

@@ -65,6 +65,7 @@ class JaxEngineWorker:
                 "model_preset": self.config.model,
                 "tp": self.config.tp,
                 "dp": self.config.dp,
+                "role": self.config.role,
             },
         )
 
@@ -82,7 +83,14 @@ class JaxEngineWorker:
                 await self.publisher.removed(removed)
 
         self.engine = JaxEngine(self.config, params=self._params,
-                                kv_event_sink=kv_event_sink)
+                                kv_event_sink=kv_event_sink,
+                                kv_pull_fn=self._kv_pull)
+        self.engine.transfer_identity = {
+            "instance_id": instance_id,
+            "namespace": self.namespace,
+            "component": self.component,
+        }
+        self._pull_clients = {}
 
         async def generate_handler(payload, ctx):
             request = PreprocessedRequest.from_dict(payload)
@@ -92,6 +100,18 @@ class JaxEngineWorker:
         async def clear_handler(payload, ctx):
             n = await self.engine.clear_kv_blocks()
             yield {"cleared_blocks": n}
+
+        async def kv_pull_handler(payload, ctx):
+            """Stream a parked prefill's KV, one layer per frame (bounds
+            frame sizes for long prompts), then release the blocks."""
+            from ..disagg.transfer import serialize_kv
+
+            rid = payload["request_id"]
+            k, v, prompt_len = await self.engine.extract_parked_kv(rid)
+            yield {"prompt_len": prompt_len, "num_layers": int(k.shape[0])}
+            for layer in range(k.shape[0]):
+                yield serialize_kv(k[layer:layer + 1], v[layer:layer + 1])
+            await self.engine.release_parked(rid)
 
         comp = rt.namespace(self.namespace).component(self.component)
         self.served = await comp.endpoint("generate").serve_endpoint(
@@ -104,12 +124,52 @@ class JaxEngineWorker:
                 clear_handler, instance_id=instance_id),
             await comp.endpoint("kv_events_replay").serve_endpoint(
                 self.publisher.replay_handler, instance_id=instance_id),
+            await comp.endpoint("kv_pull").serve_endpoint(
+                kv_pull_handler, instance_id=instance_id),
         ]
         await register_model(rt, self.card, instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
         logger.info("jax engine worker %d serving %s (tp=%d)",
                     instance_id, self.config.served_name, self.config.tp)
         return self
+
+    async def _kv_pull(self, params: dict):
+        """Decode-side pull: fetch a parked prefill's KV from its worker.
+
+        The transport is the request plane (host-staged); on multi-slice
+        topologies this is where the ICI/DCN device-to-device path plugs in
+        (disagg/transfer.py docstring)."""
+        import numpy as np
+
+        from ..disagg.transfer import deserialize_kv
+
+        ns = params.get("namespace", self.namespace)
+        comp = params.get("component", self.component)
+        key = (ns, comp)
+        client = self._pull_clients.get(key)
+        if client is None:
+            ep = (self.runtime.namespace(ns).component(comp)
+                  .endpoint("kv_pull"))
+            client = await ep.client().start()
+            await client.wait_for_instances()
+            self._pull_clients[key] = client
+        header = None
+        k_layers, v_layers = [], []
+        async for item in client.generate(
+            {"request_id": params["request_id"]},
+            instance_id=params["instance_id"],
+        ):
+            if header is None:
+                header = item
+                continue
+            payload = deserialize_kv(item)
+            k_layers.append(payload.k)
+            v_layers.append(payload.v)
+        if header is None or not k_layers:
+            raise RuntimeError("empty KV pull stream")
+        k = np.concatenate(k_layers, axis=0)
+        v = np.concatenate(v_layers, axis=0)
+        return k, v, header["prompt_len"]
 
     async def _load_loop(self) -> None:
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"

@@ -20,6 +20,10 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--busy-threshold", type=int, default=None)
     p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     p.add_argument("--router-temperature", type=float, default=0.0)
+    # conditional disagg thresholds (ref: conditional_disagg.rs:11-18)
+    p.add_argument("--disagg-min-isl", type=int, default=2048)
+    p.add_argument("--disagg-ratio", type=float, default=0.7)
+    p.add_argument("--always-disagg", action="store_true")
     return p
 
 
@@ -39,8 +43,16 @@ async def main() -> None:
             overlap_score_weight=args.kv_overlap_score_weight,
             temperature=args.router_temperature,
         )
+    from ..disagg.prefill_router import ConditionalDisaggConfig
+
+    disagg_config = ConditionalDisaggConfig(
+        min_effective_isl=args.disagg_min_isl,
+        min_effective_ratio=args.disagg_ratio,
+        always_remote=args.always_disagg,
+    )
     watcher = await ModelWatcher(
-        rt, manager, router_mode=mode, make_route=make_route
+        rt, manager, router_mode=mode, make_route=make_route,
+        disagg_config=disagg_config,
     ).start()
     service = await HttpService(
         rt, manager, host=args.host, port=args.port,

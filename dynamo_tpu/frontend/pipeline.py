@@ -110,19 +110,23 @@ class ModelPipeline:
     """Everything the HTTP layer needs to serve one model."""
 
     def __init__(self, mdc: ModelDeploymentCard, client: Client,
-                 route=None):
+                 route=None, prefill=None):
         self.mdc = mdc
         self.preprocessor = OpenAIPreprocessor(mdc)
         self.client = client
         self.migration = MigrationOperator(
             client, migration_limit=mdc.migration_limit, route=route
         )
+        # disaggregation: PrefillOrchestrator when a prefill fleet exists
+        self.prefill = prefill
 
     async def generate_deltas(
         self, request: PreprocessedRequest,
         token: Optional[CancellationToken] = None,
     ) -> AsyncIterator[ChatDelta]:
         """Engine stream → detokenized text deltas with stop-string handling."""
+        if self.prefill is not None:
+            request = await self.prefill.maybe_prefill(request, token=token)
         detok = self.preprocessor.tokenizer.make_detokenizer()
         stops = request.stop.stop or []
         pending = ""  # holdback buffer for partial stop-string matches

@@ -46,17 +46,21 @@ class ModelWatcher:
 
     def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
                  router_mode: RouterMode = RouterMode.ROUND_ROBIN,
-                 make_route=None):
+                 make_route=None, disagg_config=None):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         # make_route(mdc) -> optional coroutine route(req, avoid) -> instance_id
         self.make_route = make_route
+        self.disagg_config = disagg_config
         self._cancel = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._clients: Dict[str, Any] = {}        # model name -> client
         self._key_to_name: Dict[str, str] = {}    # discovery key -> model name
-        self._model_keys: Dict[str, set] = {}     # model name -> live keys
+        self._key_role: Dict[str, str] = {}       # discovery key -> role
+        self._model_keys: Dict[str, set] = {}     # model name -> decode keys
+        self._prefill_keys: Dict[str, set] = {}   # model name -> prefill keys
+        self._prefill_orchs: Dict[str, Any] = {}  # model name -> orchestrator
 
     async def start(self) -> "ModelWatcher":
         if self._task is None:
@@ -82,6 +86,12 @@ class ModelWatcher:
 
     async def _add(self, key: str, mdc: ModelDeploymentCard) -> None:
         self._key_to_name[key] = mdc.name
+        role = mdc.runtime_config.get("role", "both")
+        if role == "prefill":
+            self._key_role[key] = "prefill"
+            await self._add_prefill(key, mdc)
+            return
+        self._key_role[key] = "decode"
         self._model_keys.setdefault(mdc.name, set()).add(key)
         existing = self.manager.models.get(mdc.name)
         if existing is not None:
@@ -103,14 +113,80 @@ class ModelWatcher:
         route = None
         if self.make_route is not None:
             route = await self.make_route(mdc, client)
-        self.manager.models[mdc.name] = ModelPipeline(mdc, client, route=route)
+        self.manager.models[mdc.name] = ModelPipeline(
+            mdc, client, route=route,
+            prefill=self._prefill_orchs.get(mdc.name),
+        )
         self._clients[mdc.name] = client
         logger.info("model %s registered (endpoint %s/%s/%s)",
                     mdc.name, mdc.namespace, mdc.component, mdc.endpoint)
 
+    async def _add_prefill(self, key: str, mdc: ModelDeploymentCard) -> None:
+        """A prefill-fleet card: attach a PrefillOrchestrator to the model's
+        pipeline instead of serving it directly (ref: PrefillRouter)."""
+        from ..disagg.prefill_router import PrefillOrchestrator
+
+        self._prefill_keys.setdefault(mdc.name, set()).add(key)
+        if mdc.name in self._prefill_orchs:
+            return
+        ep = (
+            self.runtime.namespace(mdc.namespace)
+            .component(mdc.component)
+            .endpoint(mdc.endpoint)
+        )
+        pclient = await ep.client(RouterMode.ROUND_ROBIN).start()
+        orch = PrefillOrchestrator(
+            pclient, config=self.disagg_config,
+            decode_overlap_fn=self._make_overlap_fn(mdc.name),
+        )
+        self._prefill_orchs[mdc.name] = orch
+        pipeline = self.manager.models.get(mdc.name)
+        if pipeline is not None:
+            pipeline.prefill = orch
+        logger.info("prefill fleet attached for model %s (%s/%s)",
+                    mdc.name, mdc.namespace, mdc.component)
+
+    def _make_overlap_fn(self, name: str):
+        """Effective-ISL input for conditional disagg: best decode-fleet
+        prefix overlap, from the model's KV router index (0 without one)."""
+
+        async def overlap(request) -> int:
+            pipeline = self.manager.models.get(name)
+            if pipeline is None:
+                return 0
+            route = pipeline.migration.route
+            indexer = getattr(route, "indexer", None)
+            if indexer is None:
+                return 0
+            from ..tokens import compute_block_hashes_for_request
+
+            bs = pipeline.mdc.kv_cache_block_size
+            hashes = compute_block_hashes_for_request(
+                request.token_ids, bs, lora_name=request.lora_name
+            )
+            overlaps = indexer.find_matches(hashes)
+            return max(overlaps.values(), default=0) * bs
+
+        return overlap
+
     async def _remove_by_key(self, key: str) -> None:
         name = self._key_to_name.pop(key, None)
         if name is None:
+            return
+        if self._key_role.pop(key, "decode") == "prefill":
+            pkeys = self._prefill_keys.get(name)
+            if pkeys is not None:
+                pkeys.discard(key)
+                if pkeys:
+                    return
+            self._prefill_keys.pop(name, None)
+            orch = self._prefill_orchs.pop(name, None)
+            pipeline = self.manager.models.get(name)
+            if pipeline is not None:
+                pipeline.prefill = None  # fall back to aggregated serving
+            if orch is not None:
+                await orch.close()
+            logger.info("prefill fleet for %s gone; serving aggregated", name)
             return
         keys = self._model_keys.get(name)
         if keys is not None:
@@ -135,6 +211,8 @@ class ModelWatcher:
         self._cancel.set()
         if self._task is not None:
             self._task.cancel()
+        for orch in self._prefill_orchs.values():
+            await orch.close()
         for pipeline in self.manager.models.values():
             await self._close_route(pipeline)
         for client in self._clients.values():

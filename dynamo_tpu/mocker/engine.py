@@ -55,6 +55,8 @@ class _Seq:
     generated: int = 0
     cached_blocks: int = 0
     finished: bool = False
+    disagg_prefill: bool = False   # prefill-only hop; return transfer params
+    remote_prefilled: bool = False  # KV arrives via transfer; skip prefill
     rng: random.Random = field(default_factory=random.Random)
 
 
@@ -126,6 +128,11 @@ class MockEngine:
                 else hash(request.request_id) & 0x7FFFFFFF
             ),
         )
+        from ..protocols.llm import DISAGG_ANNOTATION
+
+        seq.disagg_prefill = DISAGG_ANNOTATION in (request.annotations or [])
+        dp = request.disaggregated_params
+        seq.remote_prefilled = bool(dp) and dp.get("engine") == "mock"
         self.waiting.append(seq)
         self._wake.set()
         from ..runtime.aio import CANCELLED, next_or_cancel
@@ -197,6 +204,9 @@ class MockEngine:
             seq.prefill_pos = min(
                 res.cached_blocks * self.args.block_size, seq.num_prompt_tokens
             )
+            if seq.remote_prefilled:
+                # KV transferred from the prefill worker: no local compute
+                seq.prefill_pos = seq.num_prompt_tokens
             self._publish(res)
             self.waiting.pop(0)
             self.running.append(seq)
@@ -239,6 +249,22 @@ class MockEngine:
         self.metrics["prefill_tokens"] += prefill_tokens
 
         for seq in decode_seqs:
+            if seq.disagg_prefill:
+                # prefill-only hop: emit first token + transfer metadata and
+                # finish (mock transfer is instantaneous; no parking)
+                tok = self._next_token(seq)
+                seq.out_queue.put_nowait(LLMEngineOutput(
+                    token_ids=[tok], finish_reason="stop",
+                    kv_transfer_params={
+                        "engine": "mock",
+                        "first_token": tok,
+                        "prompt_len": seq.num_prompt_tokens,
+                    },
+                ))
+                seq.finished = True
+                self.running.remove(seq)
+                self._publish(self.cache.free(seq.request_id))
+                continue
             tok = self._next_token(seq)
             completed = seq.blocks.append(tok)
             partial = seq.blocks.partial_len()

@@ -14,6 +14,10 @@ from typing import Any, Dict, List, Optional
 
 FinishReason = str  # "stop" | "length" | "eos" | "cancelled" | "error"
 
+# request annotation marking a disaggregated-prefill hop (the worker runs
+# prefill only and parks the KV for the decode worker to pull)
+DISAGG_ANNOTATION = "disagg_prefill"
+
 
 @dataclass
 class SamplingOptions:
