@@ -20,11 +20,13 @@ XLA's compile-once/execute-many model:
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
+from types import SimpleNamespace
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 import jax
@@ -296,14 +298,33 @@ class JaxEngine:
         return slot.request.request_id
 
     def _emit_events(self, res) -> None:
-        """Thread-safe KV event emission (called from the scheduler thread)."""
+        """Thread-safe KV event emission (called from the scheduler thread).
+
+        The sink may be synchronous (preferred: enqueue + serialized publish,
+        see KvEventPublisher.enqueue_batch) or an async callable.  Either way
+        it is invoked on the loop thread via call_soon_threadsafe, whose FIFO
+        callback ordering keeps wire order equal to mutation order."""
         if res is None or self.kv_event_sink is None:
             return
-        stored = getattr(res, "stored", [])
-        removed = getattr(res, "removed", [])
-        if (stored or removed) and self._loop_ref is not None:
-            coro = self.kv_event_sink(list(stored), list(removed))
-            self._loop_ref.call_soon_threadsafe(asyncio.ensure_future, coro)
+        stored = list(getattr(res, "stored", []))
+        removed = list(getattr(res, "removed", []))
+        if not (stored or removed):
+            return
+        sink = self.kv_event_sink
+
+        def dispatch():
+            r = sink(stored, removed)
+            if inspect.isawaitable(r):
+                asyncio.ensure_future(r)
+
+        if self._loop_ref is not None:
+            self._loop_ref.call_soon_threadsafe(dispatch)
+        else:
+            # pre-start only (no loop yet): nothing is routing yet, so an
+            # async sink's events can be dropped safely
+            r = sink(stored, removed)
+            if inspect.isawaitable(r):
+                r.close()
 
     def _call_on_scheduler(self, fn) -> asyncio.Future:
         """Run `fn()` between scheduler steps (the allocator and KV cache are
@@ -342,9 +363,15 @@ class JaxEngine:
 
     async def clear_kv_blocks(self) -> int:
         """Drop the reusable prefix cache (active sequences keep theirs)."""
-        removed = await self._call_on_scheduler(self.allocator.clear_cached)
-        if self.kv_event_sink is not None and removed:
-            await self.kv_event_sink([], removed)
+        def do_clear():
+            removed = self.allocator.clear_cached()
+            # emit from the scheduler thread so these removals stay ordered
+            # against stores from the next step (a later stored(H) for a
+            # re-admitted prefix must reach the wire after this removed(H))
+            self._emit_events(SimpleNamespace(stored=[], removed=removed))
+            return removed
+
+        removed = await self._call_on_scheduler(do_clear)
         return len(removed)
 
     # -- disaggregation: parked prefills + KV extraction -------------------
@@ -649,8 +676,18 @@ class JaxEngine:
             self._push_token(s, int(next_tokens[s.index]))
 
     def _commit_full_blocks(self, slot: _Slot) -> None:
-        """Register newly-completed full blocks under their PLH."""
-        while slot.committed_blocks < slot.seq.num_full_blocks:
+        """Register newly-completed full blocks under their PLH.
+
+        A block is only committed once every one of its tokens' K/V is
+        materialized in the cache (covered by ctx_len).  The sampled token
+        that *completes* a block has its K/V written on the NEXT decode
+        step, so that block commits one step later; if the request finishes,
+        is cancelled, or is preempted first, the trailing block is never
+        registered — otherwise a later prompt could prefix-match a block
+        whose final position holds zeros."""
+        materialized = slot.ctx_len // self.config.block_size
+        limit = min(slot.seq.num_full_blocks, materialized)
+        while slot.committed_blocks < limit:
             idx = slot.committed_blocks
             h = slot.seq.block_hashes[idx]
             res = self.allocator.commit_block(self._seq_id(slot), idx, h)

@@ -76,11 +76,10 @@ class JaxEngineWorker:
             rt, self.namespace, self.component, worker_id=instance_id
         )
 
-        async def kv_event_sink(stored, removed):
-            if stored:
-                await self.publisher.stored(stored)
-            if removed:
-                await self.publisher.removed(removed)
+        def kv_event_sink(stored, removed):
+            # synchronous enqueue on the loop thread: event ids are assigned
+            # in mutation order and a single drain task publishes FIFO
+            self.publisher.enqueue_batch(stored=stored, removed=removed)
 
         self.engine = JaxEngine(self.config, params=self._params,
                                 kv_event_sink=kv_event_sink,

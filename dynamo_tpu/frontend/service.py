@@ -98,9 +98,12 @@ class ModelWatcher:
             if existing.mdc.to_dict() == mdc.to_dict():
                 return
             # MDC update (new template/tokenizer/limits): rebuild the
-            # pipeline but keep the existing endpoint client
+            # pipeline but keep the existing endpoint client, route hook,
+            # and prefill orchestrator (dropping prefill here would silently
+            # disable disaggregated serving until the prefill card republishes)
             self.manager.models[mdc.name] = ModelPipeline(
-                mdc, existing.client, route=existing.migration.route
+                mdc, existing.client, route=existing.migration.route,
+                prefill=existing.prefill or self._prefill_orchs.get(mdc.name),
             )
             logger.info("model %s updated", mdc.name)
             return

@@ -173,10 +173,8 @@ class MockEngine:
     def _publish(self, res) -> None:
         if self.publisher is None or res is None:
             return
-        if res.stored:
-            asyncio.ensure_future(self.publisher.stored(res.stored))
-        if res.removed:
-            asyncio.ensure_future(self.publisher.removed(res.removed))
+        # removed-before-stored within one mutation, serialized on the wire
+        self.publisher.enqueue_batch(stored=res.stored, removed=res.removed)
 
     async def _loop(self) -> None:
         try:

@@ -16,9 +16,12 @@ PLHs are 128-bit, which exceeds msgpack's integer range — on the wire they are
 from __future__ import annotations
 
 import asyncio
+import logging
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
 
 KV_EVENT_SUBJECT_PREFIX = "kv_events"
 
@@ -88,6 +91,8 @@ class KvEventPublisher:
         self.dp_rank = dp_rank
         self._next_id = 0
         self._ring: deque[KvCacheEvent] = deque(maxlen=ring_size)
+        self._out: deque[KvCacheEvent] = deque()
+        self._drain_task: Optional[asyncio.Task] = None
 
     def _mk(self, op: str, block_hashes: Sequence[int],
             parent_hash: Optional[int], tier: str) -> KvCacheEvent:
@@ -104,22 +109,72 @@ class KvEventPublisher:
         self._ring.append(ev)
         return ev
 
+    def enqueue_batch(self, stored: Sequence[int] = (),
+                      removed: Sequence[int] = (),
+                      parent_hash: Optional[int] = None,
+                      tier: str = "g1") -> None:
+        """Record one cache mutation's events and schedule publication.
+
+        Synchronous and loop-thread only: event ids are assigned here, so
+        wire order equals call order.  Removals publish BEFORE stores — the
+        allocator evicts before it registers within one mutation, and if a
+        hash is evicted and immediately re-registered, a router seeing
+        stored(H) then removed(H) would drop a block the engine holds.
+        A single drain task publishes FIFO so batches from concurrent
+        mutations never interleave on the wire."""
+        if removed:
+            self._out.append(self._mk("removed", removed, None, tier))
+        if stored:
+            self._out.append(self._mk("stored", stored, parent_hash, tier))
+        self._kick()
+
+    def _kick(self) -> None:
+        if self._out and (self._drain_task is None or self._drain_task.done()):
+            self._drain_task = asyncio.ensure_future(self._drain())
+
+    async def _drain(self) -> None:
+        while self._out:
+            ev = self._out[0]  # keep at head until published
+            try:
+                await self.runtime.event_plane.publish(
+                    self.subject, ev.to_wire()
+                )
+            except Exception:
+                ev._publish_attempts = getattr(ev, "_publish_attempts", 0) + 1
+                if ev._publish_attempts < 3:
+                    logger.warning("kv event %d publish failed; retrying",
+                                   ev.event_id, exc_info=True)
+                    await asyncio.sleep(0.05 * ev._publish_attempts)
+                    continue
+                # drop and move on: the id gap makes routers recover the
+                # event from the ring via kv_events_replay
+                logger.error("kv event %d dropped after retries; routers "
+                             "will gap-recover from the ring", ev.event_id)
+            self._out.popleft()
+
+    async def _flush(self) -> None:
+        self._kick()
+        if self._drain_task is not None:
+            await asyncio.shield(self._drain_task)
+
     async def stored(self, block_hashes: Sequence[int],
                      parent_hash: Optional[int] = None, tier: str = "g1") -> None:
         if not block_hashes:
             return
-        ev = self._mk("stored", block_hashes, parent_hash, tier)
-        await self.runtime.event_plane.publish(self.subject, ev.to_wire())
+        self.enqueue_batch(stored=block_hashes, parent_hash=parent_hash,
+                           tier=tier)
+        await self._flush()
 
     async def removed(self, block_hashes: Sequence[int], tier: str = "g1") -> None:
         if not block_hashes:
             return
-        ev = self._mk("removed", block_hashes, None, tier)
-        await self.runtime.event_plane.publish(self.subject, ev.to_wire())
+        self.enqueue_batch(removed=block_hashes, tier=tier)
+        await self._flush()
 
     async def cleared(self) -> None:
-        ev = self._mk("cleared", [], None, "g1")
-        await self.runtime.event_plane.publish(self.subject, ev.to_wire())
+        self._out.append(self._mk("cleared", [], None, "g1"))
+        self._kick()
+        await self._flush()
 
     # -- recovery (ref: router-design.md:186-195 gap recovery) -------------
     def replay_since(self, since_event_id: int) -> List[Dict[str, Any]]:

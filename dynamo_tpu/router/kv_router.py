@@ -84,12 +84,20 @@ class KvRouter:
 
     def _apply_event(self, ev: KvCacheEvent) -> None:
         last = self.indexer.last_event_id.get(ev.worker_id)
-        if (last is not None and ev.event_id > last + 1
+        # Gap in two forms: missed events mid-stream (last known, jump > 1)
+        # and a router that subscribed after the worker started publishing
+        # (first observed event from an unknown worker has event_id > 0 —
+        # everything stored before subscription must be replayed or it stays
+        # invisible to routing forever).
+        expected_next = 0 if last is None else last + 1
+        if (ev.event_id > expected_next
                 and ev.worker_id not in self._recovering):
-            # missed events: recover from the worker's ring buffer (hold a
-            # strong task ref — the loop only keeps weak ones)
+            # recover from the worker's ring buffer (hold a strong task
+            # ref — the loop only keeps weak ones)
             self._recovering.add(ev.worker_id)
-            task = asyncio.ensure_future(self._recover(ev.worker_id, last + 1))
+            task = asyncio.ensure_future(
+                self._recover(ev.worker_id, expected_next)
+            )
             self._recover_tasks.add(task)
             task.add_done_callback(self._recover_tasks.discard)
         self.indexer.last_event_id[ev.worker_id] = max(
@@ -107,16 +115,34 @@ class KvRouter:
             self._recovering.discard(worker_id)
             return
         try:
+            events = []
             async for wire_ev in self._replay_client.generate(
                 {"since_event_id": since}, instance_id=worker_id
             ):
-                ev = KvCacheEvent.from_wire(wire_ev)
+                events.append(KvCacheEvent.from_wire(wire_ev))
+            if events and events[0].event_id > since:
+                # the worker's replay ring evicted part of the requested
+                # range: blocks stored in the lost events would stay
+                # invisible if we just applied the tail.  Reset this
+                # worker's index and rebuild from what the ring still has —
+                # a conservative miss (some resident blocks unindexed, will
+                # reappear on their next stored event) instead of a silent
+                # permanent hole presented as full recovery.
+                logger.warning(
+                    "replay ring for worker %d starts at %d > requested %d; "
+                    "resetting its index to the ring tail",
+                    worker_id, events[0].event_id, since,
+                )
+                self.indexer.clear_worker(worker_id)
+            for ev in events:
                 if ev.op == "stored":
                     self.indexer.apply_stored(ev.worker_id, ev.block_hashes)
                 elif ev.op == "removed":
                     self.indexer.apply_removed(ev.worker_id, ev.block_hashes)
-            logger.info("recovered kv events for worker %d since %d",
-                        worker_id, since)
+                elif ev.op == "cleared":
+                    self.indexer.clear_worker(ev.worker_id)
+            logger.info("recovered %d kv events for worker %d since %d",
+                        len(events), worker_id, since)
         except Exception:
             logger.warning("kv event recovery failed for worker %d; "
                            "dropping its index", worker_id, exc_info=True)

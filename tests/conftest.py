@@ -26,6 +26,19 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# build the native library from source if absent (it is not committed);
+# make_indexer falls back to pure Python when the toolchain is unavailable
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_native_so = os.path.join(_repo_root, "native", "libdynamo_native.so")
+if not os.path.exists(_native_so):
+    import subprocess
+
+    try:
+        subprocess.run(["make", "-C", os.path.join(_repo_root, "native")],
+                       capture_output=True)
+    except OSError:
+        pass  # no toolchain: tests run on the pure-Python indexer
+
 import asyncio
 import inspect
 

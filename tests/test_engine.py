@@ -204,3 +204,49 @@ async def test_kv_events_emitted():
     # 12-token prompt = 3 full blocks; some decode blocks may complete too
     assert len(stored) >= 3
     await eng.close()
+
+
+async def test_trailing_block_not_registered_before_kv_materialized():
+    """A request finishing exactly at a block boundary must NOT register the
+    trailing block: the final sampled token's K/V is only written on the next
+    decode step, which never runs.  Registering it would let a later prompt
+    prefix-match a block whose last position holds zeros (ADVICE r1, high)."""
+    events = []
+
+    def sink(stored, removed):
+        events.append((list(stored), list(removed)))
+
+    cfg = EngineConfig(model_config=FP32, block_size=4, num_blocks=16,
+                       max_blocks_per_seq=8, max_num_seqs=2,
+                       prefill_buckets=(8, 16, 32), seed=7)
+    eng = JaxEngine(cfg, kv_event_sink=sink)
+    # 7-token prompt + 1 generated = 8 tokens = 2 exact blocks.  Block 0 is
+    # fully materialized by prefill; block 1 is completed by the sampled
+    # token whose K/V never lands in the cache.
+    await collect(eng, greedy_req(list(range(1, 8)), 1, "bd1"))
+    await asyncio.sleep(0.05)
+    stored = [h for st, _ in events for h in st]
+    assert len(stored) == 1, f"trailing block leaked into the cache: {stored}"
+    await eng.close()
+
+
+async def test_sync_sink_removed_published_before_stored():
+    """One allocator mutation can evict hash H and re-register it; the wire
+    must carry removed before stored so routers don't drop live blocks."""
+    from dynamo_tpu.router.events import KvEventPublisher
+
+    published = []
+
+    class FakePlane:
+        async def publish(self, subject, payload):
+            published.append(payload)
+
+    class FakeRuntime:
+        event_plane = FakePlane()
+
+    pub = KvEventPublisher(FakeRuntime(), "ns", "comp", worker_id=1)
+    pub.enqueue_batch(stored=[1 << 100], removed=[2 << 100])
+    pub.enqueue_batch(stored=[3 << 100])
+    await pub._flush()
+    assert [p["op"] for p in published] == ["removed", "stored", "stored"]
+    assert [p["event_id"] for p in published] == [0, 1, 2]

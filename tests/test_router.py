@@ -243,3 +243,42 @@ async def test_kv_router_event_gap_recovery():
 
     await router.close()
     await rt.shutdown()
+
+
+async def test_kv_router_late_join_full_replay():
+    """A router that subscribes AFTER a worker has been publishing must
+    replay events 0..N-1 on its first observed event, or blocks stored
+    before subscription stay invisible to routing (ADVICE r1, medium)."""
+    from dynamo_tpu.router.events import KvEventPublisher
+    from dynamo_tpu.router.kv_router import KvRouter
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex
+    ).start()
+    comp = rt.namespace("ns").component("w")
+    pub = KvEventPublisher(rt, "ns", "w", worker_id=9)
+    await comp.endpoint("kv_events_replay").serve_endpoint(
+        pub.replay_handler, instance_id=9
+    )
+    hs = [H(i) for i in range(8)]
+    # events 0 and 1 happen before any router exists
+    await pub.stored(hs[:3])
+    await pub.stored(hs[3:5])
+    await asyncio.sleep(0.05)
+
+    gen_client = await comp.endpoint("generate").client().start()
+    router = await KvRouter(rt, "ns", "w", gen_client, block_size=4).start()
+    await asyncio.sleep(0.05)
+    # first event the late router sees has event_id=2 -> full replay from 0
+    await pub.stored(hs[5:8])
+    for _ in range(100):
+        if router.indexer.worker_block_count(9) >= 8:
+            break
+        await asyncio.sleep(0.02)
+    assert router.indexer.worker_block_count(9) == 8
+    assert router.indexer.find_matches(hs) == {9: 8}
+
+    await router.close()
+    await rt.shutdown()
