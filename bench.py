@@ -23,7 +23,7 @@ from dynamo_tpu.models import llama
 
 BATCH = 8
 CTX = 512            # context tokens per sequence during decode
-BLOCK = 16
+BLOCK = 128          # lane-aligned paged blocks (Pallas decode kernel)
 STEPS = 64
 WARMUP = 8
 
@@ -39,8 +39,8 @@ def main() -> None:
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     kv = tuple(
-        jnp.zeros((cfg.n_layers, num_blocks, BLOCK, cfg.n_kv_heads,
-                   cfg.head_dim), cfg.dtype)
+        jnp.zeros((cfg.n_layers, cfg.n_kv_heads, num_blocks,
+                   cfg.head_dim, BLOCK), cfg.dtype)
         for _ in range(2)
     )
     rng = np.random.default_rng(0)

@@ -19,8 +19,8 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--model-name", default="", help="served model name")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--component", default="backend")
-    p.add_argument("--block-size", type=int, default=16)
-    p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--block-size", type=int, default=128)
+    p.add_argument("--num-blocks", type=int, default=128)
     p.add_argument("--max-blocks-per-seq", type=int, default=64)
     p.add_argument("--max-num-seqs", type=int, default=8)
     p.add_argument("--tp", type=int, default=1)

@@ -15,9 +15,11 @@ class EngineConfig:
     model_config: Optional[LlamaConfig] = None
     model_name: str = ""  # served model name; defaults to preset name
 
-    # paged KV cache
-    block_size: int = 16          # tokens per block == PLH hashing block size
-    num_blocks: int = 512         # physical blocks (id 0 is garbage)
+    # paged KV cache.  Default block_size is 128 (lane-aligned) so the
+    # Pallas decode kernel's auto-dispatch engages on TPU; CPU/test configs
+    # pass smaller blocks and take the jnp path.
+    block_size: int = 128         # tokens per block == PLH hashing block size
+    num_blocks: int = 128         # physical blocks (id 0 is garbage)
     max_blocks_per_seq: int = 64  # max context = block_size * this
     enable_prefix_caching: bool = True
 

@@ -140,8 +140,9 @@ class JaxEngine:
     def _init_kv_cache(self):
         m = self.model_cfg
         c = self.config
-        shape = (m.n_layers, c.num_blocks, c.block_size, m.n_kv_heads,
-                 m.head_dim)
+        # head-major transposed block layout (ops/paged_attention.py)
+        shape = (m.n_layers, m.n_kv_heads, c.num_blocks, m.head_dim,
+                 c.block_size)
         sharding = NamedSharding(self.mesh, kv_cache_spec())
         zeros = partial(jnp.zeros, shape, m.dtype)
         k = jax.jit(zeros, out_shardings=sharding)()
@@ -161,10 +162,18 @@ class JaxEngine:
     @staticmethod
     def _inject_impl(kv, kb, vb, ids):
         """Scatter pulled KV blocks into the cache (ids padded with 0 write
-        harmlessly into the garbage block)."""
+        harmlessly into the garbage block).
+
+        kb/vb arrive in the UNIVERSAL transfer layout [L, nb, bs, nkv, hd]
+        (stable on the wire regardless of either engine's physical layout)
+        and are permuted into the head-major block layout here — the TPU
+        analogue of the reference's universal_to_block kernel
+        (lib/kvbm-kernels/cuda/tensor_kernels.cu:192)."""
         k, v = kv
-        k = k.at[:, ids].set(kb.astype(k.dtype))
-        v = v.at[:, ids].set(vb.astype(v.dtype))
+        kb = jnp.transpose(kb, (0, 3, 1, 4, 2))  # -> [L, nkv, nb, hd, bs]
+        vb = jnp.transpose(vb, (0, 3, 1, 4, 2))
+        k = k.at[:, :, ids].set(kb.astype(k.dtype))
+        v = v.at[:, :, ids].set(vb.astype(v.dtype))
         return (k, v)
 
     @staticmethod
@@ -386,8 +395,11 @@ class JaxEngine:
                 raise KeyError(f"no parked KV for request {request_id!r}")
             ids = jnp.asarray(np.asarray(parked.block_ids, np.int32))
             k, v = self.kv
-            kb = np.asarray(k[:, ids])
-            vb = np.asarray(v[:, ids])
+            # head-major transposed block layout [L, nkv, n, hd, bs] ->
+            # universal transfer layout [L, nb, bs, nkv, hd]
+            # (block_to_universal analogue, tensor_kernels.cu:151)
+            kb = np.asarray(jnp.transpose(k[:, :, ids], (0, 2, 4, 1, 3)))
+            vb = np.asarray(jnp.transpose(v[:, :, ids], (0, 2, 4, 1, 3)))
             return kb, vb, parked.prompt_len
 
         return await self._call_on_scheduler(gather)

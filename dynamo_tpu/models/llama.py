@@ -44,6 +44,8 @@ class LlamaConfig:
     tie_embeddings: bool = False
     max_context: int = 8192
     dtype: Any = jnp.bfloat16
+    # decode attention path: "auto" | "pallas" | "pallas_interpret" | "jnp"
+    attn_impl: str = "auto"
 
     @property
     def q_dim(self) -> int:
@@ -247,7 +249,8 @@ def decode(
             k_cache, v_cache, li, k[:, 0], v[:, 0], block_tables, ctx_lens
         )
         attn = paged_attention_decode(
-            q[:, 0], k_cache, v_cache, li, block_tables, ctx_lens + 1
+            q[:, 0], k_cache, v_cache, li, block_tables, ctx_lens + 1,
+            impl=cfg.attn_impl,
         )  # [B, nh, hd]
         x = x + attn.reshape(x.shape[0], cfg.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)

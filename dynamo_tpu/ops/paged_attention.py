@@ -4,9 +4,15 @@ The TPU replacement for the reference's only first-party GPU kernels
 (lib/kvbm-kernels/cuda/tensor_kernels.cu — block gather/scatter) plus the
 paged attention the reference delegates to vLLM/TRT-LLM.
 
-Cache layout (per tensor): [n_layers, num_blocks, block_size, n_kv_heads,
-head_dim] — block_size*n_kv_heads in the sublane dims and head_dim=lane dim,
-bf16, sharded over tp on the kv_heads axis (parallel/mesh.py:kv_cache_spec).
+Cache layout (per tensor): [n_layers, n_kv_heads, num_blocks, head_dim,
+block_size] — HEAD-MAJOR with TRANSPOSED blocks.  Head-major: one
+(head, block) slab is contiguous, so the Pallas decode kernel DMAs blocks
+by physical id as whole planes, and the tp sharding over kv_heads
+(parallel/mesh.py:kv_cache_spec) splits the cache into contiguous
+per-shard slabs.  Transposed ([hd, bs] instead of [bs, hd]): block_size is
+the TPU lane dimension, so with block_size a multiple of 128 the DMA slabs
+are lane-aligned for ANY head_dim (64-dim models included) and the
+kernel's two matmuls hit the MXU without in-kernel transposes.
 
 Conventions:
   * physical block 0 is the GARBAGE block: inactive slots' writes land there
@@ -15,9 +21,10 @@ Conventions:
     scalars and enforced with masks, so XLA compiles one program per bucket.
 
 These are the jnp reference implementations — numerically exact, fully
-fused-able by XLA.  ops/pallas_paged_attention.py provides the hand-tiled
-fast path for decode; the two are interchangeable and cross-checked in
-tests/test_paged_attention.py.
+fused-able by XLA.  ops/pallas_paged_attention.py is the hand-tiled fast
+path for decode; the two are interchangeable and cross-checked in
+tests/test_paged_attention.py.  `paged_attention_decode` dispatches between
+them ("auto" = Pallas on TPU, jnp elsewhere).
 """
 
 from __future__ import annotations
@@ -37,7 +44,7 @@ NEG_INF = -1e30
 
 
 def write_prompt_kv(
-    k_cache: jax.Array,  # [L, nblocks, bs, nkv, hd]
+    k_cache: jax.Array,  # [L, nkv, nblocks, hd, bs]
     v_cache: jax.Array,
     layer: int,
     k: jax.Array,        # [T, nkv, hd] new tokens' keys
@@ -47,17 +54,20 @@ def write_prompt_kv(
     true_len: jax.Array,     # scalar: valid entries of k/v
 ) -> Tuple[jax.Array, jax.Array]:
     T = k.shape[0]
-    bs = k_cache.shape[2]
+    bs = k_cache.shape[4]
     pos = ctx_len + jnp.arange(T, dtype=jnp.int32)  # absolute positions
     blocks = block_table[pos // bs]                 # [T]
     offsets = pos % bs
     valid = jnp.arange(T) < true_len
     # invalid rows scatter to the garbage block
     blocks = jnp.where(valid, blocks, 0)
-    k_cache = k_cache.at[layer, blocks, offsets].set(
+    # mixed indexing (scalar layer + slices + index arrays) moves the
+    # advanced dims to the FRONT: the target reads [T, nkv, hd] — exactly
+    # the token-major layout k/v arrive in (positions land on the lane dim)
+    k_cache = k_cache.at[layer, :, blocks, :, offsets].set(
         k.astype(k_cache.dtype), mode="drop"
     )
-    v_cache = v_cache.at[layer, blocks, offsets].set(
+    v_cache = v_cache.at[layer, :, blocks, :, offsets].set(
         v.astype(v_cache.dtype), mode="drop"
     )
     return k_cache, v_cache
@@ -72,14 +82,15 @@ def write_token_kv(
     block_tables: jax.Array,  # [B, max_blocks]
     ctx_lens: jax.Array,      # [B] position to write (== current length)
 ) -> Tuple[jax.Array, jax.Array]:
-    bs = k_cache.shape[2]
+    bs = k_cache.shape[4]
     B = k.shape[0]
     blocks = block_tables[jnp.arange(B), ctx_lens // bs]  # [B]
     offsets = ctx_lens % bs
-    k_cache = k_cache.at[layer, blocks, offsets].set(
+    # advanced dims front (see write_prompt_kv): target is [B, nkv, hd]
+    k_cache = k_cache.at[layer, :, blocks, :, offsets].set(
         k.astype(k_cache.dtype), mode="drop"
     )
-    v_cache = v_cache.at[layer, blocks, offsets].set(
+    v_cache = v_cache.at[layer, :, blocks, :, offsets].set(
         v.astype(v_cache.dtype), mode="drop"
     )
     return k_cache, v_cache
@@ -92,30 +103,30 @@ def write_token_kv(
 
 def _gather_ctx(cache: jax.Array, layer: int,
                 block_table: jax.Array) -> jax.Array:
-    """[L,nb,bs,nkv,hd] + [max_blocks] -> [max_blocks*bs, nkv, hd]."""
-    g = cache[layer, block_table]  # [max_blocks, bs, nkv, hd]
-    mb, bs, nkv, hd = g.shape
-    return g.reshape(mb * bs, nkv, hd)
+    """[L,nkv,nb,hd,bs] + [max_blocks] -> [nkv, max_blocks*bs, hd]."""
+    g = cache[layer][:, block_table]  # [nkv, max_blocks, hd, bs]
+    nkv, mb, hd, bs = g.shape
+    return g.swapaxes(2, 3).reshape(nkv, mb * bs, hd)
 
 
 def _gqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
-    """q [.., nh, hd] x k [S, nkv, hd] -> scores [.., nh, S] with GQA."""
+    """q [.., nh, hd] x k [nkv, S, hd] -> scores [.., nh, S] with GQA."""
     nh = q.shape[-2]
-    nkv = k.shape[-2]
+    nkv = k.shape[0]
     group = nh // nkv
     qg = q.reshape(*q.shape[:-2], nkv, group, q.shape[-1])
-    s = jnp.einsum("...kgh,skh->...kgs", qg.astype(jnp.float32),
+    s = jnp.einsum("...kgh,ksh->...kgs", qg.astype(jnp.float32),
                    k.astype(jnp.float32))
-    return s.reshape(*q.shape[:-2], nh, k.shape[0])
+    return s.reshape(*q.shape[:-2], nh, k.shape[1])
 
 
 def _gqa_out(p: jax.Array, v: jax.Array) -> jax.Array:
-    """p [.., nh, S] x v [S, nkv, hd] -> out [.., nh, hd]."""
+    """p [.., nh, S] x v [nkv, S, hd] -> out [.., nh, hd]."""
     nh = p.shape[-2]
-    nkv = v.shape[-2]
+    nkv = v.shape[0]
     group = nh // nkv
     pg = p.reshape(*p.shape[:-2], nkv, group, p.shape[-1])
-    o = jnp.einsum("...kgs,skh->...kgh", pg, v.astype(jnp.float32))
+    o = jnp.einsum("...kgs,ksh->...kgh", pg, v.astype(jnp.float32))
     return o.reshape(*p.shape[:-2], nh, v.shape[-1])
 
 
@@ -139,15 +150,17 @@ def paged_prefill_attention(
     T, nh, hd = q.shape
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
 
-    k_ctx = _gather_ctx(k_cache, layer, block_table)  # [S, nkv, hd]
+    k_ctx = _gather_ctx(k_cache, layer, block_table)  # [nkv, S, hd]
     v_ctx = _gather_ctx(v_cache, layer, block_table)
-    S = k_ctx.shape[0]
+    S = k_ctx.shape[1]
+    k_hm = k.swapaxes(0, 1)  # head-major [nkv, T, hd]
+    v_hm = v.swapaxes(0, 1)
 
     s_ctx = _gqa_scores(q, k_ctx) * scale            # [T, nh, S]
     ctx_mask = (jnp.arange(S) < ctx_len)[None, None, :]
     s_ctx = jnp.where(ctx_mask, s_ctx, NEG_INF)
 
-    s_self = _gqa_scores(q, k) * scale               # [T, nh, T]
+    s_self = _gqa_scores(q, k_hm) * scale            # [T, nh, T]
     i = jnp.arange(T)[:, None, None]
     j = jnp.arange(T)[None, None, :]
     causal = (j <= i) & (j < true_len)
@@ -155,11 +168,11 @@ def paged_prefill_attention(
 
     s = jnp.concatenate([s_ctx, s_self], axis=-1)    # [T, nh, S+T]
     p = jax.nn.softmax(s, axis=-1)
-    out = _gqa_out(p[..., :S], v_ctx) + _gqa_out(p[..., S:], v)
+    out = _gqa_out(p[..., :S], v_ctx) + _gqa_out(p[..., S:], v_hm)
     return out.astype(q.dtype)
 
 
-def paged_attention_decode(
+def paged_attention_decode_jnp(
     q: jax.Array,            # [B, nh, hd]
     k_cache: jax.Array,
     v_cache: jax.Array,
@@ -167,18 +180,56 @@ def paged_attention_decode(
     block_tables: jax.Array,  # [B, max_blocks]
     kv_lens: jax.Array,       # [B] valid tokens (incl. the one just written)
 ) -> jax.Array:
-    """Single-token batched paged attention (the decode hot loop)."""
+    """Reference jnp path: XLA materializes the gathered context."""
     B, nh, hd = q.shape
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
 
     def one(qb, table, kvlen):
-        kb = _gather_ctx(k_cache, layer, table)  # [S, nkv, hd]
+        kb = _gather_ctx(k_cache, layer, table)  # [nkv, S, hd]
         vb = _gather_ctx(v_cache, layer, table)
         s = _gqa_scores(qb, kb) * scale          # [nh, S]
-        mask = (jnp.arange(kb.shape[0]) < kvlen)[None, :]
+        mask = (jnp.arange(kb.shape[1]) < kvlen)[None, :]
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         return _gqa_out(p, vb)                   # [nh, hd]
 
     out = jax.vmap(one)(q, block_tables, kv_lens)
     return out.astype(q.dtype)
+
+
+def paged_attention_decode(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    layer: int,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,
+    impl: str = "auto",
+) -> jax.Array:
+    """Single-token batched paged attention (the decode hot loop).
+
+    impl: "auto" (Pallas kernel on TPU, jnp elsewhere), "pallas",
+    "pallas_interpret" (kernel under the interpreter — CPU testing),
+    or "jnp".
+    """
+    if impl == "auto":
+        # the compiled kernel needs lane-aligned blocks (bs % 128); smaller
+        # block sizes (tests, CPU configs) take the jnp path
+        bs = k_cache.shape[4]
+        impl = ("pallas" if jax.default_backend() == "tpu"
+                and bs % 128 == 0 else "jnp")
+    if impl in ("pallas", "pallas_interpret"):
+        from .pallas_paged_attention import paged_attention_decode_pallas
+
+        return paged_attention_decode_pallas(
+            q, k_cache, v_cache, layer, block_tables, kv_lens,
+            interpret=(impl == "pallas_interpret"),
+        )
+    if impl != "jnp":
+        raise ValueError(
+            f"unknown attention impl {impl!r}; expected auto | pallas | "
+            "pallas_interpret | jnp"
+        )
+    return paged_attention_decode_jnp(
+        q, k_cache, v_cache, layer, block_tables, kv_lens
+    )

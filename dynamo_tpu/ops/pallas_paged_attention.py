@@ -1,0 +1,204 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The hand-tiled fast path for the decode hot loop — the TPU counterpart of
+the reference's only first-party GPU kernels (the block gather/copy family
+in lib/kvbm-kernels/cuda/tensor_kernels.cu:151,192,494): where the CUDA
+kernels permute paged blocks through a universal layout, on TPU the same
+block-gather problem is fused INTO attention — each sequence's scattered
+KV blocks are DMA'd from HBM into VMEM by physical block id and consumed
+by an online-softmax accumulation without ever materializing a gathered
+context tensor in HBM (which is what the jnp fallback in paged_attention.py
+makes XLA do, and why that path measures ~80% of the decode step).
+
+Layout: the cache stores TRANSPOSED blocks, [n_kv, num_blocks, head_dim,
+block_size] per layer (paged_attention.py docstring).  block_size is the
+lane dimension, so with block_size a multiple of 128:
+  * every (head, block) DMA slab [hd, bs] is lane-aligned for ANY head_dim
+    (Mosaic rejects sub-128 lane slices; head_dim=64 models would otherwise
+    need padded storage);
+  * scores q[g,hd] @ k[hd,bs] and the p@v contraction are MXU-shaped with
+    no in-kernel reshapes or lane-splits (both unsupported on this Mosaic).
+
+Structure: grid = (batch,); block tables + kv lengths ride scalar prefetch
+(SMEM); per sequence, KV is consumed in chunks of `bpc` physical blocks,
+double-buffered (chunk c+1's DMAs fly while chunk c is reduced into fp32
+m/l/acc carries).  Padded table entries point at physical block 0 (the
+garbage block) and are masked by position, so shapes stay static.
+
+Numerics match paged_attention.paged_attention_decode_jnp exactly (fp32
+softmax accumulation); tests/test_paged_attention.py cross-checks the two,
+and interpret mode keeps the kernel runnable on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,   # [B, n_chunks * bpc] int32 physical block ids
+    kv_lens_ref,  # [B] int32 valid positions (incl. current token)
+    # inputs
+    q_ref,        # [1, nkv, group, hd] VMEM (this sequence's query)
+    k_hbm,        # [nkv, num_blocks, hd, bs] ANY (stays in HBM)
+    v_hbm,
+    # output
+    o_ref,        # [1, nkv, group, hd] VMEM
+    # scratch
+    k_buf,        # [2, nkv, bpc, hd, bs] VMEM
+    v_buf,
+    sem,          # DMA semaphores [2 slots, 2 (k/v)]
+    *,
+    bpc: int,
+    bs: int,
+):
+    b = pl.program_id(0)
+    nkv = k_hbm.shape[0]
+    hd = k_hbm.shape[2]
+    S = bpc * bs  # positions per chunk
+    kv_len = kv_lens_ref[b]
+    n_chunks = pl.cdiv(kv_len, S)
+
+    def chunk_copies(c, slot):
+        """Per-(head, block) DMAs for chunk c into buffer `slot`: each copy
+        is one full [hd, bs] plane — contiguous, lane-aligned for any hd."""
+        copies = []
+        for i in range(bpc):
+            pid = tables_ref[b, c * bpc + i]
+            for h in range(nkv):
+                copies.append(pltpu.make_async_copy(
+                    k_hbm.at[h, pid], k_buf.at[slot, h, i], sem.at[slot, 0],
+                ))
+                copies.append(pltpu.make_async_copy(
+                    v_hbm.at[h, pid], v_buf.at[slot, h, i], sem.at[slot, 1],
+                ))
+        return copies
+
+    def start_chunk(c, slot):
+        for cp in chunk_copies(c, slot):
+            cp.start()
+
+    def wait_chunk(c, slot):
+        for cp in chunk_copies(c, slot):
+            cp.wait()
+
+    start_chunk(0, 0)
+    q = q_ref[0].astype(jnp.float32)  # [nkv, group, hd]
+    g = q.shape[1]
+
+    def body(c, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < n_chunks)
+        def _():
+            start_chunk(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait_chunk(c, slot)
+        # one online-softmax update per block plane: every matmul is a
+        # single-contracting-dim batched 2D form Mosaic lowers directly
+        for i in range(bpc):
+            k = k_buf[slot, :, i].astype(jnp.float32)  # [nkv, hd, bs]
+            v = v_buf[slot, :, i].astype(jnp.float32)
+            # scores [nkv, g, bs]: q[g,hd] @ k[hd,bs] per kv head
+            s = jax.lax.dot_general(
+                q, k, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            pos = (c * bpc + i) * bs \
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(pos < kv_len, s, NEG_INF)
+
+            m_new = jnp.maximum(m, jnp.max(s, axis=2, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l = l * alpha + jnp.sum(p, axis=2, keepdims=True)
+            # out [nkv, g, hd]: p[g,bs] @ v[hd,bs]^T per kv head
+            pv = jax.lax.dot_general(
+                p, v, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            acc = acc * alpha + pv
+            m = m_new
+        return m, l, acc
+
+    m0 = jnp.full((nkv, g, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((nkv, g, 1), jnp.float32)
+    a0 = jnp.zeros((nkv, g, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, a0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("layer", "blocks_per_chunk", "interpret"),
+)
+def paged_attention_decode_pallas(
+    q: jax.Array,             # [B, nh, hd] (rope applied, NOT pre-scaled)
+    k_cache: jax.Array,       # [L, nkv, num_blocks, hd, bs]
+    v_cache: jax.Array,
+    layer: int,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    kv_lens: jax.Array,       # [B] int32, valid positions incl. current
+    *,
+    blocks_per_chunk: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in fast path for paged_attention.paged_attention_decode."""
+    B, nh, hd = q.shape
+    kc, vc = k_cache[layer], v_cache[layer]
+    nkv, _, _, bs = kc.shape
+    group = nh // nkv
+    max_blocks = block_tables.shape[1]
+
+    bpc = blocks_per_chunk or max(1, min(max_blocks, -(-256 // bs)))
+    n_chunks = -(-max_blocks // bpc)
+    pad = n_chunks * bpc - max_blocks
+    if pad:
+        # padded entries hit the garbage block (0) and are masked by pos
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    qg = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qg = qg.reshape(B, nkv, group, hd)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, bpc=bpc, bs=bs),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, nkv, group, hd),
+                             lambda b, *refs: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, nkv, group, hd),
+                                   lambda b, *refs: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, nkv, bpc, hd, bs), kc.dtype),
+                pltpu.VMEM((2, nkv, bpc, hd, bs), vc.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 2 * B * nh * hd * max_blocks * bs,
+            bytes_accessed=2 * B * nkv * max_blocks * bs * hd
+            * kc.dtype.itemsize,
+            transcendentals=B * nh * max_blocks * bs,
+        ),
+        interpret=interpret,
+    )(block_tables, kv_lens, qg, kc, vc)
+    return out.reshape(B, nh, hd)

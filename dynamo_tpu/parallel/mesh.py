@@ -94,6 +94,7 @@ def shard_params(params, mesh: Mesh):
 
 
 def kv_cache_spec() -> P:
-    """KV cache [layers, blocks, block_size, kv_heads, head_dim]: shard the
-    kv_heads axis over tp (same split as the attention heads)."""
-    return P(None, None, None, "tp", None)
+    """KV cache [layers, kv_heads, blocks, head_dim, block_size]: shard the
+    kv_heads axis over tp (same split as the attention heads).  Head-major
+    layout keeps each tp shard a single contiguous slab."""
+    return P(None, "tp", None, None, None)

@@ -1,0 +1,138 @@
+"""Pallas paged-attention decode kernel vs the jnp reference path.
+
+The two implementations are interchangeable (ops/paged_attention.py
+dispatch); these tests pin that equivalence on randomized shapes, including
+GQA grouping, partial blocks, garbage-block padding, and multi-chunk
+contexts (forcing the double-buffered DMA loop through >1 iteration).
+Runs the kernel under the Pallas interpreter so CPU CI covers it; the same
+code path compiles on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.ops.paged_attention import paged_attention_decode_jnp
+from dynamo_tpu.ops.pallas_paged_attention import paged_attention_decode_pallas
+
+
+def _mk_case(rng, *, B, nkv, group, hd, bs, max_blocks, L=2, dtype=jnp.float32):
+    num_blocks = 1 + B * max_blocks  # block 0 is garbage
+    shape = (L, nkv, num_blocks, hd, bs)  # transposed block layout
+    k_cache = jnp.asarray(rng.standard_normal(shape), dtype)
+    v_cache = jnp.asarray(rng.standard_normal(shape), dtype)
+    q = jnp.asarray(rng.standard_normal((B, nkv * group, hd)), dtype)
+    # each sequence owns a disjoint set of physical blocks, shuffled so
+    # gathers are genuinely scattered
+    tables = np.zeros((B, max_blocks), np.int32)
+    perm = rng.permutation(num_blocks - 1) + 1
+    for b in range(B):
+        tables[b] = perm[b * max_blocks:(b + 1) * max_blocks]
+    kv_lens = rng.integers(1, max_blocks * bs + 1, B).astype(np.int32)
+    # zero-out table entries beyond each sequence's context (garbage block)
+    for b in range(B):
+        used = -(-int(kv_lens[b]) // bs)
+        tables[b, used:] = 0
+    return q, k_cache, v_cache, jnp.asarray(tables), jnp.asarray(kv_lens)
+
+
+@pytest.mark.parametrize("case", [
+    dict(B=2, nkv=2, group=1, hd=16, bs=4, max_blocks=4),    # MHA-ish
+    dict(B=3, nkv=2, group=4, hd=32, bs=8, max_blocks=6),    # GQA
+    dict(B=1, nkv=1, group=8, hd=64, bs=16, max_blocks=9),   # MQA, odd blocks
+])
+def test_pallas_matches_jnp(case):
+    rng = np.random.default_rng(42)
+    q, kc, vc, tables, kv_lens = _mk_case(rng, **case)
+    for layer in range(2):
+        ref = paged_attention_decode_jnp(q, kc, vc, layer, tables, kv_lens)
+        out = paged_attention_decode_pallas(
+            q, kc, vc, layer, tables, kv_lens, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_pallas_matches_jnp_multichunk():
+    """Context long enough that the kernel's chunk loop runs > 1 iteration
+    (blocks_per_chunk forced small), exercising double-buffer slot reuse."""
+    rng = np.random.default_rng(7)
+    q, kc, vc, tables, kv_lens = _mk_case(
+        rng, B=2, nkv=2, group=2, hd=16, bs=4, max_blocks=8
+    )
+    kv_lens = jnp.asarray([29, 32], jnp.int32)  # partial + full final block
+    ref = paged_attention_decode_jnp(q, kc, vc, 0, tables, kv_lens)
+    out = paged_attention_decode_pallas(
+        q, kc, vc, 0, tables, kv_lens, blocks_per_chunk=2, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_pallas_bf16_tolerance():
+    rng = np.random.default_rng(3)
+    q, kc, vc, tables, kv_lens = _mk_case(
+        rng, B=2, nkv=2, group=2, hd=32, bs=8, max_blocks=4,
+        dtype=jnp.bfloat16,
+    )
+    ref = paged_attention_decode_jnp(q, kc, vc, 1, tables, kv_lens)
+    out = paged_attention_decode_pallas(
+        q, kc, vc, 1, tables, kv_lens, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=0.05, atol=0.05,
+    )
+
+
+async def test_engine_greedy_with_pallas_attention():
+    """End-to-end: the engine produces identical greedy tokens with the
+    Pallas decode path (interpret mode) and the jnp path."""
+    from dataclasses import replace
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    FP32 = LlamaConfig(name="tiny32", vocab_size=256, d_model=64, n_layers=2,
+                       n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,
+                       dtype=jnp.float32)
+
+    def greedy_req(tokens, n, rid):
+        return PreprocessedRequest(
+            token_ids=tokens, request_id=rid,
+            sampling=SamplingOptions(temperature=0.0, seed=0),
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+        )
+
+    async def collect(eng, req):
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids)
+        return toks
+
+    prompt = [5, 9, 13, 2, 7, 11, 3, 1, 8, 20]
+
+    async def run(impl):
+        cfg = EngineConfig(
+            model_config=replace(FP32, attn_impl=impl), block_size=4,
+            num_blocks=64, max_blocks_per_seq=8, max_num_seqs=2,
+            prefill_buckets=(8, 16), seed=7,
+        )
+        eng = JaxEngine(cfg)
+        toks = await collect(eng, greedy_req(list(prompt), 6, f"pl-{impl}"))
+        await eng.close()
+        return toks
+
+    pallas_toks = await run("pallas_interpret")
+    jnp_toks = await run("jnp")
+    # a crashed engine yields an empty stream — equality alone is vacuous
+    assert len(jnp_toks) == 6  # max_tokens generated (first + 5 decode)
+    assert pallas_toks == jnp_toks
