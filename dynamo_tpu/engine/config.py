@@ -26,6 +26,10 @@ class EngineConfig:
     # batching
     max_num_seqs: int = 8
     prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
+    # per-scheduler-step token budget: one prefill chunk is capped to
+    # max_batch_tokens minus one token per decoding slot, so decode ITL is
+    # bounded by a single chunk's compute (vLLM chunked-prefill semantics)
+    max_batch_tokens: int = 2048
 
     # parallelism
     dp: int = 1

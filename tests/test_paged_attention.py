@@ -93,30 +93,9 @@ async def test_engine_greedy_with_pallas_attention():
     Pallas decode path (interpret mode) and the jnp path."""
     from dataclasses import replace
 
+    from test_engine import FP32, collect, greedy_req
+
     from dynamo_tpu.engine import EngineConfig, JaxEngine
-    from dynamo_tpu.models.llama import LlamaConfig
-    from dynamo_tpu.protocols import (
-        PreprocessedRequest,
-        SamplingOptions,
-        StopConditions,
-    )
-
-    FP32 = LlamaConfig(name="tiny32", vocab_size=256, d_model=64, n_layers=2,
-                       n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,
-                       dtype=jnp.float32)
-
-    def greedy_req(tokens, n, rid):
-        return PreprocessedRequest(
-            token_ids=tokens, request_id=rid,
-            sampling=SamplingOptions(temperature=0.0, seed=0),
-            stop=StopConditions(max_tokens=n, ignore_eos=True),
-        )
-
-    async def collect(eng, req):
-        toks = []
-        async for out in eng.generate(req):
-            toks.extend(out.token_ids)
-        return toks
 
     prompt = [5, 9, 13, 2, 7, 11, 3, 1, 8, 20]
 
