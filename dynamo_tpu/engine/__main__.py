@@ -16,6 +16,8 @@ from .worker import JaxEngineWorker
 def build_args() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("dynamo_tpu.engine")
     p.add_argument("--model", default="tiny", help="model preset name")
+    p.add_argument("--model-path", default="",
+                   help="local HF checkpoint dir (overrides --model)")
     p.add_argument("--model-name", default="", help="served model name")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--component", default="backend")
@@ -37,6 +39,7 @@ async def main() -> None:
     args = build_args().parse_args()
     config = EngineConfig(
         model=args.model,
+        model_path=args.model_path,
         model_name=args.model_name,
         block_size=args.block_size,
         num_blocks=args.num_blocks,

@@ -14,6 +14,9 @@ class EngineConfig:
     model: str = "tiny"  # preset name (models/llama.py PRESETS)
     model_config: Optional[LlamaConfig] = None
     model_name: str = ""  # served model name; defaults to preset name
+    # local HF checkpoint dir (config.json + *.safetensors + tokenizer);
+    # when set it overrides `model` and the engine serves real weights
+    model_path: str = ""
 
     # paged KV cache.  Default block_size is 128 (lane-aligned) so the
     # Pallas decode kernel's auto-dispatch engages on TPU; CPU/test configs
@@ -39,12 +42,17 @@ class EngineConfig:
     # prefill-only hops and park KV; "decode" workers pull and decode
     role: str = "both"
 
-    eos_token_id: int = 2
+    # None = resolve from the checkpoint's config.json (model_path) or 2
+    eos_token_id: Optional[int] = None
     seed: int = 0
 
     def resolve_model(self) -> LlamaConfig:
         if self.model_config is not None:
             return self.model_config
+        if self.model_path:
+            from .loader_cache import cached_hf_config
+
+            return cached_hf_config(self.model_path)
         if self.model not in PRESETS:
             raise ValueError(
                 f"unknown model preset {self.model!r}; have {sorted(PRESETS)}"
@@ -58,3 +66,11 @@ class EngineConfig:
     @property
     def max_context(self) -> int:
         return self.block_size * self.max_blocks_per_seq
+
+    def resolve_eos_ids(self) -> Tuple[int, ...]:
+        """Stop-token set: explicit override > checkpoint config > default.
+        The checkpoint path reuses cached_hf_config (one config.json parse
+        per path, same error surface as resolve_model)."""
+        if self.eos_token_id is not None:
+            return (self.eos_token_id,)
+        return self.resolve_model().eos_token_ids

@@ -115,16 +115,25 @@ class JaxEngine:
         )
         self.kv_event_sink = kv_event_sink
         self.kv_pull_fn = kv_pull_fn
+        self.eos_ids = frozenset(config.resolve_eos_ids())
         self.allocator = BlockAllocator(
             config.num_blocks, config.enable_prefix_caching
         )
 
         with self.mesh:
-            if params is None:
-                params = llama.init_params(
-                    self.model_cfg, jax.random.PRNGKey(config.seed)
+            if params is None and config.model_path:
+                from ..models.loader import load_params
+
+                # already placed shard-by-shard onto the mesh
+                self.params = load_params(
+                    config.model_path, self.model_cfg, mesh=self.mesh
                 )
-            self.params = shard_params(params, self.mesh)
+            else:
+                if params is None:
+                    params = llama.init_params(
+                        self.model_cfg, jax.random.PRNGKey(config.seed)
+                    )
+                self.params = shard_params(params, self.mesh)
             self.kv = self._init_kv_cache()
 
         self._jit_decode = jax.jit(
@@ -793,7 +802,7 @@ class JaxEngine:
 
     def _finish_reason(self, slot: _Slot, tok: int) -> Optional[str]:
         st = slot.request.stop
-        if not st.ignore_eos and tok == self.config.eos_token_id:
+        if not st.ignore_eos and tok in self.eos_ids:
             return "stop"
         if tok in (st.stop_token_ids or []):
             return "stop"

@@ -37,9 +37,30 @@ class JaxEngineWorker:
         self.namespace = namespace
         self.component = component
         self.migration_limit = migration_limit
-        self.tokenizer_cfg = tokenizer_cfg or {
-            "type": "mock", "vocab_size": config.resolve_model().vocab_size
-        }
+        self._chat_template: Optional[str] = None
+        if tokenizer_cfg is None:
+            if config.model_path:
+                import os
+
+                from ..models.loader import load_chat_template
+
+                eos_ids = config.resolve_eos_ids()
+                # ship the tokenizer as an inline blob so frontends on
+                # other hosts can build it (a worker-local path would not
+                # resolve there)
+                tok_json = os.path.join(config.model_path, "tokenizer.json")
+                with open(tok_json) as f:
+                    tokenizer_cfg = {
+                        "type": "hf", "json": f.read(),
+                        "eos_id": eos_ids[0] if eos_ids else None,
+                    }
+                self._chat_template = load_chat_template(config.model_path)
+            else:
+                tokenizer_cfg = {
+                    "type": "mock",
+                    "vocab_size": config.resolve_model().vocab_size,
+                }
+        self.tokenizer_cfg = tokenizer_cfg
         self._params = params
         self.engine: Optional[JaxEngine] = None
         self.publisher: Optional[KvEventPublisher] = None
@@ -56,6 +77,7 @@ class JaxEngineWorker:
             component=self.component,
             endpoint="generate",
             tokenizer=self.tokenizer_cfg,
+            chat_template=self._chat_template,
             context_length=min(m.max_context, self.config.max_context),
             kv_cache_block_size=self.config.block_size,
             migration_limit=self.migration_limit,

@@ -70,13 +70,18 @@ class MockTokenizer(Tokenizer):
 
 
 class HFTokenizer(Tokenizer):
-    """HF `tokenizers` tokenizer from a local tokenizer.json path or blob."""
+    """HF `tokenizers` tokenizer from a local tokenizer.json (or a model
+    directory containing one) or an inline json blob."""
 
     def __init__(self, path: Optional[str] = None, json_blob: Optional[str] = None,
                  eos_id: Optional[int] = None):
+        import os
+
         from tokenizers import Tokenizer as _HFTok
 
         if path:
+            if os.path.isdir(path):
+                path = os.path.join(path, "tokenizer.json")
             self._tok = _HFTok.from_file(path)
         elif json_blob:
             self._tok = _HFTok.from_str(json_blob)

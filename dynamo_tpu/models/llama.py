@@ -15,7 +15,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple  # noqa: F401 (Tuple in cfg)
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +46,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # decode attention path: "auto" | "pallas" | "pallas_interpret" | "jnp"
     attn_impl: str = "auto"
+    # stop-token set (instruct checkpoints often declare several, e.g.
+    # llama-3's <|end_of_text|> and <|eot_id|>)
+    eos_token_ids: Tuple[int, ...] = (2,)
 
     @property
     def q_dim(self) -> int:

@@ -1,0 +1,219 @@
+"""HF checkpoint loading: safetensors -> sharded params pytree.
+
+The TPU counterpart of the reference's model-resolution path — every
+reference backend starts by fetching and loading real weights
+(components/src/dynamo/vllm/main.py:114 fetch_model,
+lib/llm/src/local_model/, hub/huggingface.rs).  Here a local HF model
+directory (config.json + *.safetensors) is mapped onto the llama.py params
+pytree and placed shard-by-shard with jax.device_put per
+param_sharding_rules(), so a 70B checkpoint never needs to fit on one
+chip's HBM as a whole: each weight goes host -> its tp shards directly.
+
+Name mapping (HF Llama/Qwen3 -> ours; HF nn.Linear stores [out, in], our
+matmuls are x @ W so projections transpose):
+
+    model.embed_tokens.weight              embedding        [vocab, d]
+    lm_head.weight                         lm_head          [d, vocab] (T)
+    model.norm.weight                      final_norm.norm
+    ...layers.N.self_attn.q_proj.weight    layers[N].wq     (T)
+    ...layers.N.self_attn.{k,v}_proj       layers[N].wk/wv  (T)
+    ...layers.N.self_attn.o_proj           layers[N].wo     (T)
+    ...layers.N.self_attn.{q,k}_norm       layers[N].q_norm/k_norm (Qwen3)
+    ...layers.N.input_layernorm            layers[N].attn_norm.norm
+    ...layers.N.post_attention_layernorm   layers[N].mlp_norm.norm
+    ...layers.N.mlp.{gate,up,down}_proj    layers[N].w_gate/w_up/w_down (T)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import param_sharding_rules
+from .llama import LlamaConfig
+
+_ARCHS = {
+    "LlamaForCausalLM": {},
+    "MistralForCausalLM": {},
+    "Qwen2ForCausalLM": {},
+    "Qwen3ForCausalLM": {"qk_norm": True},
+}
+
+
+def load_hf_config(model_path: str, dtype=jnp.bfloat16) -> LlamaConfig:
+    """config.json -> LlamaConfig (dense Llama-family architectures)."""
+    with open(os.path.join(model_path, "config.json")) as f:
+        hf = json.load(f)
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if arch not in _ARCHS:
+        raise ValueError(
+            f"unsupported architecture {arch!r}; have {sorted(_ARCHS)}"
+        )
+    n_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // n_heads
+    eos = hf.get("eos_token_id", 2)
+    eos_ids = tuple(int(e) for e in eos) if isinstance(eos, list) else (
+        (int(eos),) if eos is not None else ()
+    )
+    return LlamaConfig(
+        name=os.path.basename(os.path.abspath(model_path)) or hf.get(
+            "model_type", "hf-model"),
+        vocab_size=hf["vocab_size"],
+        d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=n_heads,
+        n_kv_heads=hf.get("num_key_value_heads", n_heads),
+        head_dim=head_dim,
+        ffn_dim=hf["intermediate_size"],
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        max_context=int(hf.get("max_position_embeddings", 8192)),
+        dtype=dtype,
+        eos_token_ids=eos_ids or (2,),
+        **_ARCHS[arch],
+    )
+
+
+def load_chat_template(model_path: str) -> Optional[str]:
+    """The checkpoint's chat template (tokenizer_config.json or the
+    standalone chat_template.jinja), if any."""
+    jinja = os.path.join(model_path, "chat_template.jinja")
+    if os.path.exists(jinja):
+        with open(jinja) as f:
+            return f.read()
+    tc = os.path.join(model_path, "tokenizer_config.json")
+    try:
+        with open(tc) as f:
+            tmpl = json.load(f).get("chat_template")
+        return tmpl if isinstance(tmpl, str) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+_LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+# HF suffix -> (our key, transpose?)
+_LAYER_MAP = {
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "self_attn.q_norm.weight": ("q_norm", False),
+    "self_attn.k_norm.weight": ("k_norm", False),
+    "input_layernorm.weight": ("attn_norm", False),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+}
+
+_NORM_KEYS = {"attn_norm", "mlp_norm", "q_norm", "k_norm"}
+
+
+def _iter_safetensors(model_path: str):
+    from safetensors import safe_open
+
+    files = sorted(
+        f for f in os.listdir(model_path) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no *.safetensors under {model_path}")
+    for fname in files:
+        with safe_open(os.path.join(model_path, fname), framework="np") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def load_params(
+    model_path: str,
+    cfg: Optional[LlamaConfig] = None,
+    mesh=None,
+) -> Dict[str, Any]:
+    """Load a HF checkpoint into the llama.py params pytree.
+
+    With a mesh, each tensor is device_put directly to its NamedSharding
+    (per-weight streaming: host RAM holds one tensor at a time beyond the
+    checkpoint mmap).  Without, arrays stay as committed jax arrays on the
+    default device.
+    """
+    from jax.sharding import NamedSharding
+
+    cfg = cfg or load_hf_config(model_path)
+    rules = param_sharding_rules()
+
+    def put(name_key: str, arr: np.ndarray):
+        arr = jnp.asarray(arr)
+        if mesh is not None:
+            return jax.device_put(
+                arr, NamedSharding(mesh, rules.get(name_key, jax.sharding.PartitionSpec()))
+            )
+        return arr
+
+    norm_dt = jnp.float32
+    params: Dict[str, Any] = {
+        "layers": [dict() for _ in range(cfg.n_layers)]
+    }
+    seen = set()
+    for name, tensor in _iter_safetensors(model_path):
+        m = _LAYER_RE.match(name)
+        if m:
+            li, suffix = int(m.group(1)), m.group(2)
+            if suffix not in _LAYER_MAP:
+                raise ValueError(f"unmapped layer tensor {name!r}")
+            key, transpose = _LAYER_MAP[suffix]
+            t = tensor.T if transpose else tensor
+            if key in _NORM_KEYS:
+                params["layers"][li][key] = {
+                    "norm": jnp.asarray(t).astype(norm_dt)
+                }
+            else:
+                params["layers"][li][key] = put(
+                    key, np.ascontiguousarray(t).astype(cfg.dtype)
+                )
+        elif name == "model.embed_tokens.weight":
+            params["embedding"] = put(
+                "embedding", tensor.astype(cfg.dtype))
+        elif name == "lm_head.weight":
+            params["lm_head"] = put(
+                "lm_head", np.ascontiguousarray(tensor.T).astype(cfg.dtype))
+        elif name == "model.norm.weight":
+            params["final_norm"] = {
+                "norm": jnp.asarray(tensor).astype(norm_dt)
+            }
+        else:
+            raise ValueError(f"unmapped tensor {name!r}")
+        seen.add(name)
+
+    if cfg.tie_embeddings:
+        params.pop("lm_head", None)
+    elif "lm_head" not in params:
+        # some tied checkpoints omit lm_head but don't set the flag
+        params["lm_head"] = put(
+            "lm_head",
+            np.ascontiguousarray(
+                np.asarray(params["embedding"]).T).astype(cfg.dtype),
+        )
+
+    missing = []
+    if "embedding" not in params:
+        missing.append("model.embed_tokens.weight")
+    if "final_norm" not in params:
+        missing.append("model.norm.weight")
+    want = set(_LAYER_MAP)
+    if not cfg.qk_norm:
+        want -= {"self_attn.q_norm.weight", "self_attn.k_norm.weight"}
+    for li, layer in enumerate(params["layers"]):
+        got = len(layer)
+        if got != len(want):
+            missing.append(f"model.layers.{li} ({got}/{len(want)} tensors)")
+    if missing:
+        raise ValueError(f"incomplete checkpoint {model_path}: missing "
+                         f"{missing[:5]}")
+    return params

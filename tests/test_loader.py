@@ -1,0 +1,139 @@
+"""Weight loading: HF safetensors checkpoint -> params pytree -> engine.
+
+Ground truth is the transformers CPU forward pass on the SAME randomly
+initialized tiny checkpoint: if our prefill logits match HF's logits
+position-by-position, the name mapping, transposes, norms, rope, and GQA
+wiring are all correct — the strongest parity signal available without
+network access (ref: reference backends load real weights before serving,
+components/src/dynamo/vllm/main.py:114).
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+TINY_HF = dict(
+    architectures=["LlamaForCausalLM"],
+    hidden_size=64,
+    intermediate_size=128,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    num_hidden_layers=2,
+    vocab_size=256,
+    rms_norm_eps=1e-5,
+    rope_theta=10000.0,
+    max_position_embeddings=512,
+    tie_word_embeddings=False,
+    torch_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tiny-llama-hf")
+    cfg = transformers.LlamaConfig(**{
+        k: v for k, v in TINY_HF.items() if k != "architectures"
+    })
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path), model
+
+
+def test_hf_config_mapping(tiny_checkpoint):
+    from dynamo_tpu.models.loader import load_hf_config
+
+    path, _ = tiny_checkpoint
+    cfg = load_hf_config(path, dtype=jnp.float32)
+    assert cfg.d_model == 64
+    assert cfg.n_heads == 4
+    assert cfg.n_kv_heads == 2
+    assert cfg.head_dim == 16
+    assert cfg.n_layers == 2
+    assert cfg.vocab_size == 256
+    assert not cfg.qk_norm
+
+
+def test_loaded_prefill_matches_hf_logits(tiny_checkpoint):
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.loader import load_hf_config, load_params
+
+    path, hf_model = tiny_checkpoint
+    cfg = load_hf_config(path, dtype=jnp.float32)
+    params = load_params(path, cfg)
+
+    token_ids = [5, 9, 13, 2, 7, 11, 3, 1, 8, 20, 100, 255]
+    T = len(token_ids)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([token_ids])).logits[0].numpy()
+
+    # drive our prefill through the paged cache, one block at a time
+    bs, nblocks = 4, 8
+    kv = tuple(
+        jnp.zeros((cfg.n_layers, cfg.n_kv_heads, nblocks, cfg.head_dim, bs),
+                  cfg.dtype)
+        for _ in range(2)
+    )
+    table = jnp.asarray(np.arange(1, nblocks + 1, dtype=np.int32) % nblocks)
+    # prefill the full prompt; compare last-position logits
+    logits, kv = llama.prefill(
+        params, cfg, kv,
+        jnp.asarray(np.asarray(token_ids, np.int32)),
+        jnp.arange(T, dtype=jnp.int32), table,
+        jnp.int32(0), jnp.int32(T),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), ref[-1], rtol=2e-4, atol=2e-4
+    )
+
+
+async def test_engine_serves_real_checkpoint_greedy_matches_hf(
+    tiny_checkpoint,
+):
+    """End-to-end: the engine loads the checkpoint from disk and its greedy
+    continuation equals HF's greedy decoding."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    path, hf_model = tiny_checkpoint
+    prompt = [5, 9, 13, 2, 7, 11, 3, 1]
+    n_gen = 6
+    with torch.no_grad():
+        out = hf_model.generate(
+            torch.tensor([prompt]), max_new_tokens=n_gen, do_sample=False,
+            num_beams=1, pad_token_id=0,
+        )[0][len(prompt):].tolist()
+
+    from dynamo_tpu.models.loader import load_hf_config
+
+    cfg = EngineConfig(
+        model_path=path,
+        model_config=None,
+        block_size=4, num_blocks=64, max_blocks_per_seq=16,
+        max_num_seqs=2, prefill_buckets=(8, 16), seed=3,
+    )
+    # force fp32 to match the fp32 HF reference exactly
+    from dataclasses import replace
+    cfg.model_config = replace(
+        load_hf_config(path, dtype=jnp.float32), attn_impl="jnp")
+    eng = JaxEngine(cfg)
+    req = PreprocessedRequest(
+        token_ids=list(prompt), request_id="hf1",
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=n_gen, ignore_eos=True),
+    )
+    toks = []
+    async for o in eng.generate(req):
+        toks.extend(o.token_ids)
+    await eng.close()
+    assert toks == out
