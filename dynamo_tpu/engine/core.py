@@ -230,7 +230,10 @@ class JaxEngine:
 
     def _fail_all_streams(self) -> None:
         """Terminate every in-flight stream (shutdown or loop crash)."""
-        err = LLMEngineOutput(finish_reason="error")
+        err = LLMEngineOutput(
+            finish_reason="error",
+            error="worker engine error: engine loop failed or shut down",
+        )
         with self._qlock:
             stuck = list(self.waiting) + [
                 s for s in self._slots if s is not None
@@ -253,7 +256,11 @@ class JaxEngine:
     ) -> AsyncIterator[LLMEngineOutput]:
         self.start()
         if len(request.token_ids) >= self.config.max_context:
-            yield LLMEngineOutput(finish_reason="error")
+            yield LLMEngineOutput(
+                finish_reason="error",
+                error=f"prompt is {len(request.token_ids)} tokens; engine "
+                      f"max_context is {self.config.max_context}",
+            )
             return
         preloaded = None
         dp = request.disaggregated_params

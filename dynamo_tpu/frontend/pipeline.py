@@ -20,7 +20,7 @@ from .preprocessor import OpenAIPreprocessor
 logger = logging.getLogger(__name__)
 
 MIGRATABLE_MARKERS = ("connection lost", "no handler", "worker draining",
-                      "not found")
+                      "not found", "worker engine error")
 
 
 def is_migratable(err: Exception) -> bool:
@@ -74,6 +74,15 @@ class MigrationOperator:
                         req.to_dict(), instance_id=instance_id, token=token
                     ):
                         out = LLMEngineOutput.from_dict(item)
+                        if out.finish_reason == "error":
+                            # not a completion: surface as an error (HTTP
+                            # 5xx / SSE error upstream).  Worker-side
+                            # failures carry the "worker engine error"
+                            # marker and migrate; request errors don't.
+                            raise EngineError(
+                                out.error or "worker engine error for "
+                                f"request {request.request_id}"
+                            )
                         if first and out.token_ids:
                             first = False
                             if hasattr(route, "mark_prefill_completed"):

@@ -130,6 +130,10 @@ class LLMEngineOutput:
     kv_transfer_params: Optional[Dict[str, Any]] = None
     # engine-side observability (FPM): step latency, queue depth, etc.
     metrics: Optional[Dict[str, Any]] = None
+    # set when finish_reason == "error": what failed.  "worker engine
+    # error" prefixed messages are migratable (worker-side failure);
+    # anything else is a terminal request error.
+    error: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"token_ids": list(self.token_ids)}
@@ -141,6 +145,8 @@ class LLMEngineOutput:
             d["kv_transfer_params"] = self.kv_transfer_params
         if self.metrics is not None:
             d["metrics"] = self.metrics
+        if self.error is not None:
+            d["error"] = self.error
         return d
 
     @staticmethod
@@ -151,4 +157,5 @@ class LLMEngineOutput:
             cum_log_prob=d.get("cum_log_prob"),
             kv_transfer_params=d.get("kv_transfer_params"),
             metrics=d.get("metrics"),
+            error=d.get("error"),
         )
