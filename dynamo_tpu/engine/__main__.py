@@ -28,6 +28,11 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--no-prefix-caching", action="store_true")
+    p.add_argument("--host-cache-blocks", type=int, default=0,
+                   help="G2 host-DRAM KV cache capacity (blocks); 0 off")
+    p.add_argument("--disk-cache-dir", default="",
+                   help="G3 disk KV cache directory")
+    p.add_argument("--disk-cache-blocks", type=int, default=0)
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--role", default="both",
                    choices=["both", "prefill", "decode"])
@@ -48,6 +53,9 @@ async def main() -> None:
         tp=args.tp,
         dp=args.dp,
         enable_prefix_caching=not args.no_prefix_caching,
+        host_cache_blocks=args.host_cache_blocks,
+        disk_cache_dir=args.disk_cache_dir or None,
+        disk_cache_blocks=args.disk_cache_blocks,
         role=args.role,
     )
     rt = await DistributedRuntime.detached().start()

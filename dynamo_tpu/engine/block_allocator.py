@@ -73,6 +73,29 @@ class BlockAllocator:
     def seq_block_ids(self, seq_id: str) -> List[int]:
         return self._seq_blocks.get(seq_id, [])
 
+    def coldest_evictable(self, n: int, exclude=(),
+                          scan_limit: Optional[int] = None
+                          ) -> List[Tuple[int, int]]:
+        """Up to n (hash, block_id) pairs from the cold end of the LRU,
+        skipping `exclude` hashes — offload candidates (the blocks the next
+        evictions would destroy).  Does not mutate.
+
+        scan_limit bounds the walk: once the cold end is fully excluded
+        (already offloaded), an unbounded scan would cost O(num_blocks) of
+        Python per scheduler step for an empty result.  Candidates cluster at
+        the cold end and excluded entries there are evicted by allocation, so
+        a bounded scan still finds fresh cold blocks as the head refreshes."""
+        out: List[Tuple[int, int]] = []
+        for i, h in enumerate(self._lru):
+            if scan_limit is not None and i >= scan_limit:
+                break
+            if h in exclude:
+                continue
+            out.append((h, self._hash_to_block[h]))
+            if len(out) >= n:
+                break
+        return out
+
     # -- internals --------------------------------------------------------
     def _evict_one(self, removed: List[int]) -> Optional[int]:
         if not self._lru:
@@ -127,12 +150,11 @@ class BlockAllocator:
             assert bid is not None, "capacity invariant violated"
             self._block_ref[bid] = 1
             res.block_ids.append(bid)
-            if i < len(hashes):
-                h = hashes[i]
-                if h not in self._hash_to_block and self.enable_prefix_caching:
-                    self._hash_to_block[h] = bid
-                    self._block_hash[bid] = h
-                    res.stored.append(h)
+        # Registration of the non-hit full blocks is DEFERRED to
+        # commit_block, once prefill has materialized their K/V: registering
+        # here would let a concurrent same-prefix request prefix-match
+        # blocks whose cache contents are still zeros (the engine interleaves
+        # prefill chunks with other admissions).
         self._seq_blocks[seq_id] = list(res.block_ids)
         return res
 

@@ -34,6 +34,17 @@ class EngineConfig:
     # bounded by a single chunk's compute (vLLM chunked-prefill semantics)
     max_batch_tokens: int = 2048
 
+    # KVBM tiers (kvbm/): 0 disables the G2 host cache.  When enabled, the
+    # scheduler offloads the coldest evictable HBM blocks to host DRAM once
+    # free blocks fall below offload_watermark_blocks (one batched
+    # device→host gather per step), and onboards G2/G3 prefix hits at
+    # admission instead of recomputing prefill.
+    host_cache_blocks: int = 0
+    disk_cache_dir: Optional[str] = None   # G3; needs disk_cache_blocks > 0
+    disk_cache_blocks: int = 0
+    offload_watermark_blocks: int = 0      # 0 = num_blocks // 4
+    offload_batch: int = 16                # max blocks gathered per step
+
     # parallelism
     dp: int = 1
     tp: int = 1

@@ -50,6 +50,20 @@ def _set_result_safe(fut: asyncio.Future, value) -> None:
         fut.set_result(value)
 
 
+def _pow2_ids(block_ids) -> np.ndarray:
+    """Block ids zero-padded to the next power of two: bounds the number of
+    distinct shapes reaching jit (one recompile per bucket), and padded ids
+    target the reserved garbage block 0, so gathers read junk the host
+    slices off and scatters write harmlessly."""
+    n = len(block_ids)
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    ids = np.zeros(bucket, np.int32)
+    ids[:n] = block_ids
+    return ids
+
+
 @dataclass
 class _Slot:
     index: int
@@ -114,10 +128,51 @@ class JaxEngine:
             MeshConfig(dp=config.dp, tp=config.tp)
         )
         self.kv_event_sink = kv_event_sink
+        self._sink_takes_tier = False
+        if kv_event_sink is not None:
+            try:
+                sink_params = list(
+                    inspect.signature(kv_event_sink).parameters.values()
+                )
+                kinds = inspect.Parameter
+                self._sink_takes_tier = (
+                    sum(p.kind in (kinds.POSITIONAL_ONLY,
+                                   kinds.POSITIONAL_OR_KEYWORD)
+                        for p in sink_params) >= 3
+                    or any(p.kind == kinds.VAR_POSITIONAL
+                           for p in sink_params)
+                )
+            except (TypeError, ValueError):
+                pass
         self.kv_pull_fn = kv_pull_fn
         self.eos_ids = frozenset(config.resolve_eos_ids())
         self.allocator = BlockAllocator(
             config.num_blocks, config.enable_prefix_caching
+        )
+        # KVBM tiers: router-visible events for ALL tiers are netted through
+        # the consolidator, so a block offloaded to G2 survives G1 eviction
+        # in the router's view (kvbm/consolidator.py)
+        from ..kvbm import KvEventConsolidator, TieredKvManager
+
+        self._consolidator = KvEventConsolidator()
+        self.kvbm: Optional[TieredKvManager] = None
+        if config.disk_cache_dir and config.host_cache_blocks <= 0:
+            raise ValueError(
+                "disk_cache_dir (G3) requires host_cache_blocks > 0: the "
+                "disk tier is fed only by demotion from the host tier"
+            )
+        if config.disk_cache_dir and config.disk_cache_blocks <= 0:
+            raise ValueError(
+                "disk_cache_dir (G3) requires disk_cache_blocks > 0"
+            )
+        if config.host_cache_blocks > 0:
+            self.kvbm = TieredKvManager(
+                config.host_cache_blocks,
+                disk_dir=config.disk_cache_dir,
+                disk_blocks=config.disk_cache_blocks,
+            )
+        self._offload_watermark = (
+            config.offload_watermark_blocks or config.num_blocks // 4
         )
 
         with self.mesh:
@@ -143,6 +198,7 @@ class JaxEngine:
             partial(self._prefill_impl, self.model_cfg), donate_argnums=(1,)
         )
         self._jit_inject = jax.jit(self._inject_impl, donate_argnums=(0,))
+        self._jit_gather = jax.jit(self._gather_impl)
 
         self.waiting: List[_Slot] = []
         self._sched_calls: List[tuple] = []  # (fn, future) run between steps
@@ -200,6 +256,17 @@ class JaxEngine:
         k = k.at[:, :, ids].set(kb.astype(k.dtype))
         v = v.at[:, :, ids].set(vb.astype(v.dtype))
         return (k, v)
+
+    @staticmethod
+    def _gather_impl(kv, ids):
+        """Gather blocks out of the cache into the universal transfer layout
+        [L, nb, bs, nkv, hd] (block_to_universal analogue,
+        lib/kvbm-kernels/cuda/tensor_kernels.cu:151).  Padded ids read the
+        garbage block; the host slices them off."""
+        k, v = kv
+        kb = jnp.transpose(k[:, :, ids], (0, 2, 4, 1, 3))
+        vb = jnp.transpose(v[:, :, ids], (0, 2, 4, 1, 3))
+        return kb, vb
 
     @staticmethod
     def _prefill_impl(model_cfg, params, kv, tokens, positions, block_table,
@@ -338,23 +405,50 @@ class JaxEngine:
     def _seq_id(self, slot: _Slot) -> str:
         return slot.request.request_id
 
-    def _emit_events(self, res) -> None:
+    def _emit_events(self, res, tier: str = "g1") -> None:
         """Thread-safe KV event emission (called from the scheduler thread).
 
-        The sink may be synchronous (preferred: enqueue + serialized publish,
-        see KvEventPublisher.enqueue_batch) or an async callable.  Either way
-        it is invoked on the loop thread via call_soon_threadsafe, whose FIFO
-        callback ordering keeps wire order equal to mutation order."""
-        if res is None or self.kv_event_sink is None:
+        Mutations are first folded through the cross-tier consolidator so
+        routers see net ownership (stored on first tier entered, removed on
+        last tier left).  The sink may be synchronous (preferred: enqueue +
+        serialized publish, see KvEventPublisher.enqueue_batch) or an async
+        callable.  Either way it is invoked on the loop thread via
+        call_soon_threadsafe, whose FIFO callback ordering keeps wire order
+        equal to mutation order."""
+        if res is None:
             return
         stored = list(getattr(res, "stored", []))
         removed = list(getattr(res, "removed", []))
         if not (stored or removed):
             return
+        # G1 evictions of blocks that were offloaded must not drop the G2/G3
+        # copy — the consolidator handles the netting; the pools themselves
+        # only drop on their own capacity pressure.
+        net_stored, net_removed, _ = self._consolidator.apply(
+            stored, removed, tier
+        )
+        self._dispatch_events(net_stored, net_removed, tier)
+
+    def _emit_tier_events(self, batches) -> None:
+        """Emit [(stored, removed, tier), ...] batches from the KVBM manager
+        (already per-tier; still netted through the consolidator)."""
+        for stored, removed, tier in batches:
+            self._emit_events(
+                SimpleNamespace(stored=stored, removed=removed), tier=tier
+            )
+
+    def _dispatch_events(self, stored, removed, tier: str) -> None:
+        if self.kv_event_sink is None or not (stored or removed):
+            return
         sink = self.kv_event_sink
+        takes_tier = self._sink_takes_tier
+
+        def call():
+            return sink(stored, removed, tier) if takes_tier \
+                else sink(stored, removed)
 
         def dispatch():
-            r = sink(stored, removed)
+            r = call()
             if inspect.isawaitable(r):
                 asyncio.ensure_future(r)
 
@@ -363,7 +457,7 @@ class JaxEngine:
         else:
             # pre-start only (no loop yet): nothing is routing yet, so an
             # async sink's events can be dropped safely
-            r = sink(stored, removed)
+            r = call()
             if inspect.isawaitable(r):
                 r.close()
 
@@ -410,6 +504,8 @@ class JaxEngine:
             # against stores from the next step (a later stored(H) for a
             # re-admitted prefix must reach the wire after this removed(H))
             self._emit_events(SimpleNamespace(stored=[], removed=removed))
+            if self.kvbm is not None:
+                self._emit_tier_events(self.kvbm.clear())
             return removed
 
         removed = await self._call_on_scheduler(do_clear)
@@ -425,14 +521,11 @@ class JaxEngine:
             parked = self._parked.get(request_id)
             if parked is None:
                 raise KeyError(f"no parked KV for request {request_id!r}")
-            ids = jnp.asarray(np.asarray(parked.block_ids, np.int32))
-            k, v = self.kv
-            # head-major transposed block layout [L, nkv, n, hd, bs] ->
-            # universal transfer layout [L, nb, bs, nkv, hd]
-            # (block_to_universal analogue, tensor_kernels.cu:151)
-            kb = np.asarray(jnp.transpose(k[:, :, ids], (0, 2, 4, 1, 3)))
-            vb = np.asarray(jnp.transpose(v[:, :, ids], (0, 2, 4, 1, 3)))
-            return kb, vb, parked.prompt_len
+            n = len(parked.block_ids)
+            ids = _pow2_ids(parked.block_ids)
+            kb, vb = self._jit_gather(self.kv, jnp.asarray(ids))
+            return (np.asarray(kb[:, :n]), np.asarray(vb[:, :n]),
+                    parked.prompt_len)
 
         return await self._call_on_scheduler(gather)
 
@@ -497,10 +590,71 @@ class JaxEngine:
         stalls active decodes for more than one chunk's compute
         (the head-of-line blocking the round-1 verdict called out)."""
         self._process_cancellations()
+        self._maybe_offload()
         self._admit_waiting()
         self._prefill_step()
         if any(s is not None and not s.prefilling for s in self._slots):
             self._decode_step()
+
+    # -- KVBM offload/onboard ----------------------------------------------
+    def _maybe_offload(self) -> None:
+        """Copy the coldest evictable HBM blocks to the G2 host tier before
+        eviction pressure destroys them.  One batched gather per step; the
+        blocks stay live in G1 (offload is a copy, not a move), so there is
+        no correctness window."""
+        if self.kvbm is None or self.allocator.num_free >= self._offload_watermark:
+            return
+        cands = self.allocator.coldest_evictable(
+            self.config.offload_batch, exclude=self.kvbm.offload_skip,
+            scan_limit=4 * self.config.offload_batch + 64,
+        )
+        if not cands:
+            return
+        ids = _pow2_ids([bid for _, bid in cands])
+        kb, vb = self._jit_gather(self.kv, jnp.asarray(ids))
+        kb = np.asarray(kb)
+        vb = np.asarray(vb)
+        for i, (h, _) in enumerate(cands):
+            # contiguous copies: a [:, i] view would pin the whole gathered
+            # batch buffer in host RAM for as long as any one block lives
+            self._emit_tier_events(self.kvbm.offload(
+                h, np.ascontiguousarray(kb[:, i]),
+                np.ascontiguousarray(vb[:, i]),
+            ))
+
+    def _try_onboard(self, slot: _Slot, hit: int, cap_blocks: int) -> int:
+        """Extend a G1 prefix hit with blocks onboarded from G2/G3: scatter
+        their payloads into the freshly allocated HBM blocks instead of
+        recomputing prefill.  Returns the number of blocks onboarded."""
+        if self.kvbm is None:
+            return 0
+        hashes = slot.seq.block_hashes
+        run = self.kvbm.match_run(hashes[hit:cap_blocks])
+        if run == 0:
+            return 0
+        block_ids = self.allocator.seq_block_ids(self._seq_id(slot))
+        ks, vs, ids = [], [], []
+        for i in range(hit, hit + run):
+            blk, events = self.kvbm.fetch(hashes[i])
+            self._emit_tier_events(events)
+            if blk is None:  # dropped from the pool mid-walk
+                break
+            k, v = blk
+            ks.append(k)
+            vs.append(v)
+            ids.append(block_ids[i])
+        if not ids:
+            return 0
+        n = len(ids)
+        ids_arr = _pow2_ids(ids)
+        bucket = len(ids_arr)
+        pad = [(0, 0), (0, bucket - n)] + [(0, 0)] * (ks[0].ndim - 1)
+        kb = np.pad(np.stack(ks, axis=1), pad)
+        vb = np.pad(np.stack(vs, axis=1), pad)
+        self.kv = self._jit_inject(
+            self.kv, jnp.asarray(kb), jnp.asarray(vb), jnp.asarray(ids_arr)
+        )
+        return n
 
     # -- prefill ----------------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -541,9 +695,24 @@ class JaxEngine:
             bids = res.block_ids
             slot.block_table[: len(bids)] = bids
             slot.committed_blocks = res.cached_blocks
-            cached_tokens = res.cached_blocks * c.block_size
+            # extend the G1 hit with G2/G3 onboarding (KV scattered back
+            # into HBM instead of recomputed)
+            onboarded = self._try_onboard(slot, res.cached_blocks, cap_blocks)
+            for i in range(res.cached_blocks, res.cached_blocks + onboarded):
+                cres = self.allocator.commit_block(
+                    self._seq_id(slot), i, slot.seq.block_hashes[i]
+                )
+                self._emit_events(cres)
+                slot.committed_blocks = i + 1
+            total_cached = res.cached_blocks + onboarded
+            cached_tokens = total_cached * c.block_size
             slot.cached_tokens = cached_tokens
             self.metrics["cache_hit_tokens"] += cached_tokens
+            if onboarded:
+                self.metrics["onboarded_tokens"] = (
+                    self.metrics.get("onboarded_tokens", 0)
+                    + onboarded * c.block_size
+                )
             slot.ctx_len = cached_tokens
             slot.prompt_len = prompt_len
             slot.prefill_pos = cached_tokens
@@ -587,10 +756,11 @@ class JaxEngine:
         self.metrics["prefill_tokens"] += chunk
         slot.prefill_pos = pos + chunk
         slot.ctx_len = slot.prefill_pos
+        # register blocks this chunk completed (registration is deferred to
+        # materialization, so commit must track prefill progress chunkwise)
+        self._commit_full_blocks(slot)
         if slot.prefilling:
             return  # more chunks to go; decode runs in between
-        # prefill complete: the final chunk's sample is the first token
-        self._commit_full_blocks(slot)
         first = int(tok)
         slot.first_token_t = time.monotonic()
         if slot.disagg_prefill:
@@ -612,14 +782,9 @@ class JaxEngine:
                            kb.shape, self.model_cfg.n_layers, len(block_ids),
                            self.config.block_size)
             return False
-        # pad block count to a pow2 bucket to bound recompiles; padded ids
-        # target the garbage block
         n = len(block_ids)
-        bucket = 1
-        while bucket < n:
-            bucket *= 2
-        ids = np.zeros(bucket, np.int32)
-        ids[:n] = block_ids
+        ids = _pow2_ids(block_ids)
+        bucket = len(ids)
         pad = ((0, 0), (0, bucket - n)) + ((0, 0),) * (kb.ndim - 2)
         kb_p = np.pad(kb, pad)
         vb_p = np.pad(vb, pad)
@@ -667,7 +832,6 @@ class JaxEngine:
             prompt_len=slot.ctx_len,
             expires_t=time.monotonic() + self.parked_ttl_s,
         )
-        self._commit_full_blocks(slot)
         slot.finished = True
         if slot.index >= 0:
             self._slots[slot.index] = None

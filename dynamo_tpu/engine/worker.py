@@ -98,10 +98,14 @@ class JaxEngineWorker:
             rt, self.namespace, self.component, worker_id=instance_id
         )
 
-        def kv_event_sink(stored, removed):
+        def kv_event_sink(stored, removed, tier="g1"):
             # synchronous enqueue on the loop thread: event ids are assigned
-            # in mutation order and a single drain task publishes FIFO
-            self.publisher.enqueue_batch(stored=stored, removed=removed)
+            # in mutation order and a single drain task publishes FIFO.
+            # `tier` is the tier of the mutation that made the block enter
+            # (stored) or fully leave (removed) the worker — events are
+            # already netted across tiers by the engine's consolidator.
+            self.publisher.enqueue_batch(stored=stored, removed=removed,
+                                         tier=tier)
 
         self.engine = JaxEngine(self.config, params=self._params,
                                 kv_event_sink=kv_event_sink,

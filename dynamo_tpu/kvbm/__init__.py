@@ -1,0 +1,28 @@
+"""KVBM: multi-tier KV block management (the reference's L2 layer).
+
+Tier model (ref: lib/kvbm-engine/src/lib.rs:9-25):
+  G1 = TPU HBM        (the engine's paged cache, engine/block_allocator.py)
+  G2 = host DRAM      (pools.HostBlockPool)
+  G3 = local disk     (pools.DiskBlockPool)
+
+Blocks are keyed by PositionalLineageHash, the same identity the engine,
+router, and events already share.  The engine proactively *offloads* cold
+evictable G1 blocks to G2 (one batched device→host gather per scheduler
+step), demotes G2→G3 under pressure, and *onboards* G2/G3 prefix hits back
+into HBM at admission instead of recomputing them.
+
+Event consistency across tiers goes through KvEventConsolidator (ref:
+lib/kvbm-consolidator/src/lib.rs:1-12): routers stay tier-blind and see one
+net stored/removed stream — a block is "stored" while ANY tier holds it.
+"""
+
+from .consolidator import KvEventConsolidator
+from .manager import TieredKvManager
+from .pools import DiskBlockPool, HostBlockPool
+
+__all__ = [
+    "DiskBlockPool",
+    "HostBlockPool",
+    "KvEventConsolidator",
+    "TieredKvManager",
+]

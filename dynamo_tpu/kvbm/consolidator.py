@@ -1,0 +1,54 @@
+"""Cross-tier KV event consolidation.
+
+Ref: lib/kvbm-consolidator/src/lib.rs:1-12 — the reference dedups KV events
+from multiple sources (G1 engine stream + G2/G3 KVBM broadcast) into ONE
+router-compatible stream keyed by the 128-bit PLH.  Routers stay tier-blind:
+a block is owned by a worker while *any* tier holds it, so
+
+  * `stored` is published only when a block enters its FIRST tier, and
+  * `removed` only when it leaves its LAST tier.
+
+Without this, `stored(g1) → offload stored(g2) → evict removed(g1)` would
+make a tier-blind router drop a block the worker can still onboard.
+
+Runs on the engine scheduler thread (same thread as every cache mutation),
+so net-event order equals mutation order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Set, Tuple
+
+# (stored_hashes, removed_hashes, tier) ready for KvEventPublisher
+NetBatch = Tuple[List[int], List[int], str]
+
+
+class KvEventConsolidator:
+    def __init__(self) -> None:
+        self._tiers: Dict[int, Set[str]] = {}
+
+    def apply(self, stored: Sequence[int], removed: Sequence[int],
+              tier: str) -> NetBatch:
+        """Fold one tier's mutation into the cross-tier view.
+
+        Removals are processed before stores (mirroring the publisher's
+        removed-before-stored wire discipline) so an evict+re-register of the
+        same hash inside one mutation nets out correctly."""
+        net_removed: List[int] = []
+        for h in removed:
+            tiers = self._tiers.get(h)
+            if tiers is None:
+                continue
+            tiers.discard(tier)
+            if not tiers:
+                del self._tiers[h]
+                net_removed.append(h)
+        net_stored: List[int] = []
+        for h in stored:
+            tiers = self._tiers.get(h)
+            if tiers is None:
+                self._tiers[h] = {tier}
+                net_stored.append(h)
+            else:
+                tiers.add(tier)
+        return net_stored, net_removed, tier

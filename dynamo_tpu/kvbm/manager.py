@@ -1,0 +1,141 @@
+"""Tiered KV manager: G2/G3 placement, demotion, and onboarding lookups.
+
+Ref: lib/kvbm-engine/src/leader/instance.rs:67 (InstanceLeader owns
+placement across tiers) and lib/kvbm-engine offload/ (batched demotion).
+This is the single-host version: the engine scheduler thread calls into it
+synchronously; multi-host coordination rides the existing event plane (each
+worker advertises its consolidated block set; the router does placement by
+routing).
+
+Responsibilities:
+  * offload(h, k, v): place an HBM block's payload into G2, demoting G2's
+    LRU victims to G3 (or dropping them) as capacity requires.
+  * match_run(hashes): longest leading run onboardable from G2∪G3 —
+    the admission-time alternative to recomputing prefill.
+  * fetch(h): read a block back for onboarding (promotes G3 hits to G2,
+    so a second onboard is a DRAM read, not a disk read).
+
+Every mutation returns [(stored, removed, tier), ...] batches for the
+engine to fold through KvEventConsolidator.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .pools import Block, DiskBlockPool, HostBlockPool
+
+logger = logging.getLogger(__name__)
+
+TierEvents = List[Tuple[List[int], List[int], str]]
+
+
+class _OffloadSkip:
+    """Membership view the engine passes to coldest_evictable: skip blocks
+    already held AND blocks recently dropped for capacity.  Without the
+    cooldown, a G2 smaller than G1's cold set ping-pongs: every offload
+    drops the previous coldest, which is re-offloaded next step, forever."""
+
+    def __init__(self, mgr: "TieredKvManager"):
+        self._m = mgr
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._m or h in self._m._dropped
+
+
+class TieredKvManager:
+    def __init__(self, host_blocks: int, disk_dir: Optional[str] = None,
+                 disk_blocks: int = 0):
+        self.g2 = HostBlockPool(host_blocks)
+        self.g3 = (DiskBlockPool(disk_dir, disk_blocks)
+                   if disk_dir and disk_blocks > 0 else None)
+        self.stats = {"offloaded": 0, "onboarded": 0, "demoted": 0,
+                      "dropped": 0, "disk_hits": 0}
+        # cooldown FIFO of capacity-dropped hashes; bounded so entries age
+        # out as churn elsewhere produces new drops
+        self._dropped: "OrderedDict[int, None]" = OrderedDict()
+        self._dropped_cap = max(64, host_blocks)
+        self.offload_skip = _OffloadSkip(self)
+
+    def _mark_dropped(self, h: int) -> None:
+        self._dropped[h] = None
+        self._dropped.move_to_end(h)
+        while len(self._dropped) > self._dropped_cap:
+            self._dropped.popitem(last=False)
+
+    def __contains__(self, h: int) -> bool:
+        return h in self.g2 or (self.g3 is not None and h in self.g3)
+
+    def offload(self, h: int, k: np.ndarray, v: np.ndarray) -> TierEvents:
+        """Place one block into G2; returns tier events."""
+        events: TierEvents = [([h], [], "g2")]
+        self.stats["offloaded"] += 1
+        self._dropped.pop(h, None)
+        for victim_h, blk in self.g2.put(h, k, v):
+            events.extend(self._demote(victim_h, blk))
+        return events
+
+    def _demote(self, h: int, blk: Block) -> TierEvents:
+        if self.g3 is None:
+            self.stats["dropped"] += 1
+            self._mark_dropped(h)
+            return [([], [h], "g2")]
+        self.stats["demoted"] += 1
+        dropped = self.g3.put(h, *blk)
+        # one batch carries one tier: g3 store first, then the g2 removal,
+        # so the consolidator never sees the block tierless in between
+        events: TierEvents = [([h], [], "g3"), ([], [h], "g2")]
+        for old in dropped:
+            self.stats["dropped"] += 1
+            self._mark_dropped(old)
+            events.append(([], [old], "g3"))
+        return events
+
+    def match_run(self, hashes: Sequence[int]) -> int:
+        """Longest leading run of hashes held in G2∪G3."""
+        n = 0
+        for h in hashes:
+            if h not in self:
+                break
+            n += 1
+        return n
+
+    def fetch(self, h: int) -> Tuple[Optional[Block], TierEvents]:
+        """Read one block for onboarding.  G3 hits are promoted into G2.
+
+        Returns (block, tier_events); block is None on a miss.  The events
+        must be emitted even on a miss: an unreadable G3 file is dropped
+        from the pool here, and the router must see that removal or it will
+        keep routing prefixes to a block that can never onboard."""
+        blk = self.g2.get(h)
+        events: TierEvents = []
+        if blk is None and self.g3 is not None:
+            was_held = h in self.g3
+            blk = self.g3.get(h)
+            if blk is not None:
+                self.stats["disk_hits"] += 1
+                events.append(([h], [], "g2"))
+                for victim_h, victim in self.g2.put(h, *blk):
+                    events.extend(self._demote(victim_h, victim))
+            elif was_held:
+                events.append(([], [h], "g3"))
+        if blk is None:
+            return None, events
+        self.stats["onboarded"] += 1
+        return blk, events
+
+    def clear(self) -> TierEvents:
+        events: TierEvents = []
+        self._dropped.clear()
+        g2 = self.g2.clear()
+        if g2:
+            events.append(([], g2, "g2"))
+        if self.g3 is not None:
+            g3 = self.g3.clear()
+            if g3:
+                events.append(([], g3, "g3"))
+        return events

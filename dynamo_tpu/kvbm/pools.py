@@ -1,0 +1,169 @@
+"""Physical block pools for the G2 (host DRAM) and G3 (disk) KV tiers.
+
+Ref: lib/kvbm-physical/src/layout/ (FullyContiguous host layout) and
+lib/kvbm-engine offload/ (batched demotion).  Block payloads use the
+*universal* transfer layout — per block, K and V arrays of shape
+[n_layers, block_size, n_kv_heads, head_dim] — the same layout the disagg
+transfer path and the engine's gather/inject programs speak, so a block can
+move HBM→host→disk→HBM (or across workers) without reinterpretation.
+
+Pools are plain LRU maps keyed by PLH.  They run on the engine's scheduler
+thread only, so no locking.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+Block = Tuple[np.ndarray, np.ndarray]  # (k, v), each [L, bs, nkv, hd]
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes families (bfloat16,
+    float8_*) that np.dtype() alone cannot parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class HostBlockPool:
+    """G2: host-DRAM KV block cache with LRU eviction."""
+
+    tier = "g2"
+
+    def __init__(self, capacity_blocks: int):
+        self.capacity = capacity_blocks
+        self._blocks: "OrderedDict[int, Block]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._blocks
+
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> List[Tuple[int, Block]]:
+        """Insert a block; returns LRU-evicted (hash, block) pairs."""
+        if h in self._blocks:
+            self._blocks.move_to_end(h)
+            return []
+        self._blocks[h] = (k, v)
+        evicted: List[Tuple[int, Block]] = []
+        while len(self._blocks) > self.capacity:
+            evicted.append(self._blocks.popitem(last=False))
+        return evicted
+
+    def get(self, h: int) -> Optional[Block]:
+        blk = self._blocks.get(h)
+        if blk is not None:
+            self._blocks.move_to_end(h)
+        return blk
+
+    def drop(self, h: int) -> bool:
+        return self._blocks.pop(h, None) is not None
+
+    def clear(self) -> List[int]:
+        hashes = list(self._blocks)
+        self._blocks.clear()
+        return hashes
+
+
+class DiskBlockPool:
+    """G3: disk-backed KV block cache (one .npz per block, LRU by insert)."""
+
+    tier = "g3"
+
+    def __init__(self, directory: str, capacity_blocks: int):
+        self.dir = directory
+        self.capacity = capacity_blocks
+        os.makedirs(directory, exist_ok=True)
+        self._order: "OrderedDict[int, None]" = OrderedDict()
+        # a fresh pool owns its block files: stale ones from a previous run
+        # are untracked (router never saw stored events for them) so they
+        # would only leak disk — wipe them.  Only the pool's own strict
+        # 32-hex-char names; anything else in the directory is not ours.
+        import re
+
+        own = re.compile(r"^[0-9a-f]{32}\.npz$")
+        stale = [f for f in os.listdir(directory) if own.match(f)]
+        for f in stale:
+            try:
+                os.unlink(os.path.join(directory, f))
+            except OSError:
+                pass
+        if stale:
+            logger.info("G3 pool wiped %d stale block files in %s",
+                        len(stale), directory)
+
+    def _path(self, h: int) -> str:
+        return os.path.join(self.dir, f"{int(h):032x}.npz")
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._order
+
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> List[int]:
+        """Persist a block; returns hashes evicted to make room."""
+        if h in self._order:
+            self._order.move_to_end(h)
+            return []
+        # npz round-trips ml_dtypes (bfloat16, the default KV dtype) as raw
+        # void ('|V2') — persist byte views + dtype names and view() back
+        np.savez(self._path(h),
+                 k=np.ascontiguousarray(k).view(np.uint8),
+                 v=np.ascontiguousarray(v).view(np.uint8),
+                 kd=str(k.dtype), vd=str(v.dtype))
+        self._order[h] = None
+        evicted: List[int] = []
+        while len(self._order) > self.capacity:
+            old, _ = self._order.popitem(last=False)
+            self._unlink(old)
+            evicted.append(old)
+        return evicted
+
+    def get(self, h: int) -> Optional[Block]:
+        """Returns the block, or None.  An unreadable file is dropped from
+        the pool — callers that saw `h in pool` beforehand must treat a None
+        here as a G3 removal (and emit the removed event)."""
+        if h not in self._order:
+            return None
+        try:
+            with np.load(self._path(h)) as z:
+                blk = (z["k"].view(_np_dtype(z["kd"].item())),
+                       z["v"].view(_np_dtype(z["vd"].item())))
+        except (OSError, KeyError, TypeError, AttributeError):
+            logger.warning("G3 block %x unreadable; dropping", h)
+            self._order.pop(h, None)
+            return None
+        self._order.move_to_end(h)
+        return blk
+
+    def drop(self, h: int) -> bool:
+        if self._order.pop(h, None) is None:
+            return False
+        self._unlink(h)
+        return True
+
+    def _unlink(self, h: int) -> None:
+        try:
+            os.unlink(self._path(h))
+        except OSError:
+            pass
+
+    def clear(self) -> List[int]:
+        hashes = list(self._order)
+        for h in hashes:
+            self._unlink(h)
+        self._order.clear()
+        return hashes

@@ -1,0 +1,273 @@
+"""KVBM multi-tier KV management: pools, consolidation, offload/onboard.
+
+Mirrors the reference's kvbm test discipline (lib/kvbm-engine testing
+features): pool/consolidator units first, then engine e2e where evicted
+blocks round-trip HBM→host→HBM instead of being recomputed."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.kvbm import (
+    DiskBlockPool,
+    HostBlockPool,
+    KvEventConsolidator,
+    TieredKvManager,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+FP32 = LlamaConfig(name="tiny32", vocab_size=256, d_model=64, n_layers=2,
+                   n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,
+                   dtype=jnp.float32)
+
+
+def blk(seed):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(2, 4, 2, 8)).astype(np.float32),
+            rng.normal(size=(2, 4, 2, 8)).astype(np.float32))
+
+
+def greedy_req(tokens, n, rid):
+    return PreprocessedRequest(
+        token_ids=tokens, request_id=rid,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=n, ignore_eos=True),
+    )
+
+
+async def collect(eng, req):
+    toks = []
+    async for out in eng.generate(req):
+        toks.extend(out.token_ids)
+    return toks
+
+
+# ----------------------------- pools -----------------------------------
+
+
+def test_host_pool_lru_eviction():
+    pool = HostBlockPool(capacity_blocks=2)
+    k1, v1 = blk(1)
+    assert pool.put(1, k1, v1) == []
+    assert pool.put(2, *blk(2)) == []
+    pool.get(1)  # touch: 2 becomes LRU victim
+    evicted = pool.put(3, *blk(3))
+    assert [h for h, _ in evicted] == [2]
+    assert 1 in pool and 3 in pool and 2 not in pool
+    got = pool.get(1)
+    np.testing.assert_array_equal(got[0], k1)
+
+
+def test_disk_pool_round_trip(tmp_path):
+    pool = DiskBlockPool(str(tmp_path), capacity_blocks=2)
+    k, v = blk(7)
+    assert pool.put(10, k, v) == []
+    assert pool.put(11, *blk(8)) == []
+    assert pool.put(12, *blk(9)) == [10]  # capacity eviction, oldest first
+    got = pool.get(11)
+    assert got is not None
+    assert pool.get(10) is None
+    pool.clear()
+    assert len(pool) == 0 and pool.get(11) is None
+
+
+def test_disk_pool_round_trips_bfloat16(tmp_path):
+    """bfloat16 is the default KV dtype; a plain np.savez round-trips it as
+    raw void ('|V2'), which crashes jnp.asarray at onboard time.  The pool
+    must hand back the original dtype."""
+    import ml_dtypes
+
+    pool = DiskBlockPool(str(tmp_path), capacity_blocks=2)
+    k = np.arange(2 * 4 * 2 * 8, dtype=np.float32).reshape(2, 4, 2, 8)
+    kb = k.astype(ml_dtypes.bfloat16)
+    pool.put(1, kb, (k + 1).astype(ml_dtypes.bfloat16))
+    got_k, got_v = pool.get(1)
+    assert got_k.dtype == kb.dtype and got_v.dtype == kb.dtype
+    np.testing.assert_array_equal(got_k, kb)
+    jnp.asarray(got_k)  # must be a valid JAX input
+
+
+def test_disk_pool_wipes_stale_files_but_not_foreign_ones(tmp_path):
+    stale = tmp_path / ("0" * 31 + "a.npz")  # pool's own 32-hex name form
+    stale.write_bytes(b"junk")
+    foreign = tmp_path / "user_data.npz"  # NOT ours: must survive
+    foreign.write_bytes(b"precious")
+    pool = DiskBlockPool(str(tmp_path), capacity_blocks=2)
+    assert not stale.exists()
+    assert foreign.exists()
+    pool.put(1, *blk(1))
+    assert pool.get(1) is not None
+
+
+def test_manager_offload_cooldown_prevents_pingpong(tmp_path):
+    """A capacity-dropped hash must be excluded from immediate re-offload
+    (via offload_skip), or an undersized G2 regathers the same cold blocks
+    every scheduler step."""
+    mgr = TieredKvManager(host_blocks=1)
+    mgr.offload(1, *blk(1))
+    mgr.offload(2, *blk(2))  # drops 1 (no G3)
+    assert 1 in mgr.offload_skip  # recently dropped: don't re-offload
+    assert 2 in mgr.offload_skip  # resident: don't re-offload
+    assert 3 not in mgr.offload_skip
+    # an explicit re-offload (block turned hot again) still works and
+    # clears the cooldown
+    mgr.offload(1, *blk(1))
+    assert mgr.match_run([1]) == 1
+
+
+def test_manager_demotes_g2_to_g3_and_promotes_back(tmp_path):
+    mgr = TieredKvManager(host_blocks=1, disk_dir=str(tmp_path),
+                          disk_blocks=4)
+    ev1 = mgr.offload(1, *blk(1))
+    assert ev1 == [([1], [], "g2")]
+    ev2 = mgr.offload(2, *blk(2))  # demotes 1 to disk
+    assert ([1], [], "g3") in ev2 and ([], [1], "g2") in ev2
+    assert mgr.match_run([1, 2]) == 2
+    # fetching the disk-resident block promotes it back into G2
+    (k, v), ev3 = mgr.fetch(1)
+    np.testing.assert_array_equal(k, blk(1)[0])
+    assert ([1], [], "g2") in ev3
+    assert mgr.stats["disk_hits"] == 1
+
+
+def test_manager_fetch_emits_removal_for_vanished_disk_block(tmp_path):
+    """An externally corrupted/deleted G3 file must surface a g3 removal so
+    the router stops expecting an onboard that can never happen."""
+    import os
+
+    mgr = TieredKvManager(host_blocks=1, disk_dir=str(tmp_path),
+                          disk_blocks=4)
+    mgr.offload(1, *blk(1))
+    mgr.offload(2, *blk(2))  # demotes 1 to disk
+    for f in os.listdir(tmp_path):
+        os.unlink(os.path.join(tmp_path, f))
+    blk_out, events = mgr.fetch(1)
+    assert blk_out is None
+    assert ([], [1], "g3") in events
+
+
+# -------------------------- consolidator --------------------------------
+
+
+def test_consolidator_nets_events_across_tiers():
+    c = KvEventConsolidator()
+    assert c.apply([1, 2], [], "g1") == ([1, 2], [], "g1")
+    # offload copies into g2: no net store (router already owns them)
+    assert c.apply([1], [], "g2") == ([], [], "g2")
+    # g1 eviction while g2 holds: no net removal
+    assert c.apply([], [1], "g1") == ([], [], "g1")
+    # g2 drop is the LAST tier: net removal
+    assert c.apply([], [1], "g2") == ([], [1], "g2")
+    # hash 2 only ever in g1
+    assert c.apply([], [2], "g1") == ([], [2], "g1")
+
+
+def test_consolidator_evict_reregister_same_mutation():
+    c = KvEventConsolidator()
+    c.apply([5], [], "g1")
+    # one mutation: evict 5, re-register 5 (allocator can do this)
+    stored, removed, _ = c.apply([5], [5], "g1")
+    assert stored == [5] and removed == [5]  # removed precedes stored on wire
+
+
+# ------------------------- engine e2e ------------------------------------
+
+
+def eng_kwargs(**kw):
+    d = dict(model_config=FP32, block_size=4, num_blocks=16,
+             max_blocks_per_seq=8, max_num_seqs=2,
+             prefill_buckets=(8, 16, 32), seed=7)
+    d.update(kw)
+    return d
+
+
+async def test_offload_onboard_instead_of_recompute():
+    """Fill the small HBM cache, force prompt A's blocks out, then resubmit
+    A: its prefix must come back from the host tier (onboarded) rather than
+    recomputed, with identical greedy output."""
+    events = []
+
+    def sink(stored, removed, tier="g1"):
+        events.append((list(stored), list(removed), tier))
+
+    cfg = EngineConfig(**eng_kwargs(host_cache_blocks=64,
+                                    offload_watermark_blocks=16))
+    eng = JaxEngine(cfg, kv_event_sink=sink)
+    prompt_a = list(range(1, 13))  # 3 full blocks
+    out1 = await collect(eng, greedy_req(prompt_a, 4, "a1"))
+
+    # churn: distinct prompts that force A's cached blocks to be evicted
+    # (watermark == num_blocks, so every step offloads before evicting)
+    for i in range(6):
+        p = [50 + 7 * i + j for j in range(12)]
+        await collect(eng, greedy_req(p, 2, f"churn{i}"))
+
+    assert eng.kvbm.stats["offloaded"] > 0
+    pre_prefill = eng.metrics["prefill_tokens"]
+    out2 = await collect(eng, greedy_req(prompt_a, 4, "a2"))
+    assert out2 == out1
+    assert eng.metrics.get("onboarded_tokens", 0) >= 8, \
+        "prefix should onboard from the host tier"
+    # onboarded blocks skip prefill compute (only the tail recomputes)
+    assert eng.metrics["prefill_tokens"] - pre_prefill <= 8
+    await eng.close()
+
+    # router-visible consistency: every net-removed hash was stored before,
+    # and a hash the worker still holds in ANY tier was never net-removed
+    seen = set()
+    for stored, removed, _tier in events:
+        for h in removed:
+            assert h in seen, f"removed-before-stored leaked for {h}"
+            seen.discard(h)
+        seen.update(stored)
+
+
+async def test_concurrent_same_prefix_not_corrupted_by_deferred_commit():
+    """Two identical prompts admitted near-simultaneously with chunked
+    prefill: the second must not prefix-match blocks whose KV is still being
+    prefilled by the first (registration is deferred to materialization).
+    Greedy outputs must match a serial run."""
+    cfg = EngineConfig(**eng_kwargs(num_blocks=64, max_blocks_per_seq=16,
+                                    prefill_buckets=(8,),
+                                    max_batch_tokens=8))
+    eng = JaxEngine(cfg)
+    prompt = list(range(1, 49))  # 12 blocks, 6 prefill chunks
+    serial = await collect(eng, greedy_req(prompt, 6, "s0"))
+    await eng.clear_kv_blocks()
+
+    r1, r2 = await asyncio.gather(
+        collect(eng, greedy_req(prompt, 6, "c1")),
+        collect(eng, greedy_req(prompt, 6, "c2")),
+    )
+    assert r1 == serial
+    assert r2 == serial
+    await eng.close()
+
+
+async def test_disk_tier_survives_host_pressure(tmp_path):
+    """With a 2-block G2 and a disk G3, offloaded blocks demoted to disk are
+    still onboardable."""
+    cfg = EngineConfig(**eng_kwargs(
+        host_cache_blocks=2, offload_watermark_blocks=16,
+        disk_cache_dir=str(tmp_path), disk_cache_blocks=32,
+    ))
+    eng = JaxEngine(cfg)
+    prompt_a = list(range(1, 13))
+    out1 = await collect(eng, greedy_req(prompt_a, 4, "a1"))
+    for i in range(6):
+        p = [60 + 5 * i + j for j in range(12)]
+        await collect(eng, greedy_req(p, 2, f"churn{i}"))
+    assert eng.kvbm.stats["demoted"] > 0
+    out2 = await collect(eng, greedy_req(prompt_a, 4, "a2"))
+    assert out2 == out1
+    assert eng.kvbm.stats["disk_hits"] + eng.metrics.get(
+        "onboarded_tokens", 0) > 0
+    await eng.close()
