@@ -1,10 +1,11 @@
 from .prefill_router import ConditionalDisaggConfig, PrefillOrchestrator
-from .transfer import KvBlockPayload, deserialize_kv, serialize_kv
+from .transfer import ChunkAssembler, KvBlockPayload, KvLayout, iter_chunks
 
 __all__ = [
+    "ChunkAssembler",
     "ConditionalDisaggConfig",
     "KvBlockPayload",
+    "KvLayout",
     "PrefillOrchestrator",
-    "deserialize_kv",
-    "serialize_kv",
+    "iter_chunks",
 ]

@@ -10,17 +10,26 @@ protocol carries only metadata and the payload path is swapped for ICI/DCN
 device-to-device transfer (jax transfer server / collective_permute); the
 host-staged path remains the DCN fallback.
 
-Resharding falls out of the design: payloads are *logical* blocks
-[layers, n_blocks, block_size, kv_heads, head_dim] gathered to host from
-whatever tp-sharding the prefill engine used, and re-sharded on inject by
-the decode engine's GSPMD layout — prefill TP ≠ decode TP needs no special
-case (the reference calls this out as a headline feature).
+Wire protocol (one kv_pull stream):
+  1. header frame — prompt_len + KvLayout (logical geometry + the sender's
+     mesh shape).  The receiver validates *logical* compatibility
+     (layers/heads/head_dim/block_size/dtype must match) and ignores the
+     sender's parallelism: payloads are logical blocks
+     [layers, n_blocks, block_size, kv_heads, head_dim] gathered to host
+     from whatever tp-sharding the prefill engine used, and re-sharded on
+     inject by the decode engine's own GSPMD layout.  prefill TP ≠ decode
+     TP therefore needs no special case (the reference calls this out as a
+     headline feature) — and is covered by tests/test_disagg.py.
+  2. N chunk frames — (layer, block-range) slabs, each bounded by
+     max_chunk_bytes so a long prompt's KV never approaches the request
+     plane's frame cap, and the receiver can overlap deserialization with
+     the network.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -32,6 +41,10 @@ except ImportError:  # pragma: no cover
 
 _DTYPES = {"float32": np.float32, "float16": np.float16}
 
+# Default slab bound.  Well under the request plane's 256MB frame cap even
+# after msgpack framing, large enough to amortize per-frame overhead.
+DEFAULT_CHUNK_BYTES = 16 * 1024 * 1024
+
 
 def _np_dtype(name: str):
     if name == "bfloat16":
@@ -42,8 +55,58 @@ def _np_dtype(name: str):
 
 
 @dataclass
+class KvLayout:
+    """Logical geometry of a KV payload + the sender's parallel layout.
+
+    The logical fields are contract: a mismatch is a model mismatch and the
+    pull must fail.  The mesh fields are advisory (telemetry / future
+    device-to-device path negotiation) — resharding is the receiver's
+    GSPMD's job, not the protocol's."""
+
+    num_layers: int
+    num_blocks: int
+    block_size: int
+    kv_heads: int
+    head_dim: int
+    dtype: str
+    tp: int = 1
+    dp: int = 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "num_layers": self.num_layers, "num_blocks": self.num_blocks,
+            "block_size": self.block_size, "kv_heads": self.kv_heads,
+            "head_dim": self.head_dim, "dtype": self.dtype,
+            "tp": self.tp, "dp": self.dp,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KvLayout":
+        return cls(**{k: d[k] for k in (
+            "num_layers", "num_blocks", "block_size", "kv_heads",
+            "head_dim", "dtype")}, tp=d.get("tp", 1), dp=d.get("dp", 1))
+
+    @classmethod
+    def of(cls, k: np.ndarray, tp: int = 1, dp: int = 1) -> "KvLayout":
+        L, nb, bs, nkv, hd = k.shape
+        return cls(num_layers=L, num_blocks=nb, block_size=bs, kv_heads=nkv,
+                   head_dim=hd, dtype=k.dtype.name, tp=tp, dp=dp)
+
+    def check_compatible(self, other: "KvLayout") -> None:
+        """Logical-geometry contract check (tp/dp intentionally excluded)."""
+        for f in ("num_layers", "block_size", "kv_heads", "head_dim",
+                  "dtype"):
+            a, b = getattr(self, f), getattr(other, f)
+            if a != b:
+                raise ValueError(
+                    f"incompatible KV layout: {f} is {a} on the sender but "
+                    f"{b} on the receiver"
+                )
+
+
+@dataclass
 class KvBlockPayload:
-    """One chunk of KV blocks with its layout header."""
+    """A fully reassembled KV payload."""
 
     k: np.ndarray  # [layers, n_blocks, block_size, kv_heads, head_dim]
     v: np.ndarray
@@ -53,23 +116,89 @@ class KvBlockPayload:
         return self.k.shape[1]
 
 
-def serialize_kv(k: np.ndarray, v: np.ndarray) -> Dict[str, Any]:
-    """Payload → wire dict (msgpack-safe: bytes + plain lists)."""
-    assert k.shape == v.shape
-    return {
-        "shape": list(k.shape),
-        "dtype": k.dtype.name,
-        "k": k.tobytes(),
-        "v": v.tobytes(),
-    }
+def make_header(prompt_len: int, layout: KvLayout) -> Dict[str, Any]:
+    return {"prompt_len": prompt_len, "layout": layout.to_dict()}
 
 
-def deserialize_kv(wire: Dict[str, Any]) -> KvBlockPayload:
-    shape = tuple(wire["shape"])
-    dt = _np_dtype(wire["dtype"])
-    k = np.frombuffer(wire["k"], dtype=dt).reshape(shape)
-    v = np.frombuffer(wire["v"], dtype=dt).reshape(shape)
-    return KvBlockPayload(k=k, v=v)
+def iter_chunks(
+    k: np.ndarray, v: np.ndarray, max_bytes: int = DEFAULT_CHUNK_BYTES
+) -> Iterator[Dict[str, Any]]:
+    """Split [L, nb, bs, nkv, hd] K/V into wire frames of bounded size.
+
+    Slabs never span layers (keeps indexing trivial); within a layer the
+    block axis is split so that k-bytes + v-bytes <= max_bytes (a single
+    block larger than max_bytes still goes out whole — the bound is a
+    target, the frame cap is the hard limit)."""
+    assert k.shape == v.shape and k.dtype == v.dtype
+    L, nb = k.shape[0], k.shape[1]
+    block_bytes = int(k[0, :1].nbytes) if nb else 0
+    per = max(1, max_bytes // max(1, 2 * block_bytes))
+    for layer in range(L):
+        for b0 in range(0, nb, per):
+            b1 = min(nb, b0 + per)
+            yield {
+                "layer": layer,
+                "block_start": b0,
+                "block_count": b1 - b0,
+                "k": np.ascontiguousarray(k[layer, b0:b1]).tobytes(),
+                "v": np.ascontiguousarray(v[layer, b0:b1]).tobytes(),
+            }
+
+
+class ChunkAssembler:
+    """Receiver side: header + chunk frames → KvBlockPayload.
+
+    Allocates the destination once from the header layout and writes each
+    slab in place — no per-chunk concatenation garbage."""
+
+    def __init__(self, header: Dict[str, Any],
+                 expect: Optional[KvLayout] = None,
+                 max_blocks: Optional[int] = None):
+        self.prompt_len = int(header["prompt_len"])
+        self.layout = KvLayout.from_dict(header["layout"])
+        if expect is not None:
+            self.layout.check_compatible(expect)
+        if max_blocks is not None and self.layout.num_blocks > max_blocks:
+            # the allocation below is sized entirely by the sender's header;
+            # without this cap a corrupt header OOMs the receiver before a
+            # single payload byte arrives
+            raise ValueError(
+                f"KV transfer of {self.layout.num_blocks} blocks exceeds "
+                f"the receiver's limit of {max_blocks}"
+            )
+        lo = self.layout
+        shape = (lo.num_layers, lo.num_blocks, lo.block_size, lo.kv_heads,
+                 lo.head_dim)
+        dt = _np_dtype(lo.dtype)
+        self.k = np.zeros(shape, dt)
+        self.v = np.zeros(shape, dt)
+        self._filled = np.zeros((lo.num_layers, lo.num_blocks), bool)
+
+    def add(self, frame: Dict[str, Any]) -> None:
+        lo = self.layout
+        layer = int(frame["layer"])
+        b0 = int(frame["block_start"])
+        n = int(frame["block_count"])
+        if not (0 <= layer < lo.num_layers and 0 <= b0 and
+                b0 + n <= lo.num_blocks):
+            raise ValueError(f"chunk out of bounds: layer={layer} "
+                             f"blocks=[{b0},{b0 + n})")
+        shape = (n, lo.block_size, lo.kv_heads, lo.head_dim)
+        dt = _np_dtype(lo.dtype)
+        self.k[layer, b0:b0 + n] = np.frombuffer(
+            frame["k"], dtype=dt).reshape(shape)
+        self.v[layer, b0:b0 + n] = np.frombuffer(
+            frame["v"], dtype=dt).reshape(shape)
+        self._filled[layer, b0:b0 + n] = True
+
+    def finish(self) -> KvBlockPayload:
+        if not self._filled.all():
+            missing = int((~self._filled).sum())
+            raise ValueError(
+                f"incomplete KV transfer: {missing} (layer, block) slabs "
+                "never arrived"
+            )
+        return KvBlockPayload(k=self.k, v=self.v)
 
 
 def make_transfer_params(

@@ -7,6 +7,16 @@ The TPU-native equivalent of `python -m dynamo.vllm`
 import argparse
 import asyncio
 import logging
+import os
+
+if os.environ.get("DYN_JAX_PLATFORM"):
+    # this image's TPU plugin prepends itself to jax_platforms regardless of
+    # JAX_PLATFORMS; DYN_JAX_PLATFORM=cpu forces the backend explicitly
+    # (virtual-mesh testing on a TPU-attached host, same recipe as
+    # tests/conftest.py)
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["DYN_JAX_PLATFORM"])
 
 from ..runtime import DistributedRuntime
 from .config import EngineConfig

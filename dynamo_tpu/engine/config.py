@@ -5,6 +5,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
+from ..disagg.transfer import DEFAULT_CHUNK_BYTES
 from ..models.llama import PRESETS, LlamaConfig
 from ..parallel.mesh import MeshConfig
 
@@ -44,6 +45,10 @@ class EngineConfig:
     disk_cache_blocks: int = 0
     offload_watermark_blocks: int = 0      # 0 = num_blocks // 4
     offload_batch: int = 16                # max blocks gathered per step
+
+    # disagg KV transfer: bound on one wire frame's K+V payload bytes
+    # (disagg/transfer.py iter_chunks)
+    transfer_chunk_bytes: int = DEFAULT_CHUNK_BYTES
 
     # parallelism
     dp: int = 1

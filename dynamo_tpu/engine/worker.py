@@ -13,6 +13,8 @@ import asyncio
 import logging
 from typing import Optional
 
+import numpy as np
+
 from ..protocols import LLMEngineOutput, ModelDeploymentCard, PreprocessedRequest
 from ..protocols.model_card import deregister_model, register_model
 from ..router.events import KvEventPublisher
@@ -127,15 +129,18 @@ class JaxEngineWorker:
             yield {"cleared_blocks": n}
 
         async def kv_pull_handler(payload, ctx):
-            """Stream a parked prefill's KV, one layer per frame (bounds
-            frame sizes for long prompts), then release the blocks."""
-            from ..disagg.transfer import serialize_kv
+            """Stream a parked prefill's KV: a layout header, then
+            byte-bounded (layer, block-range) slabs, then release the
+            blocks (disagg/transfer.py wire protocol)."""
+            from ..disagg.transfer import KvLayout, iter_chunks, make_header
 
             rid = payload["request_id"]
             k, v, prompt_len = await self.engine.extract_parked_kv(rid)
-            yield {"prompt_len": prompt_len, "num_layers": int(k.shape[0])}
-            for layer in range(k.shape[0]):
-                yield serialize_kv(k[layer:layer + 1], v[layer:layer + 1])
+            layout = KvLayout.of(k, tp=self.config.tp, dp=self.config.dp)
+            yield make_header(prompt_len, layout)
+            for frame in iter_chunks(k, v,
+                                     self.config.transfer_chunk_bytes):
+                yield frame
             await self.engine.release_parked(rid)
 
         comp = rt.namespace(self.namespace).component(self.component)
@@ -163,10 +168,10 @@ class JaxEngineWorker:
 
         The transport is the request plane (host-staged); on multi-slice
         topologies this is where the ICI/DCN device-to-device path plugs in
-        (disagg/transfer.py docstring)."""
-        import numpy as np
-
-        from ..disagg.transfer import deserialize_kv
+        (disagg/transfer.py docstring).  The sender's header layout is
+        validated against this worker's own model geometry — its tp/dp may
+        differ freely (inject reshards via GSPMD)."""
+        from ..disagg.transfer import ChunkAssembler, KvLayout
 
         ns = params.get("namespace", self.namespace)
         comp = params.get("component", self.component)
@@ -178,23 +183,28 @@ class JaxEngineWorker:
             client = await ep.client().start()
             await client.wait_for_instances()
             self._pull_clients[key] = client
-        header = None
-        k_layers, v_layers = [], []
+        m = self.config.resolve_model()
+        expect = KvLayout(
+            num_layers=m.n_layers, num_blocks=0,
+            block_size=self.config.block_size, kv_heads=m.n_kv_heads,
+            head_dim=m.head_dim, dtype=np.dtype(m.dtype).name,
+        )
+        asm = None
         async for item in client.generate(
             {"request_id": params["request_id"]},
             instance_id=params["instance_id"],
         ):
-            if header is None:
-                header = item
+            if asm is None:
+                asm = ChunkAssembler(
+                    item, expect=expect,
+                    max_blocks=self.config.max_blocks_per_seq,
+                )
                 continue
-            payload = deserialize_kv(item)
-            k_layers.append(payload.k)
-            v_layers.append(payload.v)
-        if header is None or not k_layers:
+            asm.add(item)
+        if asm is None:
             raise RuntimeError("empty KV pull stream")
-        k = np.concatenate(k_layers, axis=0)
-        v = np.concatenate(v_layers, axis=0)
-        return k, v, header["prompt_len"]
+        payload = asm.finish()
+        return payload.k, payload.v, asm.prompt_len
 
     async def _load_loop(self) -> None:
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"

@@ -109,6 +109,107 @@ async def test_mocker_disagg_e2e():
     await rt.shutdown()
 
 
+def test_chunked_transfer_protocol_roundtrip():
+    """Header + bounded slabs reassemble to the exact payload; incomplete
+    streams and incompatible layouts fail loudly."""
+    import numpy as np
+    import pytest
+
+    from dynamo_tpu.disagg.transfer import (
+        ChunkAssembler, KvLayout, iter_chunks, make_header,
+    )
+
+    rng = np.random.default_rng(3)
+    k = rng.normal(size=(2, 6, 4, 2, 8)).astype(np.float32)
+    v = rng.normal(size=(2, 6, 4, 2, 8)).astype(np.float32)
+    block_bytes = k[0, :1].nbytes
+    frames = list(iter_chunks(k, v, max_bytes=2 * 2 * block_bytes))
+    # 6 blocks / 2-per-slab * 2 layers = 6 frames, each within the bound
+    assert len(frames) == 6
+    assert all(len(f["k"]) + len(f["v"]) <= 4 * block_bytes for f in frames)
+
+    layout = KvLayout.of(k, tp=1)
+    asm = ChunkAssembler(make_header(24, layout))
+    for f in frames:
+        asm.add(f)
+    out = asm.finish()
+    np.testing.assert_array_equal(out.k, k)
+    np.testing.assert_array_equal(out.v, v)
+    assert asm.prompt_len == 24
+
+    # a dropped slab is an error, not silent zeros
+    asm2 = ChunkAssembler(make_header(24, layout))
+    for f in frames[:-1]:
+        asm2.add(f)
+    with pytest.raises(ValueError, match="incomplete"):
+        asm2.finish()
+
+    # logical-geometry mismatch rejected at the header; tp may differ
+    other = KvLayout.of(k, tp=4)
+    other.kv_heads = 8
+    with pytest.raises(ValueError, match="kv_heads"):
+        ChunkAssembler(make_header(24, layout), expect=other)
+    ok = KvLayout.of(k, tp=4)  # same geometry, different parallelism
+    ChunkAssembler(make_header(24, layout), expect=ok)
+
+    # a corrupt header must not size the receiver's allocation unbounded
+    huge = KvLayout.of(k)
+    huge.num_blocks = 2**30
+    with pytest.raises(ValueError, match="exceeds"):
+        ChunkAssembler(make_header(24, huge), max_blocks=64)
+
+
+async def test_disagg_resharding_prefill_tp1_decode_tp2():
+    """The headline transfer property: KV prefilled on a tp=1 engine must
+    continue identically on a tp=2 decode engine (logical payload, GSPMD
+    reshard on inject) — with the payload forced across many wire frames."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.engine.worker import JaxEngineWorker
+
+    rt = await fresh_runtime().start()
+    ecfg = dict(model_config=FP32, block_size=4, num_blocks=64,
+                max_blocks_per_seq=16, max_num_seqs=2,
+                prefill_buckets=(8, 16, 32), seed=7)
+    prefill_worker = await JaxEngineWorker(
+        rt, EngineConfig(role="prefill", tp=1, transfer_chunk_bytes=2048,
+                         **ecfg),
+        component="prefill",
+    ).start()
+    decode_worker = await JaxEngineWorker(
+        rt, EngineConfig(role="decode", tp=2, **ecfg), component="backend",
+    ).start()
+    agg = JaxEngine(EngineConfig(**ecfg))  # tp=1 reference
+
+    prompt = list(range(30, 52))
+    expect = []
+    async for out in agg.generate(greedy_req(prompt, 6, "agg")):
+        expect.extend(out.token_ids)
+
+    pclient = await (rt.namespace("dynamo").component("prefill")
+                     .endpoint("generate").client()).start()
+    dclient = await (rt.namespace("dynamo").component("backend")
+                     .endpoint("generate").client()).start()
+    orch = PrefillOrchestrator(
+        pclient, ConditionalDisaggConfig(always_remote=True))
+    routed = await orch.maybe_prefill(greedy_req(prompt, 6, "reshard1"))
+    assert routed.disaggregated_params is not None
+
+    from dynamo_tpu.protocols import LLMEngineOutput
+
+    tokens = []
+    async for item in dclient.generate(routed.to_dict()):
+        tokens.extend(LLMEngineOutput.from_dict(item).token_ids)
+    assert tokens == expect, "tp-resharded continuation diverged"
+    assert decode_worker.engine.metrics["prefill_tokens"] == 0
+
+    await orch.close()
+    await dclient.close()
+    await agg.close()
+    await prefill_worker.close()
+    await decode_worker.close()
+    await rt.shutdown()
+
+
 async def test_jax_engine_disagg_transfer_roundtrip():
     """KV computed on engine A must continue identically on engine B."""
     from dynamo_tpu.engine import EngineConfig, JaxEngine
