@@ -10,8 +10,10 @@ the engine-internal NCCL TP the reference passes through to vLLM/TRT-LLM
   tp — tensor parallel: attention heads / MLP hidden / vocab sharded; KV
        cache sharded over kv_heads.
 
-Expert parallel ("ep", MoE) reuses the tp axis by default; sequence-parallel
-long-context sharding lives in ops/ring_attention.py.
+  sp — sequence parallel: long-context ring attention
+       (ops/ring_attention.py) shards the sequence axis here.
+
+Expert parallel ("ep", MoE) reuses the tp axis by default.
 """
 
 from __future__ import annotations
@@ -28,10 +30,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 class MeshConfig:
     dp: int = 1
     tp: int = 1
+    sp: int = 1  # sequence parallel: the ring axis of ops/ring_attention.py
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.tp * self.sp
 
 
 def make_mesh(cfg: Optional[MeshConfig] = None,
@@ -43,8 +46,10 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
         raise ValueError(
             f"mesh needs {cfg.num_devices} devices, have {len(devices)}"
         )
-    dev_array = np.array(devices[: cfg.num_devices]).reshape(cfg.dp, cfg.tp)
-    return Mesh(dev_array, axis_names=("dp", "tp"))
+    dev_array = np.array(devices[: cfg.num_devices]).reshape(
+        cfg.dp, cfg.tp, cfg.sp
+    )
+    return Mesh(dev_array, axis_names=("dp", "tp", "sp"))
 
 
 def param_sharding_rules() -> dict:
