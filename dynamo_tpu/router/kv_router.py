@@ -25,6 +25,7 @@ from ..runtime import Client, DistributedRuntime
 from ..tokens import compute_block_hashes_for_request
 from .events import KvCacheEvent, kv_event_subject
 from .indexer import make_indexer
+from .replica_sync import RouterReplicaSync
 from .selector import DefaultWorkerSelector, KvRouterConfig, WorkerState
 from .sequences import ActiveSequences
 
@@ -35,7 +36,8 @@ class KvRouter:
     def __init__(self, runtime: DistributedRuntime, namespace: str,
                  component: str, client: Client,
                  block_size: int = 64,
-                 config: Optional[KvRouterConfig] = None):
+                 config: Optional[KvRouterConfig] = None,
+                 replica_sync: bool = True):
         self.runtime = runtime
         self.namespace = namespace
         self.component = component
@@ -44,6 +46,11 @@ class KvRouter:
         self.indexer = make_indexer()
         self.selector = DefaultWorkerSelector(config)
         self.sequences = ActiveSequences()
+        # multi-router slot-state convergence (replica_sync.py)
+        self.sync: Optional[RouterReplicaSync] = (
+            RouterReplicaSync(runtime, namespace, component, self.sequences)
+            if replica_sync else None
+        )
         self.states: Dict[int, WorkerState] = {}
         self._cancel = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
@@ -61,10 +68,14 @@ class KvRouter:
         ep = (self.runtime.namespace(self.namespace)
               .component(self.component).endpoint("kv_events_replay"))
         self._replay_client = await ep.client().start()
+        if self.sync is not None:
+            await self.sync.start()
         return self
 
     async def close(self) -> None:
         self._cancel.set()
+        if self.sync is not None:
+            await self.sync.close()
         for t in list(self._tasks) + list(self._recover_tasks):
             t.cancel()
         if self._replay_client is not None:
@@ -213,19 +224,26 @@ class KvRouter:
             workers, request_blocks, overlaps, self.states, avoid=avoid
         )
         if choice is not None:
+            blocks = request_blocks + (request.stop.max_tokens
+                                       // self.block_size)
+            overlap = overlaps.get(choice, 0)
             self.sequences.add_request(
-                request.request_id, choice,
-                request_blocks + (request.stop.max_tokens
-                                  // self.block_size),
-                overlaps.get(choice, 0),
+                request.request_id, choice, blocks, overlap
             )
+            if self.sync is not None:
+                self.sync.publish_add(request.request_id, choice, blocks,
+                                      overlap)
         return choice
 
     def mark_prefill_completed(self, request_id: str) -> None:
         self.sequences.mark_prefill_completed(request_id)
+        if self.sync is not None:
+            self.sync.publish_prefill_done(request_id)
 
     def complete(self, request_id: str) -> None:
         self.sequences.free(request_id)
+        if self.sync is not None:
+            self.sync.publish_free(request_id)
 
 
 def make_kv_route_factory(runtime: DistributedRuntime, *,

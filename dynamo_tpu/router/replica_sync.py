@@ -1,0 +1,124 @@
+"""Router replica synchronization.
+
+Ref: lib/kv-router/src/sequences/replica_sync.rs and
+docs/design-docs/router-design.md:166-180.  Every frontend replica runs its
+own KvRouter; each router's ActiveSequences only sees its OWN routing
+decisions, so with N frontends each router underestimates worker load by
+~(N-1)/N and hot workers get dogpiled.  Replica sync broadcasts the three
+slot-manager transitions on the event plane —
+
+    add(request, worker, blocks, overlap)  at pick time
+    prefill_done(request)                  at first token
+    free(request)                          at completion
+
+— and every router folds its peers' transitions into its slot manager,
+keyed as "request_id@router_id" so ids never collide across replicas.
+Event-plane sync is eventually consistent by design: a lost frame costs one
+request's worth of load signal until the stale-reap, not correctness (the
+reference makes the same trade).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def router_sync_subject(namespace: str, component: str) -> str:
+    return f"router_sync.{namespace}.{component}"
+
+
+class RouterReplicaSync:
+    """Publishes this router's slot transitions and applies the peers'."""
+
+    def __init__(self, runtime, namespace: str, component: str, sequences,
+                 router_id: Optional[str] = None):
+        self.runtime = runtime
+        self.subject = router_sync_subject(namespace, component)
+        self.sequences = sequences
+        self.router_id = router_id or uuid.uuid4().hex[:12]
+        self._cancel = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        # single-writer queue: publish order == transition order on the
+        # wire.  Independent fire-and-forget tasks could deliver free
+        # before its add (the event plane's first publish suspends setting
+        # up the socket), leaving phantom load on peers until stale-reap.
+        self._outbox: asyncio.Queue = asyncio.Queue()
+        self._send_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "RouterReplicaSync":
+        self._task = asyncio.create_task(self._recv_loop())
+        self._send_task = asyncio.create_task(self._send_loop())
+        return self
+
+    async def close(self) -> None:
+        self._cancel.set()
+        for t in (self._task, self._send_task):
+            if t is not None:
+                t.cancel()
+
+    # -- outbound ----------------------------------------------------------
+    def _publish(self, msg: dict) -> None:
+        msg["router_id"] = self.router_id
+        self._outbox.put_nowait(msg)
+
+    async def _send_loop(self) -> None:
+        try:
+            while True:
+                msg = await self._outbox.get()
+                try:
+                    await self.runtime.event_plane.publish(self.subject, msg)
+                except Exception:
+                    logger.warning("replica sync publish failed",
+                                   exc_info=True)
+        except asyncio.CancelledError:
+            pass
+
+    def publish_add(self, request_id: str, worker_id: int, blocks: int,
+                    overlap_blocks: int) -> None:
+        self._publish({"op": "add", "request_id": request_id,
+                       "worker_id": worker_id, "blocks": blocks,
+                       "overlap_blocks": overlap_blocks})
+
+    def publish_prefill_done(self, request_id: str) -> None:
+        self._publish({"op": "prefill_done", "request_id": request_id})
+
+    def publish_free(self, request_id: str) -> None:
+        self._publish({"op": "free", "request_id": request_id})
+
+    # -- inbound -----------------------------------------------------------
+    async def _recv_loop(self) -> None:
+        try:
+            async for _subj, msg in self.runtime.event_plane.subscribe(
+                self.subject, cancel=self._cancel
+            ):
+                try:
+                    self._apply(msg)
+                except Exception:
+                    # a malformed peer frame must not kill the loop — that
+                    # would silently revert this router to single-replica
+                    # load accounting
+                    logger.warning("dropping malformed replica-sync frame "
+                                   "%r", msg, exc_info=True)
+        except asyncio.CancelledError:
+            pass
+
+    def _apply(self, msg: dict) -> None:
+        peer = msg.get("router_id")
+        if peer is None or peer == self.router_id:
+            return  # own echo
+        key = f"{msg.get('request_id')}@{peer}"
+        op = msg.get("op")
+        if op == "add":
+            self.sequences.add_request(
+                key, int(msg["worker_id"]), int(msg["blocks"]),
+                int(msg.get("overlap_blocks", 0)),
+            )
+        elif op == "prefill_done":
+            self.sequences.mark_prefill_completed(key)
+        elif op == "free":
+            self.sequences.free(key)

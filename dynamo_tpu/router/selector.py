@@ -25,6 +25,11 @@ class KvRouterConfig:
     temperature: float = 0.0
     # workers above this KV utilization are deprioritized hard
     busy_kv_threshold: float = 0.95
+    # tie-break / sampling RNG seed.  None (the default) seeds from OS
+    # entropy so independent router replicas break cost ties DIFFERENTLY —
+    # a shared constant seed would send every frontend's tied picks to the
+    # same worker (thundering herd).  Set explicitly only in tests.
+    seed: Optional[int] = None
 
 
 @dataclass
@@ -37,7 +42,7 @@ class WorkerState:
 class DefaultWorkerSelector:
     def __init__(self, config: Optional[KvRouterConfig] = None):
         self.config = config or KvRouterConfig()
-        self._rng = random.Random(0xD1A)
+        self._rng = random.Random(self.config.seed)
 
     def select(
         self,

@@ -91,6 +91,11 @@ class ActiveSequences:
             + PREFILL_WEIGHT * self._prefill_blocks.get(worker_id, 0.0)
         )
 
+    def overlap_of(self, request_id: str) -> int:
+        """Cached-block overlap recorded at pick time (0 if unknown)."""
+        req = self._reqs.get(request_id)
+        return req.overlap_blocks if req is not None else 0
+
     def active_requests(self, worker_id: Optional[int] = None) -> int:
         if worker_id is None:
             return len(self._reqs)

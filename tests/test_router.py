@@ -282,3 +282,79 @@ async def test_kv_router_late_join_full_replay():
 
     await router.close()
     await rt.shutdown()
+
+
+async def test_router_replica_sync_converges():
+    """Two router replicas over one fleet: each router's slot manager must
+    reflect the OTHER router's in-flight picks (add / prefill_done / free),
+    or multi-frontend deployments dogpile workers."""
+    from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+    from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.router.kv_router import KvRouter
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex
+    ).start()
+    args = MockEngineArgs(model_name="m", block_size=4, base_step_s=0.0005,
+                          prefill_s_per_token=0.0, decode_s_per_seq=0.0)
+    w1 = await MockerWorker(rt, args).start()
+    wid = w1.served.instance_id
+    comp = rt.namespace("dynamo").component("mocker")
+    cA = await comp.endpoint("generate").client().start()
+    cB = await comp.endpoint("generate").client().start()
+    rA = await KvRouter(rt, "dynamo", "mocker", cA, block_size=4).start()
+    rB = await KvRouter(rt, "dynamo", "mocker", cB, block_size=4).start()
+    await cA.wait_for_instances()
+    await cB.wait_for_instances()
+
+    req = PreprocessedRequest(
+        token_ids=list(range(40)), request_id="r1",
+        stop=StopConditions(max_tokens=8, ignore_eos=True),
+    )
+    picked = await rA.pick(req)
+    assert picked == wid
+    # B must learn about A's in-flight request via replica sync
+    for _ in range(100):
+        if rB.sequences.active_blocks(wid) > 0:
+            break
+        await asyncio.sleep(0.02)
+    assert rB.sequences.active_blocks(wid) == rA.sequences.active_blocks(wid)
+    assert rB.sequences.active_requests(wid) == 1
+
+    rA.mark_prefill_completed("r1")
+    for _ in range(100):
+        if rB.sequences.active_blocks(wid) == rA.sequences.active_blocks(wid) \
+                and rB.sequences._reqs.get(f"r1@{rA.sync.router_id}") is not None \
+                and rB.sequences._reqs[f"r1@{rA.sync.router_id}"].prefill_done:
+            break
+        await asyncio.sleep(0.02)
+    assert rB.sequences._reqs[f"r1@{rA.sync.router_id}"].prefill_done
+
+    rA.complete("r1")
+    for _ in range(100):
+        if rB.sequences.active_requests(wid) == 0:
+            break
+        await asyncio.sleep(0.02)
+    assert rB.sequences.active_blocks(wid) == 0.0
+
+    await rA.close()
+    await rB.close()
+    await cA.close()
+    await cB.close()
+    await w1.close()
+    await rt.shutdown()
+
+
+def test_selector_tiebreak_not_herded():
+    """Independent selector replicas must not break cost ties identically
+    (shared constant seed == thundering herd across frontends)."""
+    workers = list(range(8))
+    seqs = []
+    for _ in range(2):
+        sel = DefaultWorkerSelector(KvRouterConfig())
+        seqs.append([
+            sel.select(workers, 4, {}, {}) for _ in range(64)
+        ])
+    assert seqs[0] != seqs[1], "replicas picked identical tie-break sequences"
