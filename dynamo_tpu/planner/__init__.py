@@ -1,0 +1,26 @@
+"""Planner: load-driven autoscaling of worker fleets (the reference's L8).
+
+Ref: docs/design-docs/planner-design.md:15-46 — the control loop is
+OBSERVE (windowed load metrics off the event plane) → PREDICT (next-window
+load) → PROPOSE (replica counts from per-replica capacity targets) →
+RECONCILE (bounds, cooldown, step clamp) → EXECUTE (a connector that
+actually changes the fleet).  Connectors abstract the execution substrate
+the way the reference's VirtualConnector/KubernetesConnector pair does
+(components/src/dynamo/planner/connectors/): in-process worker fleets for
+tests, subprocess fleets for single-host deployments.
+"""
+
+from .connectors import CallbackConnector, Connector, SubprocessConnector
+from .metrics import LoadObserver
+from .planner import Planner, PlannerConfig
+from .predictor import make_predictor
+
+__all__ = [
+    "CallbackConnector",
+    "Connector",
+    "LoadObserver",
+    "Planner",
+    "PlannerConfig",
+    "SubprocessConnector",
+    "make_predictor",
+]

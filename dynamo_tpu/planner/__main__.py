@@ -1,0 +1,71 @@
+"""`python -m dynamo_tpu.planner` — autoscale a worker fleet on this host.
+
+The single-host deployment of the L8 control plane (ref:
+components/src/dynamo/planner/__main__.py): observes the fleet's load
+metrics and scales `python -m <worker-module>` subprocesses between
+--min-replicas and --max-replicas.
+
+Example (mocker fleet):
+    python -m dynamo_tpu.planner --component mocker \
+        --worker-module dynamo_tpu.mocker --worker-arg=--model-name=m
+"""
+
+import argparse
+import asyncio
+import logging
+
+from ..runtime import DistributedRuntime
+from .connectors import SubprocessConnector
+from .planner import Planner, PlannerConfig
+
+logger = logging.getLogger(__name__)
+
+
+def build_args() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dynamo_tpu.planner")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--worker-module", required=True,
+                   help="module spawned per replica (e.g. dynamo_tpu.mocker)")
+    p.add_argument("--worker-arg", action="append", default=[],
+                   help="argument passed to each worker (repeatable)")
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--target-active-per-replica", type=float, default=4.0)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--cooldown", type=float, default=5.0)
+    p.add_argument("--predictor", default="ema",
+                   choices=["constant", "ema", "linear"])
+    return p
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    args = build_args().parse_args()
+    rt = await DistributedRuntime.detached().start()
+    connector = SubprocessConnector(args.worker_module, args.worker_arg)
+    planner = Planner(
+        rt, args.namespace, args.component, connector,
+        PlannerConfig(
+            interval_s=args.interval,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            target_active_per_replica=args.target_active_per_replica,
+            cooldown_s=args.cooldown,
+            predictor=args.predictor,
+        ),
+    )
+    await connector.scale(args.min_replicas)
+    await planner.start()
+    print("planner running", flush=True)
+    try:
+        await rt.root_token.wait_killed()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await planner.close()
+    await connector.close()
+    await rt.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,101 @@
+"""EXECUTE: connectors that change the fleet.
+
+Ref: components/src/dynamo/planner/connectors/virtual.py:30 — the planner
+core never spawns anything itself; it hands a desired replica count to a
+connector.  CallbackConnector adapts any async spawn/stop pair (tests use
+it with in-process workers); SubprocessConnector manages `python -m ...`
+worker processes on this host (the single-host deployment story).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+from typing import Awaitable, Callable, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class Connector:
+    """scale() must be idempotent and return the applied replica count."""
+
+    async def current_replicas(self) -> int:
+        raise NotImplementedError
+
+    async def scale(self, replicas: int) -> int:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class CallbackConnector(Connector):
+    """spawn() -> handle, stop(handle); newest workers are stopped first
+    (they hold the least prefix cache)."""
+
+    def __init__(self, spawn: Callable[[], Awaitable],
+                 stop: Callable[[object], Awaitable[None]]):
+        self._spawn = spawn
+        self._stop = stop
+        self.handles: List[object] = []
+
+    async def current_replicas(self) -> int:
+        return len(self.handles)
+
+    async def scale(self, replicas: int) -> int:
+        while len(self.handles) < replicas:
+            self.handles.append(await self._spawn())
+        while len(self.handles) > replicas:
+            await self._stop(self.handles.pop())
+        return len(self.handles)
+
+    async def close(self) -> None:
+        await self.scale(0)
+
+
+class SubprocessConnector(Connector):
+    """One replica == one `python -m <module> <args>` process.
+
+    Processes share the session's discovery env; SIGTERM gives workers a
+    clean deregister (lease delete) before the kill escalation."""
+
+    def __init__(self, module: str, args: Sequence[str] = (),
+                 term_grace_s: float = 5.0):
+        self.module = module
+        self.args = list(args)
+        self.term_grace_s = term_grace_s
+        self.procs: List[asyncio.subprocess.Process] = []
+
+    async def current_replicas(self) -> int:
+        self.procs = [p for p in self.procs if p.returncode is None]
+        return len(self.procs)
+
+    async def scale(self, replicas: int) -> int:
+        await self.current_replicas()  # drop crashed procs first
+        while len(self.procs) < replicas:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", self.module, *self.args,
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL,
+            )
+            logger.info("planner spawned %s pid=%d", self.module, proc.pid)
+            self.procs.append(proc)
+        while len(self.procs) > replicas:
+            await self._terminate(self.procs.pop())
+        return len(self.procs)
+
+    async def _terminate(self, proc) -> None:
+        if proc.returncode is not None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            await asyncio.wait_for(proc.wait(), self.term_grace_s)
+        except asyncio.TimeoutError:
+            logger.warning("pid %d ignored SIGTERM; killing", proc.pid)
+            proc.kill()
+            await proc.wait()
+
+    async def close(self) -> None:
+        await self.scale(0)

@@ -1,0 +1,95 @@
+"""OBSERVE: windowed worker-load aggregation off the event plane.
+
+Workers already publish load_metrics.{ns}.{component} twice a second
+(engine/worker.py:_load_loop, mocker/worker.py).  The observer keeps the
+latest sample per worker, expires workers that stop publishing, and
+aggregates per component — no new wire protocol, the planner is a pure
+consumer of what serving already emits (ref: planner-design.md OBSERVE).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerSample:
+    active_seqs: int = 0
+    kv_usage: float = 0.0
+    seen_t: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class AggregateLoad:
+    workers: int = 0
+    active_seqs: int = 0
+    mean_kv_usage: float = 0.0
+
+    @property
+    def active_per_worker(self) -> float:
+        return self.active_seqs / self.workers if self.workers else 0.0
+
+
+class LoadObserver:
+    def __init__(self, runtime, namespace: str, component: str,
+                 stale_after_s: float = 3.0):
+        self.runtime = runtime
+        self.subject = f"load_metrics.{namespace}.{component}"
+        self.stale_after_s = stale_after_s
+        self.samples: Dict[int, WorkerSample] = {}
+        self._cancel = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "LoadObserver":
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def close(self) -> None:
+        self._cancel.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            async for subj, payload in self.runtime.event_plane.subscribe(
+                self.subject, cancel=self._cancel
+            ):
+                if subj != self.subject:
+                    # subscription is prefix-matched on both planes: a
+                    # sibling component ("backend2" vs "backend") must not
+                    # leak into this fleet's aggregate
+                    continue
+                w = payload.get("worker_id")
+                if w is None:
+                    continue
+                self.samples[w] = WorkerSample(
+                    active_seqs=int(payload.get("active_seqs", 0)),
+                    kv_usage=float(payload.get("kv_usage", 0.0)),
+                )
+        except asyncio.CancelledError:
+            pass
+
+    def aggregate(self) -> AggregateLoad:
+        now = time.monotonic()
+        for w in [w for w, s in self.samples.items()
+                  if now - s.seen_t > self.stale_after_s]:
+            del self.samples[w]  # dead or scaled-away worker
+        live = list(self.samples.values())
+        if not live:
+            return AggregateLoad()
+        return AggregateLoad(
+            workers=len(live),
+            active_seqs=sum(s.active_seqs for s in live),
+            mean_kv_usage=sum(s.kv_usage for s in live) / len(live),
+        )

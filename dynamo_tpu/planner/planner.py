@@ -1,0 +1,142 @@
+"""The planner control loop: PROPOSE + RECONCILE around observe/predict.
+
+Ref: docs/design-docs/planner-design.md:15-46 and
+components/src/dynamo/planner/core/base.py:74.  Per tick:
+
+  1. OBSERVE   aggregate fleet load (planner/metrics.py)
+  2. PREDICT   next-window active sequences (planner/predictor.py)
+  3. PROPOSE   replicas = ceil(predicted / target_active_per_replica);
+               KV pressure (mean usage over target) also forces +1 —
+               sequences parked on a full cache are invisible to
+               active_seqs but still need room
+  4. RECONCILE clamp to [min, max], one scale step per cooldown window,
+               scale down only after `down_stable_ticks` consecutive
+               under-target observations (down is cheap to delay, up is
+               not)
+  5. EXECUTE   connector.scale(n)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from .connectors import Connector
+from .metrics import LoadObserver
+from .predictor import make_predictor
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PlannerConfig:
+    interval_s: float = 2.0
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # capacity target: sustained active sequences one replica should carry
+    target_active_per_replica: float = 4.0
+    # KV pressure: mean usage above this proposes one extra replica
+    kv_pressure_threshold: float = 0.85
+    cooldown_s: float = 5.0          # min seconds between scale actions
+    max_step: int = 2                # max replica delta per action
+    down_stable_ticks: int = 3       # consecutive low ticks before down
+    predictor: str = "ema"
+    predictor_window: int = 8
+
+
+class Planner:
+    def __init__(self, runtime, namespace: str, component: str,
+                 connector: Connector,
+                 config: Optional[PlannerConfig] = None):
+        self.config = config or PlannerConfig()
+        self.observer = LoadObserver(runtime, namespace, component)
+        self.predictor = make_predictor(self.config.predictor,
+                                        self.config.predictor_window)
+        self.connector = connector
+        self._task: Optional[asyncio.Task] = None
+        self._last_action_t = 0.0
+        self._low_ticks = 0
+        # audit trail (observability); bounded like the predictor window
+        self.decisions: deque = deque(maxlen=256)
+
+    async def start(self) -> "Planner":
+        await self.observer.start()
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.observer.close()
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.config.interval_s)
+                try:
+                    await self.tick()
+                except Exception:
+                    logger.exception("planner tick failed")
+        except asyncio.CancelledError:
+            pass
+
+    async def tick(self) -> Optional[int]:
+        """One control iteration; returns the applied replica count if a
+        scale action was taken, else None."""
+        c = self.config
+        load = self.observer.aggregate()
+        current = await self.connector.current_replicas()
+        if current > 0 and load.workers == 0:
+            # replicas exist but none are reporting: telemetry loss (or
+            # workers still booting), not zero load.  HOLD — scaling down a
+            # busy fleet on lost metrics kills mid-flight requests.
+            logger.warning("planner: %d replicas but no load samples; "
+                           "holding", current)
+            return None
+        self.predictor.observe(float(load.active_seqs))
+        predicted = self.predictor.predict()
+
+        proposed = math.ceil(predicted / c.target_active_per_replica)
+        if load.workers and load.mean_kv_usage >= c.kv_pressure_threshold:
+            proposed += 1
+        # min_replicas=0 is scale-to-zero: the floor comes only from config
+        proposed = max(c.min_replicas, min(c.max_replicas, proposed))
+
+        # RECONCILE
+        if proposed < current:
+            self._low_ticks += 1
+            if self._low_ticks < c.down_stable_ticks:
+                return None
+        else:
+            self._low_ticks = 0
+        if proposed == current:
+            return None
+        now = time.monotonic()
+        if now - self._last_action_t < c.cooldown_s:
+            return None
+        step = max(-c.max_step, min(c.max_step, proposed - current))
+        target = current + step
+
+        applied = await self.connector.scale(target)
+        self._last_action_t = now
+        self._low_ticks = 0  # hysteresis restarts after every action
+        decision = {
+            "t": now, "observed_active": load.active_seqs,
+            "predicted": predicted, "kv_usage": load.mean_kv_usage,
+            "current": current, "proposed": proposed, "applied": applied,
+        }
+        self.decisions.append(decision)
+        logger.info("planner: active=%d predicted=%.1f kv=%.2f %d->%d",
+                    load.active_seqs, predicted, load.mean_kv_usage,
+                    current, applied)
+        return applied

@@ -1,0 +1,254 @@
+"""Planner: predictor units, control-loop reconcile logic, and the e2e
+scale-up/scale-down cycle against a live mocker fleet.
+
+Mirrors the reference's planner test shape (planner-design.md: the loop is
+testable tick-by-tick; connectors absorb the execution substrate)."""
+
+import asyncio
+import uuid
+
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.planner import (
+    CallbackConnector,
+    Planner,
+    PlannerConfig,
+    make_predictor,
+)
+from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def fresh_runtime():
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+# ----------------------------- predictors --------------------------------
+
+
+def test_predictors():
+    c = make_predictor("constant")
+    for v in (1.0, 5.0, 3.0):
+        c.observe(v)
+    assert c.predict() == 3.0
+
+    e = make_predictor("ema", window=3)
+    for v in (0.0, 0.0, 8.0):
+        e.observe(v)
+    assert 0.0 < e.predict() < 8.0  # smoothed, lags the spike
+
+    lin = make_predictor("linear", window=4)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        lin.observe(v)
+    assert lin.predict() > 4.0  # extrapolates the ramp
+    lin2 = make_predictor("linear")
+    lin2.observe(5.0)
+    assert lin2.predict() == 5.0  # single sample: constant
+
+    try:
+        make_predictor("prophet")
+        raise AssertionError("unknown predictor must raise")
+    except ValueError:
+        pass
+
+
+# ----------------------------- reconcile ---------------------------------
+
+
+class _FakeConnector:
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+        self.calls = []
+
+    async def current_replicas(self):
+        return self.replicas
+
+    async def scale(self, n):
+        self.calls.append(n)
+        self.replicas = n
+        return n
+
+
+class _FakeObserver:
+    def __init__(self):
+        self.load = None
+
+    async def start(self):
+        return self
+
+    async def close(self):
+        pass
+
+    def aggregate(self):
+        return self.load
+
+
+def _bare_planner(cfg, connector):
+    p = Planner.__new__(Planner)
+    p.config = cfg
+    p.connector = connector
+    p.observer = _FakeObserver()
+    p.predictor = make_predictor("constant")
+    p._task = None
+    p._last_action_t = 0.0
+    p._low_ticks = 0
+    p.decisions = []
+    return p
+
+
+async def test_reconcile_bounds_cooldown_and_down_hysteresis():
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    cfg = PlannerConfig(min_replicas=1, max_replicas=4,
+                        target_active_per_replica=2.0, cooldown_s=0.0,
+                        max_step=2, down_stable_ticks=2)
+    conn = _FakeConnector(replicas=1)
+    p = _bare_planner(cfg, conn)
+
+    # spike to 12 active: proposed 6 -> clamped to max 4, step clamp 2/tick
+    p.observer.load = AggregateLoad(workers=1, active_seqs=12,
+                                    mean_kv_usage=0.2)
+    assert await p.tick() == 3
+    assert await p.tick() == 4
+    assert await p.tick() is None  # at max, no action
+
+    # load vanishes: down needs down_stable_ticks consecutive low ticks
+    p.observer.load = AggregateLoad(workers=4, active_seqs=0,
+                                    mean_kv_usage=0.0)
+    p.predictor = make_predictor("constant")  # forget the spike
+    assert await p.tick() is None   # low tick 1: hold
+    assert await p.tick() == 2      # low tick 2: scale down (step clamp)
+    assert await p.tick() is None   # hysteresis resets per action
+    assert await p.tick() == 1
+    assert conn.calls == [3, 4, 2, 1]
+
+
+async def test_kv_pressure_forces_scale_up():
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    cfg = PlannerConfig(min_replicas=1, max_replicas=4,
+                        target_active_per_replica=4.0, cooldown_s=0.0,
+                        kv_pressure_threshold=0.8)
+    conn = _FakeConnector(replicas=1)
+    p = _bare_planner(cfg, conn)
+    # few actives but cache nearly full: parked sequences need room
+    p.observer.load = AggregateLoad(workers=1, active_seqs=2,
+                                    mean_kv_usage=0.92)
+    assert await p.tick() == 2
+
+
+async def test_telemetry_loss_holds_instead_of_scaling_down():
+    """Zero samples with live replicas is lost telemetry, not zero load."""
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    cfg = PlannerConfig(min_replicas=1, max_replicas=4, cooldown_s=0.0,
+                        down_stable_ticks=1)
+    conn = _FakeConnector(replicas=3)
+    p = _bare_planner(cfg, conn)
+    p.observer.load = AggregateLoad()  # no workers reporting
+    for _ in range(5):
+        assert await p.tick() is None
+    assert conn.calls == []
+
+
+async def test_scale_to_zero_allowed_when_configured():
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    cfg = PlannerConfig(min_replicas=0, max_replicas=4, cooldown_s=0.0,
+                        down_stable_ticks=1, max_step=4)
+    conn = _FakeConnector(replicas=2)
+    p = _bare_planner(cfg, conn)
+    p.observer.load = AggregateLoad(workers=2, active_seqs=0,
+                                    mean_kv_usage=0.0)
+    assert await p.tick() == 0
+
+
+async def test_observer_ignores_sibling_component_subjects():
+    """Prefix-matched subscription must not leak backend2 into backend."""
+    from dynamo_tpu.planner import LoadObserver
+
+    rt = await fresh_runtime().start()
+    obs = await LoadObserver(rt, "dynamo", "backend").start()
+    for _ in range(100):
+        await rt.event_plane.publish(
+            "load_metrics.dynamo.backend2",
+            {"worker_id": 99, "active_seqs": 50, "kv_usage": 0.5},
+        )
+        await rt.event_plane.publish(
+            "load_metrics.dynamo.backend",
+            {"worker_id": 1, "active_seqs": 2, "kv_usage": 0.1},
+        )
+        await asyncio.sleep(0.01)
+        if obs.aggregate().workers:
+            break
+    agg = obs.aggregate()
+    assert agg.workers == 1 and agg.active_seqs == 2
+    await obs.close()
+    await rt.shutdown()
+
+
+# ------------------------------- e2e -------------------------------------
+
+
+async def test_planner_scales_mocker_fleet_up_and_down():
+    """Load spike on a live mocker fleet scales replicas up; drain scales
+    them back down to min (the VirtualConnector e2e from the verdict)."""
+    rt = await fresh_runtime().start()
+    args = MockEngineArgs(model_name="m", block_size=4, base_step_s=0.02,
+                          prefill_s_per_token=0.0, decode_s_per_seq=0.0)
+
+    async def spawn():
+        return await MockerWorker(rt, args).start()
+
+    async def stop(w):
+        await w.close()
+
+    conn = CallbackConnector(spawn, stop)
+    await conn.scale(1)
+    planner = Planner(
+        rt, "dynamo", "mocker", conn,
+        PlannerConfig(min_replicas=1, max_replicas=3, cooldown_s=0.0,
+                      target_active_per_replica=2.0, max_step=4,
+                      down_stable_ticks=2, predictor="constant"),
+    )
+    await planner.observer.start()  # no background loop: manual ticks
+
+    client = await (rt.namespace("dynamo").component("mocker")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+
+    async def run_one(i):
+        req = PreprocessedRequest(
+            token_ids=list(range(i * 50, i * 50 + 16)),
+            request_id=f"load{i}",
+            stop=StopConditions(max_tokens=120, ignore_eos=True),
+        )
+        async for _ in client.generate(req.to_dict()):
+            pass
+
+    jobs = [asyncio.create_task(run_one(i)) for i in range(6)]
+    # wait for the load signal (mocker publishes every 0.5s)
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if planner.observer.aggregate().active_seqs >= 5:
+            break
+    assert planner.observer.aggregate().active_seqs >= 5
+    applied = await planner.tick()
+    assert applied == 3, f"expected scale to max under load, got {applied}"
+
+    await asyncio.gather(*jobs)
+    # drain: metrics must observe idle workers before down-ticks count
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        agg = planner.observer.aggregate()
+        if agg.active_seqs == 0 and agg.workers >= 2:
+            break
+    planner.predictor = make_predictor("constant")
+    assert await planner.tick() is None  # hysteresis tick 1
+    assert await planner.tick() == 1     # back to min
+    assert len(conn.handles) == 1
+
+    await planner.close()
+    await client.close()
+    await conn.close()
+    await rt.shutdown()
