@@ -24,8 +24,9 @@ from dynamo_tpu.models import llama
 BATCH = 8
 CTX = 512            # context tokens per sequence during decode
 BLOCK = 128          # lane-aligned paged blocks (Pallas decode kernel)
-STEPS = 64
+STEPS = 64           # timed dispatches (each FUSED_K decode steps)
 WARMUP = 8
+FUSED_K = 8          # decode steps fused per dispatch (engine default)
 
 # v5e: ~819 GB/s HBM BW; CPU fallback number is irrelevant (vs_baseline only
 # meaningful on TPU)
@@ -34,7 +35,8 @@ HBM_GBPS = 819.0
 
 def main() -> None:
     cfg = llama.PRESETS["llama-1b"]
-    max_blocks = CTX // BLOCK + STEPS // BLOCK + 2
+    total_positions = CTX + (WARMUP + STEPS) * FUSED_K
+    max_blocks = total_positions // BLOCK + 2
     num_blocks = BATCH * max_blocks + 1
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -49,12 +51,16 @@ def main() -> None:
         tables[b] = 1 + b * max_blocks + np.arange(max_blocks)
     tables = jnp.asarray(tables)
 
-    def decode_step(params, kv, tokens, positions, tables, ctx_lens):
-        logits, kv = llama.decode(params, cfg, kv, tokens, positions,
-                                  tables, ctx_lens)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+    # the engine's decode hot path: FUSED_K steps per dispatch
+    # (EngineConfig.decode_fused_steps default; models/llama.py
+    # decode_multi) — per-dispatch overhead dominates the single-step loop
+    # on this platform, so serving bursts k steps per compiled call
+    def decode_burst(params, kv, tokens, positions, tables, ctx_lens):
+        toks, kv = llama.decode_multi(params, cfg, kv, tokens, positions,
+                                      tables, ctx_lens, FUSED_K)
+        return toks[-1], kv
 
-    step = jax.jit(decode_step, donate_argnums=(1,))
+    step = jax.jit(decode_burst, donate_argnums=(1,))
 
     tokens = jnp.asarray(rng.integers(3, cfg.vocab_size, BATCH, np.int32))
     ctx_lens = jnp.full((BATCH,), CTX, jnp.int32)
@@ -65,25 +71,27 @@ def main() -> None:
     # single final fetch (which is also how a local-TPU serving loop runs:
     # sampled ids chain on device).
     for i in range(WARMUP):
-        tokens, kv = step(params, kv, tokens, ctx_lens + i, tables,
-                          ctx_lens + i)
+        tokens, kv = step(params, kv, tokens, ctx_lens + i * FUSED_K,
+                          tables, ctx_lens + i * FUSED_K)
     np.asarray(tokens)
 
+    base = WARMUP * FUSED_K
     t0 = time.perf_counter()
     for i in range(STEPS):
-        tokens, kv = step(params, kv, tokens, ctx_lens + WARMUP + i, tables,
-                          ctx_lens + WARMUP + i)
+        pos = ctx_lens + base + i * FUSED_K
+        tokens, kv = step(params, kv, tokens, pos, tables, pos)
     np.asarray(tokens)  # forces completion of the whole dependent chain
     dt = time.perf_counter() - t0
 
-    tps = BATCH * STEPS / dt
+    tps = BATCH * STEPS * FUSED_K / dt
 
     # bandwidth roofline for these shapes (per decoded token):
     #   params read once per step, amortized over the batch
     #   + this seq's KV context read (K and V)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     param_bytes = n_params * 2
-    kv_bytes = (cfg.n_layers * (CTX + WARMUP + STEPS / 2)
+    kv_bytes = (cfg.n_layers
+                * (CTX + (WARMUP + STEPS / 2) * FUSED_K)
                 * cfg.n_kv_heads * cfg.head_dim * 2 * 2)
     bytes_per_token = param_bytes / BATCH + kv_bytes
     roofline_tps = HBM_GBPS * 1e9 / bytes_per_token

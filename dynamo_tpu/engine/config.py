@@ -29,6 +29,13 @@ class EngineConfig:
 
     # batching
     max_num_seqs: int = 8
+
+    # decode burst: fuse this many decode steps into ONE compiled program
+    # (lax.scan) when no prefill/admission work is pending.  Dispatch
+    # overhead dominates the single-step hot loop on this platform; fusing
+    # amortizes it k-fold at the cost of k-token output bursts and up to
+    # k-1 wasted steps when a sequence finishes mid-burst.  1 disables.
+    decode_fused_steps: int = 8
     prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
     # per-scheduler-step token budget: one prefill chunk is capped to
     # max_batch_tokens minus one token per decoding slot, so decode ITL is

@@ -199,6 +199,13 @@ class JaxEngine:
         )
         self._jit_inject = jax.jit(self._inject_impl, donate_argnums=(0,))
         self._jit_gather = jax.jit(self._gather_impl)
+        self._jit_decode_multi = None
+        if config.decode_fused_steps > 1:
+            self._jit_decode_multi = jax.jit(
+                partial(self._decode_multi_impl, self.model_cfg,
+                        config.decode_fused_steps),
+                donate_argnums=(1,),
+            )
 
         self.waiting: List[_Slot] = []
         self._sched_calls: List[tuple] = []  # (fn, future) run between steps
@@ -239,6 +246,23 @@ class JaxEngine:
         )
         next_tokens = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
         return next_tokens, kv
+
+    @staticmethod
+    def _decode_multi_impl(model_cfg, num_steps, params, kv, tokens,
+                           positions, block_tables, ctx_lens, seeds, steps,
+                           temps, top_ks, top_ps):
+        """num_steps fused decode steps (models/llama.py decode_multi);
+        sampling streams stay per-token identical to the single-step path
+        (seed folded with the running step counter)."""
+
+        def sample_fn(logits, step_idx):
+            return sample_tokens(logits, seeds, steps + step_idx, temps,
+                                 top_ks, top_ps)
+
+        return llama.decode_multi(
+            params, model_cfg, kv, tokens, positions, block_tables,
+            ctx_lens, num_steps, sample_fn,
+        )
 
     @staticmethod
     def _inject_impl(kv, kb, vb, ids):
@@ -857,14 +881,29 @@ class JaxEngine:
             slot.out_q.put_nowait(out)
 
     # -- decode -----------------------------------------------------------
+    def _fused_k(self) -> int:
+        """Decode-burst size for this step.  Burst only when the scheduler
+        has no other work: pending admissions or prefill chunks must run
+        between single decode steps (chunked-prefill interleaving), and a
+        burst would hold them back k steps."""
+        c = self.config
+        if (self._jit_decode_multi is None or self.waiting
+                or any(s is not None and s.prefilling for s in self._slots)):
+            return 1
+        return c.decode_fused_steps
+
     def _decode_step(self) -> None:
         c = self.config
         B = c.max_num_seqs
+        k = self._fused_k()
         active = [s for s in self._slots
                   if s is not None and not s.prefilling]
         if not active:
             return
-        # every active slot needs a block for position ctx_len
+        # Every active slot MUST have a block for position ctx_len (preempt
+        # if even that fails); blocks for the rest of the burst are
+        # speculative — under allocation pressure degrade to k=1 instead of
+        # preempting a sequence for blocks it won't need for k-1 more steps.
         for slot in active:
             nblocks = int(np.count_nonzero(slot.block_table))
             if slot.ctx_len >= nblocks * c.block_size:
@@ -874,6 +913,17 @@ class JaxEngine:
                     self._preempt(slot)
                     continue
                 slot.block_table[nblocks] = grow.block_id
+                nblocks += 1
+            while k > 1 and slot.ctx_len + k - 1 >= nblocks * c.block_size:
+                if nblocks >= c.max_blocks_per_seq:
+                    break  # capacity finish handled by _finish_reason
+                grow = self.allocator.append_block(self._seq_id(slot))
+                self._emit_events(grow)
+                if grow.block_id is None:
+                    k = 1  # pressure: this step runs single-step
+                    break
+                slot.block_table[nblocks] = grow.block_id
+                nblocks += 1
 
         active = [s for s in self._slots
                   if s is not None and not s.prefilling]
@@ -901,17 +951,28 @@ class JaxEngine:
             top_ks[i] = s.request.sampling.top_k
             top_ps[i] = s.request.sampling.top_p
 
-        next_tokens, self.kv = self._jit_decode(
+        args = (
             self.params, self.kv,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(ctx_lens), jnp.asarray(seeds), jnp.asarray(steps),
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
         )
-        next_tokens = np.asarray(next_tokens)
+        if k > 1:
+            burst, self.kv = self._jit_decode_multi(*args)  # [k, B]
+            burst = np.asarray(burst)
+        else:
+            next_tokens, self.kv = self._jit_decode(*args)
+            burst = np.asarray(next_tokens)[None]  # [1, B]
         for s in active:
-            s.ctx_len += 1
-            self.metrics["decode_tokens"] += 1
-            self._push_token(s, int(next_tokens[s.index]))
+            for j in range(burst.shape[0]):
+                s.ctx_len += 1
+                self.metrics["decode_tokens"] += 1
+                self._push_token(s, int(burst[j, s.index]))
+                if s.finished:
+                    # mid-burst finish: trailing sampled tokens discarded
+                    # (their KV writes landed in this slot's own blocks,
+                    # which are never committed past the finish ctx_len)
+                    break
 
     def _commit_full_blocks(self, slot: _Slot) -> None:
         """Register newly-completed full blocks under their PLH.

@@ -290,3 +290,48 @@ async def test_sync_sink_removed_published_before_stored():
     await pub._flush()
     assert [p["op"] for p in published] == ["removed", "stored", "stored"]
     assert [p["event_id"] for p in published] == [0, 1, 2]
+
+
+async def test_fused_decode_matches_single_step():
+    """decode_fused_steps must not change outputs: greedy and sampled
+    streams are token-identical to the single-step path (same seed
+    folding), including mid-burst EOS/length finishes."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    cfg32 = LlamaConfig(name="tiny32", vocab_size=256, d_model=64,
+                        n_layers=2, n_heads=4, n_kv_heads=2, head_dim=16,
+                        ffn_dim=128, dtype=jnp.float32)
+    base = dict(model_config=cfg32, block_size=4, num_blocks=64,
+                max_blocks_per_seq=16, max_num_seqs=2,
+                prefill_buckets=(8, 16), seed=11)
+
+    async def run(fused, rid, temperature, n):
+        eng = JaxEngine(EngineConfig(decode_fused_steps=fused, **base))
+        req = PreprocessedRequest(
+            token_ids=list(range(7, 20)), request_id=rid,
+            sampling=SamplingOptions(temperature=temperature, seed=123),
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+        )
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids)
+        await eng.close()
+        return toks
+
+    # greedy, n not a multiple of the burst (mid-burst length finish)
+    single = await run(1, "s", 0.0, 11)
+    fused = await run(8, "f", 0.0, 11)
+    assert fused == single and len(fused) == 11
+
+    # sampled: per-token rng streams must line up across burst boundaries
+    single = await run(1, "s2", 0.9, 10)
+    fused = await run(4, "f2", 0.9, 10)
+    assert fused == single
