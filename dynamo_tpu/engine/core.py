@@ -240,9 +240,10 @@ class JaxEngine:
     # -- jitted programs --------------------------------------------------
     @staticmethod
     def _decode_impl(model_cfg, params, kv, tokens, positions, block_tables,
-                     ctx_lens, seeds, steps, temps, top_ks, top_ps):
+                     ctx_lens, seeds, steps, temps, top_ks, top_ps, valid):
         logits, kv = llama.decode(
-            params, model_cfg, kv, tokens, positions, block_tables, ctx_lens
+            params, model_cfg, kv, tokens, positions, block_tables,
+            ctx_lens, valid=valid,
         )
         next_tokens = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
         return next_tokens, kv
@@ -250,7 +251,7 @@ class JaxEngine:
     @staticmethod
     def _decode_multi_impl(model_cfg, num_steps, params, kv, tokens,
                            positions, block_tables, ctx_lens, seeds, steps,
-                           temps, top_ks, top_ps):
+                           temps, top_ks, top_ps, valid):
         """num_steps fused decode steps (models/llama.py decode_multi);
         sampling streams stay per-token identical to the single-step path
         (seed folded with the running step counter)."""
@@ -261,7 +262,7 @@ class JaxEngine:
 
         return llama.decode_multi(
             params, model_cfg, kv, tokens, positions, block_tables,
-            ctx_lens, num_steps, sample_fn,
+            ctx_lens, num_steps, sample_fn, valid=valid,
         )
 
     @staticmethod
@@ -939,6 +940,7 @@ class JaxEngine:
         temps = np.zeros(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         top_ps = np.ones(B, np.float32)
+        valid = np.zeros(B, bool)  # padding rows must not eat MoE capacity
         for s in active:
             i = s.index
             tokens[i] = s.last_token
@@ -950,12 +952,14 @@ class JaxEngine:
             temps[i] = s.request.sampling.temperature
             top_ks[i] = s.request.sampling.top_k
             top_ps[i] = s.request.sampling.top_p
+            valid[i] = True
 
         args = (
             self.params, self.kv,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(ctx_lens), jnp.asarray(seeds), jnp.asarray(steps),
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(valid),
         )
         if k > 1:
             burst, self.kv = self._jit_decode_multi(*args)  # [k, B]

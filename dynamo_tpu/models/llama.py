@@ -49,6 +49,24 @@ class LlamaConfig:
     # stop-token set (instruct checkpoints often declare several, e.g.
     # llama-3's <|end_of_text|> and <|eot_id|>)
     eos_token_ids: Tuple[int, ...] = (2,)
+    # MoE (Mixtral-family): 0 experts = dense MLP.  Experts shard over the
+    # "tp" mesh axis (EP reuses tp, parallel/mesh.py moe_w_* rules).
+    # Dispatch modes:
+    #   "dense"    — every expert computes every token; the router weight
+    #                matrix masks the combine.  DROPLESS and batch-
+    #                invariant (same token -> same output regardless of
+    #                chunking/co-batch), which prefix caching and greedy
+    #                determinism rely on.  Costs E/k x the routed MLP
+    #                FLOPs — the right trade for decode (bandwidth-bound)
+    #                and correctness-critical serving.
+    #   "capacity" — GShard capacity dispatch: tokens over an expert's
+    #                C = ceil(T*k/E * capacity_factor) are dropped.  k/E
+    #                of the FLOPs, but outputs vary with batch shape; use
+    #                for throughput-oriented long-prefill deployments.
+    n_experts: int = 0
+    experts_per_token: int = 2
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 1.25
 
     @property
     def q_dim(self) -> int:
@@ -85,6 +103,18 @@ PRESETS: Dict[str, LlamaConfig] = {
         n_heads=64, n_kv_heads=8, head_dim=128, ffn_dim=25600,
         qk_norm=True, rope_theta=1000000.0, max_context=40960,
     ),
+    # MoE family
+    "tiny-moe": LlamaConfig(
+        name="tiny-moe", vocab_size=256, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,
+        n_experts=4, experts_per_token=2,
+    ),
+    "mixtral-8x7b": LlamaConfig(
+        name="mixtral-8x7b", vocab_size=32000, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
+        rope_theta=1000000.0, max_context=32768,
+        n_experts=8, experts_per_token=2,
+    ),
 }
 
 
@@ -119,10 +149,20 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
             "wk": dense(k[1], (cfg.d_model, cfg.kv_dim)),
             "wv": dense(k[2], (cfg.d_model, cfg.kv_dim)),
             "wo": dense(k[3], (cfg.q_dim, cfg.d_model)),
-            "w_gate": dense(k[4], (cfg.d_model, cfg.ffn_dim)),
-            "w_up": dense(k[5], (cfg.d_model, cfg.ffn_dim)),
-            "w_down": dense(k[6], (cfg.ffn_dim, cfg.d_model)),
         }
+        if cfg.n_experts > 0:
+            E = cfg.n_experts
+            layer["moe_gate"] = dense(k[4], (cfg.d_model, E))
+            layer["moe_w_gate"] = dense(k[5], (E, cfg.d_model, cfg.ffn_dim),
+                                        scale=1.0 / math.sqrt(cfg.d_model))
+            layer["moe_w_up"] = dense(k[6], (E, cfg.d_model, cfg.ffn_dim),
+                                      scale=1.0 / math.sqrt(cfg.d_model))
+            layer["moe_w_down"] = dense(k[7], (E, cfg.ffn_dim, cfg.d_model),
+                                        scale=1.0 / math.sqrt(cfg.ffn_dim))
+        else:
+            layer["w_gate"] = dense(k[4], (cfg.d_model, cfg.ffn_dim))
+            layer["w_up"] = dense(k[5], (cfg.d_model, cfg.ffn_dim))
+            layer["w_down"] = dense(k[6], (cfg.ffn_dim, cfg.d_model))
         if cfg.qk_norm:
             layer["q_norm"] = {"norm": jnp.ones((cfg.head_dim,), jnp.float32)}
             layer["k_norm"] = {"norm": jnp.ones((cfg.head_dim,), jnp.float32)}
@@ -178,6 +218,103 @@ def _mlp(layer, x: jax.Array) -> jax.Array:
     ]
 
 
+def _moe_router(layer, cfg: LlamaConfig, x: jax.Array):
+    """Top-k routing: returns (weights [T,k] softmaxed, expert ids [T,k]).
+
+    topk-then-softmax == HF Mixtral's softmax-topk-renormalize (softmax of
+    the selected logits), verified against transformers in
+    tests/test_loader.py."""
+    router = (x.astype(jnp.float32) @ layer["moe_gate"].astype(jnp.float32))
+    top_w, top_e = jax.lax.top_k(router, cfg.experts_per_token)
+    return jax.nn.softmax(top_w, axis=-1), top_e
+
+
+def _moe_mlp_dense(layer, cfg: LlamaConfig, x: jax.Array,
+                   valid: Optional[jax.Array] = None) -> jax.Array:
+    """Dropless masked-dense MoE: all experts compute all tokens, the
+    router matrix masks the combine.  Batch-invariant by construction.
+
+    With experts sharded over tp, the expert einsums run local to each
+    shard and the final combine reduces over the expert axis (one psum on
+    the way out) — no dispatch tensors, no all-to-all."""
+    T, d = x.shape
+    E = cfg.n_experts
+    top_w, top_e = _moe_router(layer, cfg, x)
+    wmat = jnp.zeros((T, E), jnp.float32).at[
+        jnp.arange(T)[:, None], top_e
+    ].set(top_w)                                       # [T, E]
+    if valid is not None:
+        wmat = wmat * valid.astype(jnp.float32)[:, None]
+    h = jnp.einsum("td,edf->etf", x, layer["moe_w_gate"])
+    h = jax.nn.silu(h) * jnp.einsum("td,edf->etf", x, layer["moe_w_up"])
+    eout = jnp.einsum("etf,efd->etd", h, layer["moe_w_down"])
+    return jnp.einsum("etd,te->td", eout, wmat.astype(cfg.dtype))
+
+
+def _moe_mlp(layer, cfg: LlamaConfig, x: jax.Array,
+             valid: Optional[jax.Array] = None) -> jax.Array:
+    """Top-k routed expert MLP, GShard capacity-dispatch formulation.
+
+    x [T, d] -> [T, d].  Every step is a static-shape einsum so GSPMD can
+    shard the expert axis (EP over the "tp" mesh axis via the moe_w_* rules
+    in parallel/mesh.py) and insert the dispatch/combine all-to-alls —
+    the TPU-native expression of the reference's EP path (SURVEY §2.4).
+    Tokens past an expert's capacity C = ceil(T*k/E * capacity_factor) are
+    dropped (their residual stream passes through), the standard
+    inference-time overflow policy.
+
+    `valid` [T] bool masks batch-padding rows OUT of dispatch entirely:
+    the serving engine decodes a fixed batch whose inactive slots all embed
+    token 0, route identically, and would otherwise eat the real tokens'
+    expert capacity."""
+    T, d = x.shape
+    E, k = cfg.n_experts, cfg.experts_per_token
+    C = max(1, math.ceil(T * k / E * cfg.moe_capacity_factor))
+
+    top_w, top_e = _moe_router(layer, cfg, x)          # [T, k]
+    e_flat = top_e.reshape(-1)                         # [T*k]
+    w_flat = top_w.reshape(-1)
+    onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)       # [Tk, E]
+    if valid is not None:
+        onehot = onehot * jnp.repeat(valid.astype(jnp.int32), k)[:, None]
+    # each (token, slot)'s position within its expert's capacity buffer;
+    # masked rows have all-zero onehot so they claim no position, and
+    # one_hot(pos, C) zeroes any row with pos >= C (capacity drop)
+    pos = jnp.take_along_axis(
+        jnp.cumsum(onehot, axis=0) - onehot, e_flat[:, None], axis=1
+    )[:, 0]                                            # [Tk]
+    # dispatch [Tk, E, C]: one-hot (expert, slot) placement
+    disp = onehot.astype(jnp.float32)[:, :, None] \
+        * jax.nn.one_hot(pos, C, dtype=jnp.float32)[:, None, :]
+    comb = disp * w_flat[:, None, None]                # combine weights
+
+    x_rep = jnp.repeat(x, k, axis=0)                   # [Tk, d]
+    ein = jnp.einsum("sec,sd->ecd", disp.astype(cfg.dtype), x_rep)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ein, layer["moe_w_gate"])) \
+        * jnp.einsum("ecd,edf->ecf", ein, layer["moe_w_up"])
+    eout = jnp.einsum("ecf,efd->ecd", h, layer["moe_w_down"])
+    out = jnp.einsum("sec,ecd->sd", comb.astype(cfg.dtype), eout)
+    return out.reshape(T, k, d).sum(axis=1)
+
+
+def _ffn(layer, cfg: LlamaConfig, x: jax.Array,
+         valid: Optional[jax.Array] = None) -> jax.Array:
+    """Dense or routed MLP over [..., d] (leading dims flattened for MoE)."""
+    if cfg.n_experts <= 0:
+        return _mlp(layer, x)
+    if cfg.moe_dispatch not in ("dense", "capacity"):
+        raise ValueError(
+            f"moe_dispatch must be 'dense' or 'capacity', "
+            f"got {cfg.moe_dispatch!r}"
+        )
+    lead = x.shape[:-1]
+    if valid is not None:
+        valid = valid.reshape(-1)
+    moe = _moe_mlp if cfg.moe_dispatch == "capacity" else _moe_mlp_dense
+    out = moe(layer, cfg, x.reshape(-1, x.shape[-1]), valid)
+    return out.reshape(*lead, x.shape[-1])
+
+
 def _logits(params, cfg: LlamaConfig, x: jax.Array) -> jax.Array:
     x = rms_norm(x, params["final_norm"]["norm"], cfg.rms_eps)
     if cfg.tie_embeddings:
@@ -220,7 +357,9 @@ def prefill(
         )
         x = x + attn.reshape(x.shape[0], cfg.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        # padding tokens past true_len must not eat MoE expert capacity
+        x = x + _ffn(layer, cfg, h,
+                     valid=jnp.arange(x.shape[0]) < true_len)
     last = jnp.maximum(true_len - 1, 0)
     logits = _logits(params, cfg, x[last])
     return logits, (k_cache, v_cache)
@@ -239,6 +378,7 @@ def decode(
     positions: jax.Array,      # [B] int32
     block_tables: jax.Array,   # [B, max_blocks] int32
     ctx_lens: jax.Array,       # [B] int32, tokens in cache BEFORE this step
+    valid: Optional[jax.Array] = None,  # [B] bool: active (non-padding) slots
 ):
     """One decode step for B slots.  Writes each token's K/V, attends over
     the paged context, returns (logits [B, vocab], updated kv_cache)."""
@@ -257,7 +397,7 @@ def decode(
         )  # [B, nh, hd]
         x = x + attn.reshape(x.shape[0], cfg.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
-        x = x + _mlp(layer, h)
+        x = x + _ffn(layer, cfg, h, valid=valid)
     logits = _logits(params, cfg, x)  # [B, vocab]
     return logits, (k_cache, v_cache)
 
@@ -272,6 +412,7 @@ def decode_multi(
     ctx_lens: jax.Array,       # [B] int32
     num_steps: int,
     sample_fn=None,            # (logits [B,V], step_idx) -> tokens [B]
+    valid: Optional[jax.Array] = None,  # [B] bool: active slots
 ):
     """`num_steps` fused decode steps in ONE compiled program (lax.scan).
 
@@ -289,7 +430,8 @@ def decode_multi(
 
     def body(carry, step_idx):
         tokens, kv, pos, cls = carry
-        logits, kv = decode(params, cfg, kv, tokens, pos, block_tables, cls)
+        logits, kv = decode(params, cfg, kv, tokens, pos, block_tables, cls,
+                            valid=valid)
         nt = sample_fn(logits, step_idx).astype(jnp.int32)
         return (nt, kv, pos + 1, cls + 1), nt
 

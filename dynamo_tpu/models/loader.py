@@ -41,6 +41,7 @@ from .llama import LlamaConfig
 _ARCHS = {
     "LlamaForCausalLM": {},
     "MistralForCausalLM": {},
+    "MixtralForCausalLM": {},  # MoE fields read from config.json below
     "Qwen2ForCausalLM": {},
     "Qwen3ForCausalLM": {"qk_norm": True},
 }
@@ -77,6 +78,8 @@ def load_hf_config(model_path: str, dtype=jnp.bfloat16) -> LlamaConfig:
         max_context=int(hf.get("max_position_embeddings", 8192)),
         dtype=dtype,
         eos_token_ids=eos_ids or (2,),
+        n_experts=int(hf.get("num_local_experts", 0)),
+        experts_per_token=int(hf.get("num_experts_per_tok", 2)),
         **_ARCHS[arch],
     )
 
@@ -115,6 +118,16 @@ _LAYER_MAP = {
 }
 
 _NORM_KEYS = {"attn_norm", "mlp_norm", "q_norm", "k_norm"}
+
+# Mixtral MoE layer tensors.  HF keeps one tensor per expert
+# (...block_sparse_moe.experts.E.w{1,2,3}.weight); our pytree stacks them
+# [n_experts, ...] so EP shards one array over the tp axis.  w1=gate,
+# w3=up, w2=down (all HF Linear [out, in], transposed like the dense maps).
+_MOE_GATE = "block_sparse_moe.gate.weight"
+_MOE_EXPERT_RE = re.compile(
+    r"^block_sparse_moe\.experts\.(\d+)\.(w1|w2|w3)\.weight$"
+)
+_MOE_W_MAP = {"w1": "moe_w_gate", "w3": "moe_w_up", "w2": "moe_w_down"}
 
 
 def _iter_safetensors(model_path: str):
@@ -160,11 +173,37 @@ def load_params(
     params: Dict[str, Any] = {
         "layers": [dict() for _ in range(cfg.n_layers)]
     }
-    seen = set()
+    # per-layer expert tensors stream into ONE preallocated stacked array
+    # (host RAM peak = one [E, ...] array per in-flight weight kind, not
+    # E separate copies + a stack)
+    moe_stage: Dict[int, Dict[str, Any]] = {}  # li -> w -> (buf, seen_set)
     for name, tensor in _iter_safetensors(model_path):
         m = _LAYER_RE.match(name)
         if m:
             li, suffix = int(m.group(1)), m.group(2)
+            em = _MOE_EXPERT_RE.match(suffix)
+            if em:
+                e, w = int(em.group(1)), _MOE_W_MAP[em.group(2)]
+                t = tensor.T
+                stage = moe_stage.setdefault(li, {})
+                if w not in stage:
+                    stage[w] = (
+                        np.empty((cfg.n_experts,) + t.shape, cfg.dtype),
+                        set(),
+                    )
+                buf, got = stage[w]
+                buf[e] = t
+                got.add(e)
+                if len(got) == cfg.n_experts:
+                    params["layers"][li][w] = put(w, buf)
+                    del stage[w]
+                continue
+            if suffix == _MOE_GATE:
+                params["layers"][li]["moe_gate"] = put(
+                    "moe_gate",
+                    np.ascontiguousarray(tensor.T).astype(cfg.dtype),
+                )
+                continue
             if suffix not in _LAYER_MAP:
                 raise ValueError(f"unmapped layer tensor {name!r}")
             key, transpose = _LAYER_MAP[suffix]
@@ -189,7 +228,6 @@ def load_params(
             }
         else:
             raise ValueError(f"unmapped tensor {name!r}")
-        seen.add(name)
 
     if cfg.tie_embeddings:
         params.pop("lm_head", None)
@@ -209,6 +247,16 @@ def load_params(
     want = set(_LAYER_MAP)
     if not cfg.qk_norm:
         want -= {"self_attn.q_norm.weight", "self_attn.k_norm.weight"}
+    if cfg.n_experts > 0:
+        # routed MLP replaces the dense one: gate tensor + 3 stacked
+        # expert arrays instead of the 3 dense projections
+        want -= {"mlp.gate_proj.weight", "mlp.up_proj.weight",
+                 "mlp.down_proj.weight"}
+        want |= {"moe_gate", "moe_w_gate", "moe_w_up", "moe_w_down"}
+    missing.extend(
+        f"model.layers.{li} expert tensors {sorted(parts)}"
+        for li, parts in moe_stage.items() if parts
+    )
     for li, layer in enumerate(params["layers"]):
         got = len(layer)
         if got != len(want):

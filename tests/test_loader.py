@@ -137,3 +137,66 @@ async def test_engine_serves_real_checkpoint_greedy_matches_hf(
         toks.extend(o.token_ids)
     await eng.close()
     assert toks == out
+
+
+TINY_MIXTRAL = dict(
+    hidden_size=64,
+    intermediate_size=96,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    num_hidden_layers=2,
+    vocab_size=256,
+    num_local_experts=4,
+    num_experts_per_tok=2,
+    rms_norm_eps=1e-5,
+    rope_theta=10000.0,
+    max_position_embeddings=512,
+    tie_word_embeddings=False,
+    torch_dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_mixtral_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tiny-mixtral-hf")
+    cfg = transformers.MixtralConfig(**TINY_MIXTRAL)
+    torch.manual_seed(0)
+    model = transformers.MixtralForCausalLM(cfg)
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path), model
+
+
+def test_mixtral_prefill_matches_hf_logits(tiny_mixtral_checkpoint):
+    """MoE loader + routing parity against HF Mixtral: our topk-then-softmax
+    equals HF's softmax-topk-renormalize, and the default dense dispatch is
+    dropless like HF, so prefill logits must match exactly."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.loader import load_hf_config, load_params
+
+    path, hf_model = tiny_mixtral_checkpoint
+    cfg = load_hf_config(path, dtype=jnp.float32)
+    assert cfg.n_experts == 4 and cfg.experts_per_token == 2
+    params = load_params(path, cfg)
+
+    token_ids = [5, 9, 13, 2, 7, 11, 3, 1, 8, 20, 100, 255]
+    T = len(token_ids)
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([token_ids])).logits[0].numpy()
+
+    bs, nblocks = 4, 8
+    kv = tuple(
+        jnp.zeros((cfg.n_layers, cfg.n_kv_heads, nblocks, cfg.head_dim, bs),
+                  cfg.dtype)
+        for _ in range(2)
+    )
+    table = jnp.asarray(np.arange(1, nblocks + 1, dtype=np.int32) % nblocks)
+    logits, kv = llama.prefill(
+        params, cfg, kv,
+        jnp.asarray(np.asarray(token_ids, np.int32)),
+        jnp.arange(T, dtype=jnp.int32), table,
+        jnp.int32(0), jnp.int32(T),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), ref[-1], rtol=3e-4, atol=3e-4
+    )
