@@ -37,10 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # promoted API in jax>=0.8; experimental path for older
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from ..parallel.compat import pvary, shard_map
 
 _NEG_INF = -1e30  # finite -inf stand-in: keeps exp/max NaN-free
 
@@ -98,15 +95,13 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
         o, m, l = attend(o, m, l, kr, vr, src)
         return o, m, l, kr, vr
 
-    o = jnp.zeros((tq, hkv, hq // hkv, d), jnp.float32)
-    m = jnp.full((tq, hkv, hq // hkv), _NEG_INF, jnp.float32)
-    l = jnp.zeros((tq, hkv, hq // hkv), jnp.float32)
     # constants start device-invariant; the accumulators become
     # device-varying after one update, so align the carry types (jax>=0.9
     # varying-manual-axes tracking)
-    if hasattr(lax, "pcast"):
-        o, m, l = (lax.pcast(x, (axis_name,), to="varying")
-                   for x in (o, m, l))
+    o = pvary(jnp.zeros((tq, hkv, hq // hkv, d), jnp.float32), axis_name)
+    m = pvary(jnp.full((tq, hkv, hq // hkv), _NEG_INF, jnp.float32),
+              axis_name)
+    l = pvary(jnp.zeros((tq, hkv, hq // hkv), jnp.float32), axis_name)
     o, m, l = attend(o, m, l, k, v, my_idx)
     o, m, l, _, _ = lax.fori_loop(1, axis_size, step, (o, m, l, k, v))
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output

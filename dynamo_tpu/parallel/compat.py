@@ -1,0 +1,19 @@
+"""jax version-compat shims shared by the shard_map-based parallel ops
+(ops/ring_attention.py, parallel/pipeline.py)."""
+
+from __future__ import annotations
+
+from jax import lax
+
+try:  # promoted API in jax>=0.8; experimental path for older
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def pvary(x, axis_name: str):
+    """Mark a device-invariant value as varying over `axis_name` (jax>=0.9
+    varying-manual-axes tracking); identity on older jax."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return x

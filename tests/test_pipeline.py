@@ -1,0 +1,79 @@
+"""Pipeline parallelism: rotating-schedule correctness on the virtual mesh.
+
+The property under test: pipeline_apply(stage_fn over S sharded stages)
+produces exactly the sequential composition stage_{S-1} ∘ ... ∘ stage_0,
+and per-stage state updated during bubbles is untouched."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from dynamo_tpu.parallel.pipeline import pipeline_apply
+
+
+def pp_mesh(n):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(devs[:n]), axis_names=("pp",))
+
+
+def mlp_stage(params, state, x, active):
+    """Two-matmul stage; counts the tokens it actually processed (state
+    writes masked during bubbles)."""
+    y = jnp.tanh(x @ params["w1"]) @ params["w2"] + x
+    count = state["count"] + jnp.where(active, x.shape[0], 0)
+    return y, {"count": count}
+
+
+def make_stages(key, S, d, hidden):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (S, d, hidden), jnp.float32) * 0.3,
+        "w2": jax.random.normal(k2, (S, hidden, d), jnp.float32) * 0.3,
+    }
+
+
+def sequential(params, xs):
+    S = params["w1"].shape[0]
+    out = []
+    for m in range(xs.shape[0]):
+        x = xs[m]
+        for s in range(S):
+            sl = {"w1": params["w1"][s], "w2": params["w2"][s]}
+            x, _ = mlp_stage(sl, {"count": jnp.int32(0)}, x, True)
+        out.append(x)
+    return jnp.stack(out)
+
+
+@pytest.mark.parametrize("M", [4, 7, 2])  # M == S, M > S, M < S
+def test_pipeline_matches_sequential(M):
+    S, d, hidden, mb = 4, 16, 32, 3
+    mesh = pp_mesh(S)
+    params = make_stages(jax.random.PRNGKey(0), S, d, hidden)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d), jnp.float32)
+    state = {"count": jnp.zeros((S,), jnp.int32)}
+
+    ys, new_state = pipeline_apply(mlp_stage, params, state, xs, mesh)
+    ref = sequential(params, xs)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # every stage processed exactly M microbatches of mb tokens — bubbles
+    # must not have leaked into the state
+    np.testing.assert_array_equal(np.asarray(new_state["count"]),
+                                  np.full(S, M * mb))
+
+
+def test_pipeline_under_jit():
+    S, d, hidden = 4, 8, 16
+    mesh = pp_mesh(S)
+    params = make_stages(jax.random.PRNGKey(2), S, d, hidden)
+    xs = jax.random.normal(jax.random.PRNGKey(3), (4, 2, d), jnp.float32)
+    state = {"count": jnp.zeros((S,), jnp.int32)}
+    fn = jax.jit(lambda p, s, x: pipeline_apply(mlp_stage, p, s, x, mesh))
+    ys, _ = fn(params, state, xs)
+    np.testing.assert_allclose(np.asarray(ys),
+                               np.asarray(sequential(params, xs)),
+                               rtol=2e-5, atol=2e-5)
