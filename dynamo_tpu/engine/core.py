@@ -107,12 +107,17 @@ class _Parked:
 
 class JaxEngine:
     def __init__(self, config: EngineConfig, params=None, mesh=None,
-                 kv_event_sink=None, kv_pull_fn=None):
+                 kv_event_sink=None, kv_pull_fn=None, step_sink=None):
         """kv_event_sink: optional callable(stored, removed) -> awaitable,
         invoked with PLH batches as the cache mutates.
         kv_pull_fn: optional async callable(disaggregated_params) ->
         (k, v, prompt_len) pulling a remote prefill's KV blocks (set by the
-        worker; the engine stays transport-agnostic)."""
+        worker; the engine stays transport-agnostic).
+        step_sink: optional callable(kind, {name: np.ndarray}) invoked with
+        every compute step's host inputs BEFORE the jit call — the
+        multi-host leader broadcasts these so follower processes replay an
+        identical jit sequence (parallel/multihost.py).  v1 scope: prefill
+        and decode steps (followers require kvbm/disagg off)."""
         self.config = config
         self.model_cfg = config.resolve_model()
         if self.model_cfg.attn_impl == "auto" and config.tp > 1:
@@ -145,6 +150,7 @@ class JaxEngine:
             except (TypeError, ValueError):
                 pass
         self.kv_pull_fn = kv_pull_fn
+        self.step_sink = step_sink
         self.eos_ids = frozenset(config.resolve_eos_ids())
         self.allocator = BlockAllocator(
             config.num_blocks, config.enable_prefix_caching
@@ -305,6 +311,36 @@ class JaxEngine:
             temp[None], top_k[None], top_p[None],
         )[0]
         return tok, kv
+
+    def apply_step(self, kind: str, a: Dict[str, np.ndarray]) -> None:
+        """Multi-host follower: execute one broadcast step descriptor —
+        the exact jit call the leader ran, on this process's local shards
+        (parallel/multihost.py).  Sampled tokens are discarded; only the
+        KV/weights state evolution matters on followers."""
+        if kind == "prefill":
+            _, self.kv = self._jit_prefill(
+                self.params, self.kv,
+                jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
+                jnp.asarray(a["block_table"]),
+                jnp.int32(a["pos"]), jnp.int32(a["chunk"]),
+                jnp.int32(a["seed"]), jnp.float32(a["temp"]),
+                jnp.int32(a["top_k"]), jnp.float32(a["top_p"]),
+            )
+        elif kind in ("decode", "decode_multi"):
+            args = (
+                self.params, self.kv,
+                jnp.asarray(a["tokens"]), jnp.asarray(a["positions"]),
+                jnp.asarray(a["tables"]), jnp.asarray(a["ctx_lens"]),
+                jnp.asarray(a["seeds"]), jnp.asarray(a["steps"]),
+                jnp.asarray(a["temps"]), jnp.asarray(a["top_ks"]),
+                jnp.asarray(a["top_ps"]), jnp.asarray(a["valid"]),
+            )
+            if kind == "decode_multi":
+                _, self.kv = self._jit_decode_multi(*args)
+            else:
+                _, self.kv = self._jit_decode(*args)
+        else:
+            raise ValueError(f"unknown step kind {kind!r}")
 
     # -- request entry ----------------------------------------------------
     def start(self) -> None:
@@ -769,6 +805,17 @@ class JaxEngine:
         toks[:chunk] = slot.seq.tokens[pos: pos + chunk]
         positions = pos + np.arange(bucket, dtype=np.int32)
         s = slot.request.sampling
+        if self.step_sink is not None:
+            # copy: the sink crosses to the loop thread while the scheduler
+            # keeps mutating the slot's live table (grow/release)
+            self.step_sink("prefill", {
+                "toks": toks, "positions": positions,
+                "block_table": slot.block_table.copy(),
+                "pos": np.int32(pos), "chunk": np.int32(chunk),
+                "seed": np.int32(slot.sampling_seed),
+                "temp": np.float32(s.temperature),
+                "top_k": np.int32(s.top_k), "top_p": np.float32(s.top_p),
+            })
         tok, self.kv = self._jit_prefill(
             self.params, self.kv,
             jnp.asarray(toks), jnp.asarray(positions),
@@ -954,6 +1001,13 @@ class JaxEngine:
             top_ps[i] = s.request.sampling.top_p
             valid[i] = True
 
+        if self.step_sink is not None:
+            self.step_sink("decode_multi" if k > 1 else "decode", {
+                "tokens": tokens, "positions": positions, "tables": tables,
+                "ctx_lens": ctx_lens, "seeds": seeds, "steps": steps,
+                "temps": temps, "top_ks": top_ks, "top_ps": top_ps,
+                "valid": valid,
+            })
         args = (
             self.params, self.kv,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Optional
 
 import numpy as np
@@ -33,12 +34,24 @@ class JaxEngineWorker:
                  namespace: str = "dynamo", component: str = "backend",
                  migration_limit: int = 3,
                  tokenizer_cfg: Optional[dict] = None,
-                 params=None):
+                 params=None, mh=None, slice_id: int = 0):
+        """mh: MultihostContext for N-host SPMD slices (default: detect).
+        Only the slice leader (rank 0) registers the model and serves
+        endpoints — ONE routing identity per slice; followers replay the
+        leader's broadcast step stream (parallel/multihost.py).  slice_id
+        disambiguates multiple slices of one component (xPyD)."""
+        from ..parallel.multihost import MultihostContext
+
         self.runtime = runtime
         self.config = config
         self.namespace = namespace
         self.component = component
         self.migration_limit = migration_limit
+        self.mh = mh or MultihostContext.detect()
+        self.slice_id = slice_id
+        self._broadcaster = None
+        self._follower = None
+        self._follower_task = None
         self._chat_template: Optional[str] = None
         if tokenizer_cfg is None:
             if config.model_path:
@@ -95,10 +108,67 @@ class JaxEngineWorker:
 
     async def start(self) -> "JaxEngineWorker":
         rt = self.runtime
+        if not self.mh.is_leader:
+            return await self._start_follower()
         instance_id = new_instance_id()
         self.publisher = KvEventPublisher(
             rt, self.namespace, self.component, worker_id=instance_id
         )
+        step_sink = None
+        if self.mh.world > 1:
+            from ..parallel.multihost import StepBroadcaster, ready_subject
+
+            # v1 follower replay covers prefill/decode only — paths that
+            # mutate KV outside the step stream (KVBM onboarding, disagg
+            # inject/gather) would silently diverge the slice
+            if self.config.host_cache_blocks > 0:
+                raise ValueError("multi-host serving (world > 1) does not "
+                                 "support KVBM tiers yet")
+            if self.config.role != "both":
+                raise ValueError("multi-host serving (world > 1) does not "
+                                 "support disaggregated roles yet")
+            self._broadcaster = await StepBroadcaster(
+                rt, self.namespace, self.component, self.slice_id,
+                on_fatal=rt.root_token.kill,
+            ).start()
+            loop = asyncio.get_running_loop()
+            bc = self._broadcaster
+
+            def step_sink(kind, arrays):
+                # scheduler thread -> loop thread; FIFO preserves exec order
+                loop.call_soon_threadsafe(bc.publish_step, kind, arrays)
+
+            # startup barrier: serve only after every follower's step
+            # subscription is live (a step published to nobody is a
+            # permanent gap).  Followers re-announce until stopped.
+            ready_ranks: set = {0}
+            barrier = asyncio.Event()
+
+            async def collect_ready():
+                cancel = asyncio.Event()
+                async for _s, msg in rt.event_plane.subscribe(
+                    ready_subject(self.namespace, self.component,
+                                  self.slice_id),
+                    cancel=cancel,
+                ):
+                    ready_ranks.add(int(msg.get("rank", -1)))
+                    if len(ready_ranks) >= self.mh.world:
+                        barrier.set()
+                        cancel.set()
+                        return
+
+            collector = asyncio.create_task(collect_ready())
+            try:
+                await asyncio.wait_for(
+                    barrier.wait(),
+                    float(os.environ.get("DYN_MH_BARRIER_TIMEOUT_S", "60")),
+                )
+            except asyncio.TimeoutError:
+                collector.cancel()
+                raise RuntimeError(
+                    f"multi-host barrier timeout: followers ready "
+                    f"{sorted(ready_ranks)} of world {self.mh.world}"
+                )
 
         def kv_event_sink(stored, removed, tier="g1"):
             # synchronous enqueue on the loop thread: event ids are assigned
@@ -109,9 +179,14 @@ class JaxEngineWorker:
             self.publisher.enqueue_batch(stored=stored, removed=removed,
                                          tier=tier)
 
-        self.engine = JaxEngine(self.config, params=self._params,
-                                kv_event_sink=kv_event_sink,
-                                kv_pull_fn=self._kv_pull)
+        self.engine = JaxEngine(
+            self.config, params=self._params,
+            kv_event_sink=kv_event_sink,
+            # disagg KV injection is outside the v1 step stream: a pulled
+            # prefill would mutate only the leader's KV
+            kv_pull_fn=self._kv_pull if self.mh.world == 1 else None,
+            step_sink=step_sink,
+        )
         self.engine.transfer_identity = {
             "instance_id": instance_id,
             "namespace": self.namespace,
@@ -161,6 +236,57 @@ class JaxEngineWorker:
         self._load_task = asyncio.create_task(self._load_loop())
         logger.info("jax engine worker %d serving %s (tp=%d)",
                     instance_id, self.config.served_name, self.config.tp)
+        return self
+
+    async def _start_follower(self) -> "JaxEngineWorker":
+        """Follower process of an N-host slice: hold the same engine state
+        (local weight/KV shards), replay the leader's step stream, expose
+        NO network identity.  A step gap is fatal by design — the process
+        must restart to rejoin the slice's collective schedule, so replay
+        failure kills this runtime's root token (the process exits)."""
+        from ..parallel.multihost import StepFollower, ready_subject
+
+        self.engine = JaxEngine(self.config, params=self._params)
+        self._follower = StepFollower(
+            self.runtime, self.namespace, self.component, self.slice_id
+        )
+
+        async def replay():
+            async for kind, arrays, _meta in self._follower.steps():
+                self.engine.apply_step(kind, arrays)
+
+        self._follower_task = asyncio.create_task(replay())
+
+        def on_done(task: asyncio.Task) -> None:
+            if task.cancelled():
+                return
+            exc = task.exception()
+            if exc is not None:
+                logger.critical(
+                    "follower rank %d replay died (%s); restarting is the "
+                    "only way to rejoin the slice", self.mh.rank, exc,
+                )
+                self.runtime.root_token.kill()
+
+        self._follower_task.add_done_callback(on_done)
+
+        async def announce():
+            # barrier ack: re-announce until the worker closes, so a
+            # leader that starts later (or restarts) still sees us
+            subject = ready_subject(self.namespace, self.component,
+                                    self.slice_id)
+            try:
+                while True:
+                    await self.runtime.event_plane.publish(
+                        subject, {"rank": self.mh.rank})
+                    await asyncio.sleep(0.2)
+            except asyncio.CancelledError:
+                pass
+
+        self._announce_task = asyncio.create_task(announce())
+        logger.info("follower rank %d/%d replaying %s/%s slice %d",
+                    self.mh.rank, self.mh.world, self.namespace,
+                    self.component, self.slice_id)
         return self
 
     async def _kv_pull(self, params: dict):
@@ -221,6 +347,14 @@ class JaxEngineWorker:
             })
 
     async def close(self) -> None:
+        if self._follower is not None:
+            self._follower.stop()
+        if self._follower_task is not None:
+            self._follower_task.cancel()
+        if getattr(self, "_announce_task", None) is not None:
+            self._announce_task.cancel()
+        if self._broadcaster is not None:
+            await self._broadcaster.close()
         if self._load_task is not None:
             self._load_task.cancel()
         if self.engine is not None:
