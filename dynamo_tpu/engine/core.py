@@ -220,6 +220,7 @@ class JaxEngine:
         # identity advertised in kv_transfer_params (set by the worker)
         self.transfer_identity: Dict[str, Any] = {}
         self._qlock = threading.Lock()  # guards `waiting` across threads
+        self._step_lock = threading.Lock()  # held for each _sched_step run
         self._slots: List[Optional[_Slot]] = [None] * config.max_num_seqs
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
@@ -355,6 +356,13 @@ class JaxEngine:
             self._task.cancel()
             self._task = None
         self._fail_all_streams()
+        if self.kvbm is not None:
+            # quiesce: a cancelled loop task does not stop a _sched_step
+            # already running in its thread, and that step may be mid-write
+            # into the G3 dir whose ownership kvbm.close() releases
+            await asyncio.to_thread(self._step_lock.acquire)
+            self._step_lock.release()
+            self.kvbm.close()
 
     def _fail_all_streams(self) -> None:
         """Terminate every in-flight stream (shutdown or loop crash)."""
@@ -649,13 +657,18 @@ class JaxEngine:
         (allocation only), run at most ONE budget-capped prefill chunk, then
         a decode step for every slot past prefill — so a long prompt never
         stalls active decodes for more than one chunk's compute
-        (the head-of-line blocking the round-1 verdict called out)."""
-        self._process_cancellations()
-        self._maybe_offload()
-        self._admit_waiting()
-        self._prefill_step()
-        if any(s is not None and not s.prefilling for s in self._slots):
-            self._decode_step()
+        (the head-of-line blocking the round-1 verdict called out).
+
+        _step_lock lets close() wait out an in-flight step (cancelling the
+        loop task does not stop an already-running thread) before releasing
+        resources a step may be mid-write on, e.g. the G3 cache dir."""
+        with self._step_lock:
+            self._process_cancellations()
+            self._maybe_offload()
+            self._admit_waiting()
+            self._prefill_step()
+            if any(s is not None and not s.prefilling for s in self._slots):
+                self._decode_step()
 
     # -- KVBM offload/onboard ----------------------------------------------
     def _maybe_offload(self) -> None:
@@ -964,7 +977,11 @@ class JaxEngine:
                 nblocks += 1
             while k > 1 and slot.ctx_len + k - 1 >= nblocks * c.block_size:
                 if nblocks >= c.max_blocks_per_seq:
-                    break  # capacity finish handled by _finish_reason
+                    # table is full: burst positions past it would clamp to
+                    # the last column and overwrite that block's KV — run
+                    # single-step and let _finish_reason handle capacity
+                    k = 1
+                    break
                 grow = self.allocator.append_block(self._seq_id(slot))
                 self._emit_events(grow)
                 if grow.block_id is None:

@@ -138,9 +138,11 @@ class JaxEngineWorker:
                 # scheduler thread -> loop thread; FIFO preserves exec order
                 loop.call_soon_threadsafe(bc.publish_step, kind, arrays)
 
-            # startup barrier: serve only after every follower's step
-            # subscription is live (a step published to nobody is a
-            # permanent gap).  Followers re-announce until stopped.
+            # startup barrier: serve only after every follower has ACKED A
+            # HELLO SENTINEL received on the step subject itself — proof
+            # its subscription is attached to this leader's stream (a step
+            # published to nobody is a permanent gap).  Hellos repeat while
+            # collecting, so followers re-ack for a restarted leader too.
             ready_ranks: set = {0}
             barrier = asyncio.Event()
 
@@ -157,7 +159,21 @@ class JaxEngineWorker:
                         cancel.set()
                         return
 
+            async def hello_loop():
+                # hellos repeat anyway, so a transiently failing publish
+                # (e.g. a FileDiscovery write under zmq) just costs a beat —
+                # but it must not silently kill the loop, or the barrier
+                # times out blaming the followers
+                while not barrier.is_set():
+                    try:
+                        await bc.hello()
+                    except Exception:
+                        logger.warning("barrier hello publish failed",
+                                       exc_info=True)
+                    await asyncio.sleep(0.2)
+
             collector = asyncio.create_task(collect_ready())
+            heller = asyncio.create_task(hello_loop())
             try:
                 await asyncio.wait_for(
                     barrier.wait(),
@@ -169,6 +185,8 @@ class JaxEngineWorker:
                     f"multi-host barrier timeout: followers ready "
                     f"{sorted(ready_ranks)} of world {self.mh.world}"
                 )
+            finally:
+                heller.cancel()
 
         def kv_event_sink(stored, removed, tier="g1"):
             # synchronous enqueue on the loop thread: event ids are assigned
@@ -271,15 +289,21 @@ class JaxEngineWorker:
         self._follower_task.add_done_callback(on_done)
 
         async def announce():
-            # barrier ack: re-announce until the worker closes, so a
-            # leader that starts later (or restarts) still sees us
+            # barrier ack: one ack per hello sentinel.  A hello in hand
+            # proves our step subscription is attached to the leader's
+            # stream, so the leader can never pass the barrier and publish
+            # step 0 into the void.  Hellos stop once the barrier passes
+            # (no steady-state event noise) and resume from a restarted
+            # leader — whose step 0 then crash-restarts us via StepGapError,
+            # which is how a slice rejoins.
             subject = ready_subject(self.namespace, self.component,
                                     self.slice_id)
             try:
                 while True:
+                    await self._follower.hello.wait()
+                    self._follower.hello.clear()
                     await self.runtime.event_plane.publish(
                         subject, {"rank": self.mh.rank})
-                    await asyncio.sleep(0.2)
             except asyncio.CancelledError:
                 pass
 

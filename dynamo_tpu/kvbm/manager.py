@@ -61,6 +61,12 @@ class TieredKvManager:
         self._dropped_cap = max(64, host_blocks)
         self.offload_skip = _OffloadSkip(self)
 
+    def close(self) -> None:
+        """Release tier resources (G3 directory ownership in particular, so
+        an in-process successor engine can take over the cache dir)."""
+        if self.g3 is not None:
+            self.g3.close()
+
     def _mark_dropped(self, h: int) -> None:
         self._dropped[h] = None
         self._dropped.move_to_end(h)

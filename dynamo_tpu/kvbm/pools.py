@@ -87,6 +87,21 @@ class DiskBlockPool:
         self.capacity = capacity_blocks
         os.makedirs(directory, exist_ok=True)
         self._order: "OrderedDict[int, None]" = OrderedDict()
+        # Exclusive ownership: two engines misconfigured with the same
+        # disk_cache_dir would silently destroy each other's live blocks
+        # (the wipe below, plus LRU evictions).  Hold an flock for the
+        # pool's lifetime and fail loudly instead.
+        import fcntl
+
+        self._lock_file = open(os.path.join(directory, ".lock"), "w")
+        try:
+            fcntl.flock(self._lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self._lock_file.close()
+            raise RuntimeError(
+                f"disk cache dir {directory!r} is owned by another engine "
+                "(flock held); give each engine its own disk_cache_dir"
+            )
         # a fresh pool owns its block files: stale ones from a previous run
         # are untracked (router never saw stored events for them) so they
         # would only leak disk — wipe them.  Only the pool's own strict
@@ -167,3 +182,9 @@ class DiskBlockPool:
             self._unlink(h)
         self._order.clear()
         return hashes
+
+    def close(self) -> None:
+        """Release directory ownership (the flock dies with the fd)."""
+        if self._lock_file is not None:
+            self._lock_file.close()
+            self._lock_file = None

@@ -129,6 +129,17 @@ class StepBroadcaster:
         if self._task is not None:
             self._task.cancel()
 
+    async def hello(self) -> None:
+        """Barrier probe: a sentinel (seq -1) on the step subject.  A
+        follower acks the barrier only after receiving one — proof positive
+        its subscription is attached to THIS broadcaster's stream, with no
+        assumptions about pub/sub join timing.  Safe to publish directly
+        (not via the outbox): hellos happen strictly before the barrier
+        passes and steps strictly after, so they never interleave."""
+        await self.runtime.event_plane.publish(
+            self.subject, {"seq": -1, "kind": "__hello__", "meta": {},
+                           "arrays": {}})
+
     def publish_step(self, kind: str,
                      arrays: Optional[Dict[str, np.ndarray]] = None,
                      meta: Optional[dict] = None) -> int:
@@ -178,6 +189,11 @@ class StepFollower:
         self.subject = step_subject(namespace, component, instance_id)
         self._cancel = asyncio.Event()
         self._next = 0
+        #: pulsed on every hello sentinel received from the leader.  A
+        #: hello in hand proves this follower's subscription is attached to
+        #: the leader's stream, so acking the barrier after one can never
+        #: leave step 0 published into the void (permanent StepGapError).
+        self.hello = asyncio.Event()
 
     async def steps(self) -> AsyncIterator[Tuple[str, Dict[str, np.ndarray],
                                                  dict]]:
@@ -185,6 +201,9 @@ class StepFollower:
             self.subject, cancel=self._cancel
         ):
             seq = msg.get("seq")
+            if seq == -1:  # barrier probe, not a step
+                self.hello.set()
+                continue
             if seq != self._next:
                 raise StepGapError(
                     f"expected step {self._next}, got {seq}: this follower "

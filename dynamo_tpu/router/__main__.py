@@ -8,6 +8,9 @@ Endpoints (component defaults to "router"):
     find_best_worker     PreprocessedRequest dict ->
                          {instance_id, router_instance_id, request_blocks,
                           overlap_blocks}
+                         or, when no worker can be selected (none live, or
+                         all in the request's avoid set):
+                         {error: "no_workers_available", router_instance_id}
     mark_prefill_completed  {request_id} -> {ok}
     free                 {request_id} -> {ok}
 
@@ -63,6 +66,12 @@ async def main() -> None:
     async def find_best_worker(payload, ctx):
         request = PreprocessedRequest.from_dict(payload)
         worker = await router.pick(request)
+        if worker is None:
+            # distinguishable from a placement: no live instances (or all
+            # were in the request's avoid set)
+            yield {"error": "no_workers_available",
+                   "router_instance_id": instance_id}
+            return
         yield {
             "instance_id": worker,
             "router_instance_id": instance_id,

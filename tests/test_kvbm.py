@@ -95,6 +95,19 @@ def test_disk_pool_round_trips_bfloat16(tmp_path):
     jnp.asarray(got_k)  # must be a valid JAX input
 
 
+def test_disk_pool_rejects_shared_directory(tmp_path):
+    """Two engines pointed at the same disk_cache_dir would wipe and evict
+    each other's live G3 blocks — the second pool must refuse to start."""
+    import pytest
+
+    pool = DiskBlockPool(str(tmp_path), capacity_blocks=2)
+    with pytest.raises(RuntimeError, match="owned by another engine"):
+        DiskBlockPool(str(tmp_path), capacity_blocks=2)
+    pool.close()  # ownership released: a successor may now take over
+    pool2 = DiskBlockPool(str(tmp_path), capacity_blocks=2)
+    pool2.close()
+
+
 def test_disk_pool_wipes_stale_files_but_not_foreign_ones(tmp_path):
     stale = tmp_path / ("0" * 31 + "a.npz")  # pool's own 32-hex name form
     stale.write_bytes(b"junk")
