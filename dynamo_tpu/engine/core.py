@@ -120,15 +120,6 @@ class JaxEngine:
         and decode steps (followers require kvbm/disagg off)."""
         self.config = config
         self.model_cfg = config.resolve_model()
-        if self.model_cfg.attn_impl == "auto" and config.tp > 1:
-            # the Pallas kernel is an unpartitionable custom call: under
-            # GSPMD with a kv_heads-sharded cache XLA would all-gather the
-            # whole cache per layer per step.  Until the kernel is wrapped
-            # in shard_map over tp, multi-chip decode takes the jnp path,
-            # which GSPMD partitions cleanly.
-            from dataclasses import replace as _replace
-
-            self.model_cfg = _replace(self.model_cfg, attn_impl="jnp")
         self.mesh = mesh if mesh is not None else make_mesh(
             MeshConfig(dp=config.dp, tp=config.tp)
         )
@@ -198,7 +189,8 @@ class JaxEngine:
             self.kv = self._init_kv_cache()
 
         self._jit_decode = jax.jit(
-            partial(self._decode_impl, self.model_cfg), donate_argnums=(1,)
+            partial(self._decode_impl, self.model_cfg, self.mesh),
+            donate_argnums=(1,),
         )
         self._jit_prefill = jax.jit(
             partial(self._prefill_impl, self.model_cfg), donate_argnums=(1,)
@@ -208,7 +200,7 @@ class JaxEngine:
         self._jit_decode_multi = None
         if config.decode_fused_steps > 1:
             self._jit_decode_multi = jax.jit(
-                partial(self._decode_multi_impl, self.model_cfg,
+                partial(self._decode_multi_impl, self.model_cfg, self.mesh,
                         config.decode_fused_steps),
                 donate_argnums=(1,),
             )
@@ -246,17 +238,18 @@ class JaxEngine:
 
     # -- jitted programs --------------------------------------------------
     @staticmethod
-    def _decode_impl(model_cfg, params, kv, tokens, positions, block_tables,
-                     ctx_lens, seeds, steps, temps, top_ks, top_ps, valid):
+    def _decode_impl(model_cfg, mesh, params, kv, tokens, positions,
+                     block_tables, ctx_lens, seeds, steps, temps, top_ks,
+                     top_ps, valid):
         logits, kv = llama.decode(
             params, model_cfg, kv, tokens, positions, block_tables,
-            ctx_lens, valid=valid,
+            ctx_lens, valid=valid, mesh=mesh,
         )
         next_tokens = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
         return next_tokens, kv
 
     @staticmethod
-    def _decode_multi_impl(model_cfg, num_steps, params, kv, tokens,
+    def _decode_multi_impl(model_cfg, mesh, num_steps, params, kv, tokens,
                            positions, block_tables, ctx_lens, seeds, steps,
                            temps, top_ks, top_ps, valid):
         """num_steps fused decode steps (models/llama.py decode_multi);
@@ -269,7 +262,7 @@ class JaxEngine:
 
         return llama.decode_multi(
             params, model_cfg, kv, tokens, positions, block_tables,
-            ctx_lens, num_steps, sample_fn, valid=valid,
+            ctx_lens, num_steps, sample_fn, valid=valid, mesh=mesh,
         )
 
     @staticmethod
@@ -661,8 +654,13 @@ class JaxEngine:
 
         _step_lock lets close() wait out an in-flight step (cancelling the
         loop task does not stop an already-running thread) before releasing
-        resources a step may be mid-write on, e.g. the G3 cache dir."""
+        resources a step may be mid-write on, e.g. the G3 cache dir.  The
+        _closed check under the lock closes the remaining window: a step
+        whose thread started but had not yet acquired the lock when close()
+        swept through must not touch the released resources."""
         with self._step_lock:
+            if self._closed:
+                return
             self._process_cancellations()
             self._maybe_offload()
             self._admit_waiting()

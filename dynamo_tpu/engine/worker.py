@@ -302,8 +302,13 @@ class JaxEngineWorker:
                 while True:
                     await self._follower.hello.wait()
                     self._follower.hello.clear()
-                    await self.runtime.event_plane.publish(
-                        subject, {"rank": self.mh.rank})
+                    try:
+                        await self.runtime.event_plane.publish(
+                            subject, {"rank": self.mh.rank})
+                    except Exception:
+                        # hellos repeat; a dropped ack self-heals next beat
+                        logger.warning("barrier ack publish failed",
+                                       exc_info=True)
             except asyncio.CancelledError:
                 pass
 

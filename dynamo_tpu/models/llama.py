@@ -379,6 +379,7 @@ def decode(
     block_tables: jax.Array,   # [B, max_blocks] int32
     ctx_lens: jax.Array,       # [B] int32, tokens in cache BEFORE this step
     valid: Optional[jax.Array] = None,  # [B] bool: active (non-padding) slots
+    mesh=None,                 # required for the Pallas path under tp>1
 ):
     """One decode step for B slots.  Writes each token's K/V, attends over
     the paged context, returns (logits [B, vocab], updated kv_cache)."""
@@ -393,7 +394,7 @@ def decode(
         )
         attn = paged_attention_decode(
             q[:, 0], k_cache, v_cache, li, block_tables, ctx_lens + 1,
-            impl=cfg.attn_impl,
+            impl=cfg.attn_impl, mesh=mesh,
         )  # [B, nh, hd]
         x = x + attn.reshape(x.shape[0], cfg.q_dim) @ layer["wo"]
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
@@ -413,6 +414,7 @@ def decode_multi(
     num_steps: int,
     sample_fn=None,            # (logits [B,V], step_idx) -> tokens [B]
     valid: Optional[jax.Array] = None,  # [B] bool: active slots
+    mesh=None,                 # required for the Pallas path under tp>1
 ):
     """`num_steps` fused decode steps in ONE compiled program (lax.scan).
 
@@ -431,7 +433,7 @@ def decode_multi(
     def body(carry, step_idx):
         tokens, kv, pos, cls = carry
         logits, kv = decode(params, cfg, kv, tokens, pos, block_tables, cls,
-                            valid=valid)
+                            valid=valid, mesh=mesh)
         nt = sample_fn(logits, step_idx).astype(jnp.int32)
         return (nt, kv, pos + 1, cls + 1), nt
 

@@ -197,6 +197,41 @@ def paged_attention_decode_jnp(
     return out.astype(q.dtype)
 
 
+def _decode_pallas_tp(q, k_cache, v_cache, layer, block_tables, kv_lens,
+                      *, mesh, interpret):
+    """Pallas decode under tensor parallelism: shard_map over the tp axis.
+
+    The kernel is a custom call GSPMD cannot partition (left alone, XLA
+    all-gathers the whole kv_heads-sharded cache per layer per step — the
+    exact fallback this replaces).  Under shard_map each tp shard runs the
+    kernel on its LOCAL kv-head slice; GQA head grouping is kv-major and
+    contiguous, so a kv head's entire query group lives on the same shard
+    and the op needs zero cross-shard communication — the row-parallel wo
+    matmul downstream performs the usual psum.
+
+    Batch/tables/lens are replicated (axes beyond tp unmentioned =
+    replicated), matching the engine's host-array inputs."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.compat import shard_map
+    from .pallas_paged_attention import paged_attention_decode_pallas
+
+    def local(q, kc, vc, tables, lens):
+        return paged_attention_decode_pallas(
+            q, kc, vc, layer, tables, lens, interpret=interpret
+        )
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, "tp", None), P(None, "tp", None, None, None),
+                  P(None, "tp", None, None, None), P(None, None), P(None)),
+        out_specs=P(None, "tp", None),
+        # pallas_call's out_shape carries no varying-mesh-axes annotation,
+        # so the vma checker cannot see through it
+        check_vma=False,
+    )(q, k_cache, v_cache, block_tables, kv_lens)
+
+
 def paged_attention_decode(
     q: jax.Array,
     k_cache: jax.Array,
@@ -205,13 +240,21 @@ def paged_attention_decode(
     block_tables: jax.Array,
     kv_lens: jax.Array,
     impl: str = "auto",
+    mesh=None,
 ) -> jax.Array:
     """Single-token batched paged attention (the decode hot loop).
 
     impl: "auto" (Pallas kernel on TPU, jnp elsewhere), "pallas",
     "pallas_interpret" (kernel under the interpreter — CPU testing),
     or "jnp".
+
+    mesh: required for the Pallas path when the kv cache is tensor-parallel
+    (kv_heads sharded over a "tp" axis) — the kernel then runs under
+    shard_map per shard.  Without a mesh, "auto" under tp>1 would hit
+    GSPMD's unpartitionable-custom-call all-gather, so callers serving
+    multi-chip must pass their mesh (the engine does).
     """
+    tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
     if impl == "auto":
         # the compiled kernel needs lane-aligned blocks (bs % 128); smaller
         # block sizes (tests, CPU configs) take the jnp path
@@ -219,11 +262,17 @@ def paged_attention_decode(
         impl = ("pallas" if jax.default_backend() == "tpu"
                 and bs % 128 == 0 else "jnp")
     if impl in ("pallas", "pallas_interpret"):
+        interpret = impl == "pallas_interpret"
+        if tp > 1:
+            return _decode_pallas_tp(
+                q, k_cache, v_cache, layer, block_tables, kv_lens,
+                mesh=mesh, interpret=interpret,
+            )
         from .pallas_paged_attention import paged_attention_decode_pallas
 
         return paged_attention_decode_pallas(
             q, k_cache, v_cache, layer, block_tables, kv_lens,
-            interpret=(impl == "pallas_interpret"),
+            interpret=interpret,
         )
     if impl != "jnp":
         raise ValueError(

