@@ -41,6 +41,12 @@ class EngineConfig:
     # max_batch_tokens minus one token per decoding slot, so decode ITL is
     # bounded by a single chunk's compute (vLLM chunked-prefill semantics)
     max_batch_tokens: int = 2048
+    # concurrent-arrival prefill: up to this many prefilling sequences run
+    # their chunks in ONE batched program per scheduler step (the token
+    # budget is split across them).  Short prompts that would each waste
+    # most of max_batch_tokens fill it together, so TTFT under queue depth
+    # does not serialize.  1 disables batching (always the B=1 program).
+    max_prefill_seqs: int = 4
 
     # KVBM tiers (kvbm/): 0 disables the G2 host cache.  When enabled, the
     # scheduler offloads the coldest evictable HBM blocks to host DRAM once

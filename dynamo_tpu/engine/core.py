@@ -50,18 +50,23 @@ def _set_result_safe(fut: asyncio.Future, value) -> None:
         fut.set_result(value)
 
 
+def _pow2_len(n: int) -> int:
+    """Next power of two >= n (shape-bucketing for jit)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 def _pow2_ids(block_ids) -> np.ndarray:
-    """Block ids zero-padded to the next power of two: bounds the number of
-    distinct shapes reaching jit (one recompile per bucket), and padded ids
-    target the reserved garbage block 0, so gathers read junk the host
-    slices off and scatters write harmlessly."""
+    """Block ids zero-padded to _pow2_len: bounds the number of distinct
+    shapes reaching jit (one recompile per bucket), and padded ids target
+    the reserved garbage block 0, so gathers read junk the host slices off
+    and scatters write harmlessly."""
     n = len(block_ids)
-    bucket = 1
-    while bucket < n:
-        bucket *= 2
-    ids = np.zeros(bucket, np.int32)
-    ids[:n] = block_ids
-    return ids
+    out = np.zeros(_pow2_len(n), np.int32)
+    out[:n] = block_ids
+    return out
 
 
 @dataclass
@@ -195,6 +200,10 @@ class JaxEngine:
         self._jit_prefill = jax.jit(
             partial(self._prefill_impl, self.model_cfg), donate_argnums=(1,)
         )
+        self._jit_prefill_batched = jax.jit(
+            partial(self._prefill_batched_impl, self.model_cfg),
+            donate_argnums=(1,),
+        )
         self._jit_inject = jax.jit(self._inject_impl, donate_argnums=(0,))
         self._jit_gather = jax.jit(self._gather_impl)
         self._jit_decode_multi = None
@@ -306,12 +315,39 @@ class JaxEngine:
         )[0]
         return tok, kv
 
+    @staticmethod
+    def _prefill_batched_impl(model_cfg, params, kv, toks, positions,
+                              tables, ctx_lens, true_lens, seeds, temps,
+                              top_ks, top_ps):
+        """Multi-sequence chunked prefill (models/llama.py prefill_batched):
+        concurrent arrivals share one program instead of serializing B=1
+        chunks.  First tokens are sampled per row; rows whose prompt is not
+        finished this chunk have their sample discarded by the host."""
+        logits, kv = llama.prefill_batched(
+            params, model_cfg, kv, toks, positions, tables,
+            ctx_lens, true_lens,
+        )
+        tok = sample_tokens(
+            logits, seeds, jnp.zeros(seeds.shape, jnp.int32), temps,
+            top_ks, top_ps,
+        )
+        return tok, kv
+
     def apply_step(self, kind: str, a: Dict[str, np.ndarray]) -> None:
         """Multi-host follower: execute one broadcast step descriptor —
         the exact jit call the leader ran, on this process's local shards
         (parallel/multihost.py).  Sampled tokens are discarded; only the
         KV/weights state evolution matters on followers."""
-        if kind == "prefill":
+        if kind == "prefill_batch":
+            _, self.kv = self._jit_prefill_batched(
+                self.params, self.kv,
+                jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
+                jnp.asarray(a["tables"]), jnp.asarray(a["ctx_lens"]),
+                jnp.asarray(a["true_lens"]), jnp.asarray(a["seeds"]),
+                jnp.asarray(a["temps"]), jnp.asarray(a["top_ks"]),
+                jnp.asarray(a["top_ps"]),
+            )
+        elif kind == "prefill":
             _, self.kv = self._jit_prefill(
                 self.params, self.kv,
                 jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
@@ -794,21 +830,84 @@ class JaxEngine:
                 continue
 
     def _prefill_step(self) -> None:
-        """Run ONE prefill chunk for the earliest-enqueued prefilling slot,
-        capped so this step's total token count stays near
-        max_batch_tokens (chunk + one decode token per active slot)."""
-        slot = min(
+        """Run prefill chunks for up to max_prefill_seqs prefilling slots
+        (earliest-enqueued first) in ONE program, the step's total token
+        count capped near max_batch_tokens (chunks + one decode token per
+        active slot).  A single prefilling slot takes the B=1 program;
+        concurrent arrivals share a batched program so short prompts fill
+        the budget together instead of serializing (TTFT under queue
+        depth)."""
+        pslots = sorted(
             (s for s in self._slots if s is not None and s.prefilling),
             key=lambda s: s.enqueued_t,
-            default=None,
-        )
-        if slot is None:
+        )[: self.config.max_prefill_seqs]
+        if not pslots:
             return
         c = self.config
+        self.metrics["prefill_steps"] = \
+            self.metrics.get("prefill_steps", 0) + 1
         decoding = sum(
             1 for s in self._slots if s is not None and not s.prefilling
         )
         budget = max(c.max_batch_tokens - decoding, c.prefill_buckets[0])
+        if len(pslots) == 1:
+            self._prefill_one(pslots[0], budget)
+            return
+
+        # Equal budget shares, NO donation of leftovers: every row pads to
+        # the largest chunk's bucket, so letting one row grow past the
+        # share would multiply the whole batch's padded compute (n×bucket)
+        # far beyond the budget that bounds decode ITL.  With shares,
+        # padded compute ≤ n · bucket(share) ≤ ~2·budget.
+        n = len(pslots)
+        share = max(budget // n, c.prefill_buckets[0])
+        chunks = [min(c.prefill_buckets[-1], share,
+                      s.prompt_len - s.prefill_pos) for s in pslots]
+
+        bucket = self._bucket_for(max(chunks))
+        Bp = _pow2_len(n)
+        toks = np.zeros((Bp, bucket), np.int32)
+        positions = np.zeros((Bp, bucket), np.int32)
+        tables = np.zeros((Bp, c.max_blocks_per_seq), np.int32)
+        ctx_lens = np.zeros(Bp, np.int32)
+        true_lens = np.zeros(Bp, np.int32)
+        seeds = np.zeros(Bp, np.int32)
+        temps = np.zeros(Bp, np.float32)
+        top_ks = np.zeros(Bp, np.int32)
+        top_ps = np.ones(Bp, np.float32)
+        for i, (slot, chunk) in enumerate(zip(pslots, chunks)):
+            pos = slot.prefill_pos
+            toks[i, :chunk] = slot.seq.tokens[pos: pos + chunk]
+            positions[i] = pos + np.arange(bucket, dtype=np.int32)
+            tables[i] = slot.block_table
+            ctx_lens[i] = pos
+            true_lens[i] = chunk
+            s = slot.request.sampling
+            seeds[i] = slot.sampling_seed
+            temps[i] = s.temperature
+            top_ks[i] = s.top_k
+            top_ps[i] = s.top_p
+        if self.step_sink is not None:
+            self.step_sink("prefill_batch", {
+                "toks": toks, "positions": positions,
+                "tables": tables, "ctx_lens": ctx_lens,
+                "true_lens": true_lens, "seeds": seeds, "temps": temps,
+                "top_ks": top_ks, "top_ps": top_ps,
+            })
+        tok, self.kv = self._jit_prefill_batched(
+            self.params, self.kv,
+            jnp.asarray(toks), jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(ctx_lens), jnp.asarray(true_lens),
+            jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+        )
+        firsts = np.asarray(tok)
+        for i, (slot, chunk) in enumerate(zip(pslots, chunks)):
+            self._finish_prefill_chunk(slot, chunk, int(firsts[i]))
+
+    def _prefill_one(self, slot: "_Slot", budget: int) -> None:
+        """The B=1 chunk program (single prefilling slot)."""
+        c = self.config
         pos = slot.prefill_pos
         chunk = min(c.prefill_buckets[-1], budget, slot.prompt_len - pos)
         bucket = self._bucket_for(chunk)
@@ -836,15 +935,20 @@ class JaxEngine:
             jnp.float32(s.temperature), jnp.int32(s.top_k),
             jnp.float32(s.top_p),
         )
+        self._finish_prefill_chunk(slot, chunk, int(tok))
+
+    def _finish_prefill_chunk(self, slot: "_Slot", chunk: int,
+                              first: int) -> None:
+        """Advance a slot past a completed chunk; emit the first token (or
+        park the KV for disagg pull) when the prompt is done."""
         self.metrics["prefill_tokens"] += chunk
-        slot.prefill_pos = pos + chunk
+        slot.prefill_pos += chunk
         slot.ctx_len = slot.prefill_pos
         # register blocks this chunk completed (registration is deferred to
         # materialization, so commit must track prefill progress chunkwise)
         self._commit_full_blocks(slot)
         if slot.prefilling:
             return  # more chunks to go; decode runs in between
-        first = int(tok)
         slot.first_token_t = time.monotonic()
         if slot.disagg_prefill:
             self._park_prefilled(slot, first)

@@ -73,6 +73,39 @@ def write_prompt_kv(
     return k_cache, v_cache
 
 
+def write_prompt_kv_batched(
+    k_cache: jax.Array,       # [L, nkv, nblocks, hd, bs]
+    v_cache: jax.Array,
+    layer: int,
+    k: jax.Array,             # [Bp, T, nkv, hd] chunk keys per sequence
+    v: jax.Array,
+    block_tables: jax.Array,  # [Bp, max_blocks] int32
+    ctx_lens: jax.Array,      # [Bp] tokens already in cache per sequence
+    true_lens: jax.Array,     # [Bp] valid entries of each row of k/v
+) -> Tuple[jax.Array, jax.Array]:
+    """Multi-sequence chunk scatter: Bp sequences' prefill chunks written in
+    one flat scatter (sequences own disjoint blocks, so rows never collide;
+    invalid/padding rows land in the garbage block)."""
+    Bp, T = k.shape[:2]
+    bs = k_cache.shape[4]
+    pos = ctx_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    blocks = jnp.take_along_axis(block_tables, pos // bs, axis=1)  # [Bp, T]
+    offsets = pos % bs
+    valid = jnp.arange(T)[None, :] < true_lens[:, None]
+    blocks = jnp.where(valid, blocks, 0)
+    bf = blocks.reshape(-1)
+    of = offsets.reshape(-1)
+    kf = k.reshape(Bp * T, *k.shape[2:])
+    vf = v.reshape(Bp * T, *v.shape[2:])
+    k_cache = k_cache.at[layer, :, bf, :, of].set(
+        kf.astype(k_cache.dtype), mode="drop"
+    )
+    v_cache = v_cache.at[layer, :, bf, :, of].set(
+        vf.astype(v_cache.dtype), mode="drop"
+    )
+    return k_cache, v_cache
+
+
 def write_token_kv(
     k_cache: jax.Array,
     v_cache: jax.Array,

@@ -176,3 +176,45 @@ async def test_engine_serves_moe_preset():
 def test_moe_preset_registered():
     assert PRESETS["tiny-moe"].n_experts == 4
     assert PRESETS["mixtral-8x7b"].n_experts == 8
+
+
+def test_moe_batched_prefill_per_row_capacity():
+    """prefill_batched must give each sequence its OWN expert-capacity pool
+    (capacity dispatch): co-scheduled requests must not capacity-drop each
+    other's tokens, so batched logits equal per-sequence prefill logits."""
+    from dynamo_tpu.models.llama import init_params, prefill, prefill_batched
+
+    # tight capacity so cross-row pooling WOULD drop tokens if shared
+    cfg = moe_cfg(n_layers=2, moe_dispatch="capacity",
+                  moe_capacity_factor=1.0)
+    params = init_params(cfg, jax.random.PRNGKey(4))
+    bs, nb, mb, T = 4, 64, 8, 16
+    shape = (cfg.n_layers, cfg.n_kv_heads, nb, cfg.head_dim, bs)
+    kv_a = (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+    kv_b = (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+    rng = np.random.default_rng(8)
+    prompts = [rng.integers(1, cfg.vocab_size, T).astype(np.int32)
+               for _ in range(2)]
+    tables = np.zeros((2, mb), np.int32)
+    for i in range(2):
+        tables[i, : T // bs] = 1 + i * mb + np.arange(T // bs)
+
+    solo = []
+    for i in range(2):
+        lg, kv_a = prefill(
+            params, cfg, kv_a, jnp.asarray(prompts[i]),
+            jnp.arange(T, dtype=jnp.int32), jnp.asarray(tables[i]),
+            jnp.int32(0), jnp.int32(T),
+        )
+        solo.append(np.asarray(lg))
+
+    blg, kv_b = prefill_batched(
+        params, cfg, kv_b, jnp.asarray(np.stack(prompts)),
+        jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (2, T)),
+        jnp.asarray(tables), jnp.zeros(2, jnp.int32),
+        jnp.full((2,), T, jnp.int32),
+    )
+    for i in range(2):
+        np.testing.assert_allclose(np.asarray(blg[i]), solo[i],
+                                   rtol=2e-5, atol=2e-5)
