@@ -73,7 +73,11 @@ async def main() -> None:
         rt, config, namespace=args.namespace, component=args.component,
         migration_limit=args.migration_limit,
     ).start()
-    print(f"ready instance_id={worker.served.instance_id}", flush=True)
+    if worker.served is not None:
+        print(f"ready instance_id={worker.served.instance_id}", flush=True)
+    else:  # multihost follower: no routing identity, replay only
+        print(f"ready follower rank={worker.mh.rank}/{worker.mh.world}",
+              flush=True)
     try:
         await rt.root_token.wait_killed()
     except (KeyboardInterrupt, asyncio.CancelledError):

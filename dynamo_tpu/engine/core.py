@@ -369,6 +369,18 @@ class JaxEngine:
                 _, self.kv = self._jit_decode_multi(*args)
             else:
                 _, self.kv = self._jit_decode(*args)
+        elif kind == "gather":
+            # read-only, but still a collective program every process of
+            # the slice must execute (KVBM offload, parked-KV extraction);
+            # the result is the leader's to consume
+            self._jit_gather(self.kv, jnp.asarray(a["ids"]))
+        elif kind == "inject":
+            # KVBM onboard or disagg KV pull: payload rides the stream, so
+            # followers need no tiers/transport of their own
+            self.kv = self._jit_inject(
+                self.kv, jnp.asarray(a["kb"]), jnp.asarray(a["vb"]),
+                jnp.asarray(a["ids"]),
+            )
         else:
             raise ValueError(f"unknown step kind {kind!r}")
 
@@ -621,6 +633,10 @@ class JaxEngine:
                 raise KeyError(f"no parked KV for request {request_id!r}")
             n = len(parked.block_ids)
             ids = _pow2_ids(parked.block_ids)
+            if self.step_sink is not None:
+                # reads are collective programs too: every process of the
+                # slice must execute the same gather or it hangs
+                self.step_sink("gather", {"ids": ids})
             kb, vb = self._jit_gather(self.kv, jnp.asarray(ids))
             return (np.asarray(kb[:, :n]), np.asarray(vb[:, :n]),
                     parked.prompt_len)
@@ -719,6 +735,8 @@ class JaxEngine:
         if not cands:
             return
         ids = _pow2_ids([bid for _, bid in cands])
+        if self.step_sink is not None:
+            self.step_sink("gather", {"ids": ids})
         kb, vb = self._jit_gather(self.kv, jnp.asarray(ids))
         kb = np.asarray(kb)
         vb = np.asarray(vb)
@@ -759,6 +777,10 @@ class JaxEngine:
         pad = [(0, 0), (0, bucket - n)] + [(0, 0)] * (ks[0].ndim - 1)
         kb = np.pad(np.stack(ks, axis=1), pad)
         vb = np.pad(np.stack(vs, axis=1), pad)
+        if self.step_sink is not None:
+            # onboard payloads ride the wire so followers need no KVBM
+            # tiers of their own — their self.kv evolves from the stream
+            self.step_sink("inject", {"kb": kb, "vb": vb, "ids": ids_arr})
         self.kv = self._jit_inject(
             self.kv, jnp.asarray(kb), jnp.asarray(vb), jnp.asarray(ids_arr)
         )
@@ -857,9 +879,15 @@ class JaxEngine:
         # Equal budget shares, NO donation of leftovers: every row pads to
         # the largest chunk's bucket, so letting one row grow past the
         # share would multiply the whole batch's padded compute (n×bucket)
-        # far beyond the budget that bounds decode ITL.  With shares,
-        # padded compute ≤ n · bucket(share) ≤ ~2·budget.
-        n = len(pslots)
+        # far beyond the budget that bounds decode ITL.  When the budget is
+        # too tight to give every row the minimum bucket, batch FEWER slots
+        # this step (earliest first) rather than multiplying the floor by
+        # n — total compute stays ≤ n·bucket(share) ≤ ~2·budget either way.
+        n = max(1, min(len(pslots), budget // c.prefill_buckets[0]))
+        pslots = pslots[:n]
+        if n == 1:
+            self._prefill_one(pslots[0], budget)
+            return
         share = max(budget // n, c.prefill_buckets[0])
         chunks = [min(c.prefill_buckets[-1], share,
                       s.prompt_len - s.prefill_pos) for s in pslots]
@@ -975,6 +1003,11 @@ class JaxEngine:
         pad = ((0, 0), (0, bucket - n)) + ((0, 0),) * (kb.ndim - 2)
         kb_p = np.pad(kb, pad)
         vb_p = np.pad(vb, pad)
+        if self.step_sink is not None:
+            # the pulled KV rides the step stream to the slice's followers
+            # (host-staged transfer delivers full block bytes anyway; each
+            # process scatters its own shard under GSPMD)
+            self.step_sink("inject", {"kb": kb_p, "vb": vb_p, "ids": ids})
         self.kv = self._jit_inject(
             self.kv, jnp.asarray(kb_p), jnp.asarray(vb_p), jnp.asarray(ids)
         )
@@ -994,6 +1027,16 @@ class JaxEngine:
             toks[0] = slot.seq.tokens[-1]
             positions = (prompt_len - 1) + np.arange(
                 self.config.prefill_buckets[0], dtype=np.int32)
+            if self.step_sink is not None:
+                self.step_sink("prefill", {
+                    "toks": toks, "positions": positions,
+                    "block_table": slot.block_table.copy(),
+                    "pos": np.int32(prompt_len - 1), "chunk": np.int32(1),
+                    "seed": np.int32(slot.sampling_seed),
+                    "temp": np.float32(s.temperature),
+                    "top_k": np.int32(s.top_k),
+                    "top_p": np.float32(s.top_p),
+                })
             tok, self.kv = self._jit_prefill(
                 self.params, self.kv, jnp.asarray(toks),
                 jnp.asarray(positions), table_dev,

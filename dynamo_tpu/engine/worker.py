@@ -118,15 +118,10 @@ class JaxEngineWorker:
         if self.mh.world > 1:
             from ..parallel.multihost import StepBroadcaster, ready_subject
 
-            # v1 follower replay covers prefill/decode only — paths that
-            # mutate KV outside the step stream (KVBM onboarding, disagg
-            # inject/gather) would silently diverge the slice
-            if self.config.host_cache_blocks > 0:
-                raise ValueError("multi-host serving (world > 1) does not "
-                                 "support KVBM tiers yet")
-            if self.config.role != "both":
-                raise ValueError("multi-host serving (world > 1) does not "
-                                 "support disaggregated roles yet")
+            # all KV-mutating paths ride the step stream (prefill/decode,
+            # KVBM gather/inject, disagg inject) — followers replay the
+            # full jit sequence, so tiers and disagg roles compose with
+            # multi-host (the north-star topology)
             self._broadcaster = await StepBroadcaster(
                 rt, self.namespace, self.component, self.slice_id,
                 on_fatal=rt.root_token.kill,
@@ -200,9 +195,9 @@ class JaxEngineWorker:
         self.engine = JaxEngine(
             self.config, params=self._params,
             kv_event_sink=kv_event_sink,
-            # disagg KV injection is outside the v1 step stream: a pulled
-            # prefill would mutate only the leader's KV
-            kv_pull_fn=self._kv_pull if self.mh.world == 1 else None,
+            # the leader pulls over the request plane; the injected blocks
+            # then ride the step stream to the slice's followers
+            kv_pull_fn=self._kv_pull,
             step_sink=step_sink,
         )
         self.engine.transfer_identity = {
@@ -264,7 +259,15 @@ class JaxEngineWorker:
         failure kills this runtime's root token (the process exits)."""
         from ..parallel.multihost import StepFollower, ready_subject
 
-        self.engine = JaxEngine(self.config, params=self._params)
+        # Followers hold no KVBM tiers: their self.kv evolves purely from
+        # the replayed stream (onboard/pull payloads arrive as inject
+        # steps), and pools would fight over the same disk dir on shared
+        # hosts.  dataclasses.replace keeps the compute config identical.
+        from dataclasses import replace as _dc_replace
+
+        fcfg = _dc_replace(self.config, host_cache_blocks=0,
+                           disk_cache_dir=None, disk_cache_blocks=0)
+        self.engine = JaxEngine(fcfg, params=self._params)
         self._follower = StepFollower(
             self.runtime, self.namespace, self.component, self.slice_id
         )

@@ -132,3 +132,120 @@ async def test_follower_kv_matches_leader_after_serving():
     await leader.close()
     await follower.close()
     await rt.shutdown()
+
+
+from test_engine import collect, greedy_req  # noqa: E402 (shared helpers)
+
+
+async def _wait_kv_equal(leader, follower, rounds=300):
+    for _ in range(rounds):
+        await asyncio.sleep(0.02)
+        if np.array_equal(np.asarray(leader.engine.kv[0]),
+                          np.asarray(follower.engine.kv[0])):
+            break
+    np.testing.assert_array_equal(np.asarray(leader.engine.kv[0]),
+                                  np.asarray(follower.engine.kv[0]))
+    np.testing.assert_array_equal(np.asarray(leader.engine.kv[1]),
+                                  np.asarray(follower.engine.kv[1]))
+
+
+async def test_follower_replays_kvbm_offload_onboard():
+    """KVBM tiers compose with multi-host: gathers (offload) and injects
+    (onboard) ride the step stream, so a follower's KV stays bit-identical
+    through an offload → evict → onboard cycle on the leader."""
+    rt = await fresh_runtime().start()
+    ecfg = dict(model_config=FP32, block_size=4, num_blocks=16,
+                max_blocks_per_seq=8, max_num_seqs=2,
+                prefill_buckets=(8, 16, 32), seed=5,
+                host_cache_blocks=64, offload_watermark_blocks=16)
+
+    follower = await JaxEngineWorker(
+        rt, EngineConfig(**ecfg), mh=MultihostContext(rank=1, world=2),
+    ).start()
+    leader = await JaxEngineWorker(
+        rt, EngineConfig(**ecfg), mh=MultihostContext(rank=0, world=2),
+    ).start()
+    assert follower.engine.kvbm is None  # tiers live on the leader only
+    assert leader.engine.kvbm is not None
+
+    prompt_a = list(range(1, 13))  # 3 full blocks
+    out1 = await collect(leader.engine, greedy_req(prompt_a, 4, "a1"))
+    # churn HBM so A's blocks offload to G2 and get evicted
+    for i in range(6):
+        p = [50 + 7 * i + j for j in range(12)]
+        await collect(leader.engine, greedy_req(p, 2, f"churn{i}"))
+    assert leader.engine.kvbm.stats["offloaded"] > 0
+    out2 = await collect(leader.engine, greedy_req(prompt_a, 4, "a2"))
+    assert out2 == out1
+    assert leader.engine.metrics.get("onboarded_tokens", 0) > 0, \
+        "workload failed to exercise the onboard (inject) path"
+
+    await _wait_kv_equal(leader, follower)
+    await leader.close()
+    await follower.close()
+    await rt.shutdown()
+
+
+async def test_multihost_disagg_north_star():
+    """The north-star composition (round-2 verdict missing #1): a prefill
+    slice and a decode slice, each world=2, KVBM enabled on the decode
+    leader — request flows prefill leader → parked KV → decode leader pull
+    → inject broadcast, and BOTH followers end bit-identical to their
+    leaders with tokens equal to an aggregated reference."""
+    from dynamo_tpu.disagg.prefill_router import (
+        ConditionalDisaggConfig,
+        PrefillOrchestrator,
+    )
+    from dynamo_tpu.engine.core import JaxEngine
+    from dynamo_tpu.protocols import LLMEngineOutput
+
+    rt = await fresh_runtime().start()
+    ecfg = dict(model_config=FP32, block_size=4, num_blocks=64,
+                max_blocks_per_seq=16, max_num_seqs=2,
+                prefill_buckets=(8, 16, 32), seed=7)
+
+    p_follower = await JaxEngineWorker(
+        rt, EngineConfig(role="prefill", **ecfg), component="prefill",
+        mh=MultihostContext(rank=1, world=2),
+    ).start()
+    p_leader = await JaxEngineWorker(
+        rt, EngineConfig(role="prefill", **ecfg), component="prefill",
+        mh=MultihostContext(rank=0, world=2),
+    ).start()
+    d_follower = await JaxEngineWorker(
+        rt, EngineConfig(role="decode", host_cache_blocks=32, **ecfg),
+        component="backend", mh=MultihostContext(rank=1, world=2),
+    ).start()
+    d_leader = await JaxEngineWorker(
+        rt, EngineConfig(role="decode", host_cache_blocks=32, **ecfg),
+        component="backend", mh=MultihostContext(rank=0, world=2),
+    ).start()
+
+    agg = JaxEngine(EngineConfig(**ecfg))  # aggregated reference
+    prompt = list(range(30, 52))
+    expect = await collect(agg, greedy_req(prompt, 6, "agg"))
+
+    pclient = await (rt.namespace("dynamo").component("prefill")
+                     .endpoint("generate").client()).start()
+    dclient = await (rt.namespace("dynamo").component("backend")
+                     .endpoint("generate").client()).start()
+    orch = PrefillOrchestrator(
+        pclient, ConditionalDisaggConfig(always_remote=True))
+    routed = await orch.maybe_prefill(greedy_req(prompt, 6, "ns1"))
+    assert routed.disaggregated_params is not None
+
+    tokens = []
+    async for item in dclient.generate(routed.to_dict()):
+        tokens.extend(LLMEngineOutput.from_dict(item).token_ids)
+    assert tokens == expect, "multihost disagg continuation diverged"
+    assert d_leader.engine.metrics["prefill_tokens"] == 0
+
+    await _wait_kv_equal(p_leader, p_follower)
+    await _wait_kv_equal(d_leader, d_follower)
+
+    await orch.close()
+    await dclient.close()
+    await agg.close()
+    for w in (p_leader, p_follower, d_leader, d_follower):
+        await w.close()
+    await rt.shutdown()
