@@ -24,10 +24,11 @@ import inspect
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from types import SimpleNamespace
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,7 +41,7 @@ from ..protocols import LLMEngineOutput, PreprocessedRequest
 from ..tokens import TokenBlockSequence
 from .block_allocator import BlockAllocator
 from .config import EngineConfig
-from .sampler import sample_tokens
+from .sampler import greedy_tokens, sample_tokens
 
 logger = logging.getLogger(__name__)
 
@@ -98,6 +99,11 @@ class _Slot:
     preloaded_k: Optional[np.ndarray] = None  # [L, nblk, bs, nkv, hd]
     preloaded_v: Optional[np.ndarray] = None
     preloaded_first_token: Optional[int] = None
+    # decode pipelining (decode_pipeline_depth): tokens the device has
+    # already decoded for this slot but the host has not yet read back
+    inflight: int = 0
+    # bumped on preemption so stale in-flight bursts are discarded
+    epoch: int = 0
 
 
 @dataclass
@@ -193,10 +199,16 @@ class JaxEngine:
                 self.params = shard_params(params, self.mesh)
             self.kv = self._init_kv_cache()
 
-        self._jit_decode = jax.jit(
-            partial(self._decode_impl, self.model_cfg, self.mesh),
-            donate_argnums=(1,),
-        )
+        # decode variants: {greedy: jitted} — an all-greedy batch takes the
+        # argmax specialization (sampling machinery measurably costs on
+        # large vocabs even top-k-capped)
+        self._jit_decode = {
+            g: jax.jit(
+                partial(self._decode_impl, self.model_cfg, self.mesh, g),
+                donate_argnums=(1,),
+            )
+            for g in (False, True)
+        }
         self._jit_prefill = jax.jit(
             partial(self._prefill_impl, self.model_cfg), donate_argnums=(1,)
         )
@@ -208,11 +220,14 @@ class JaxEngine:
         self._jit_gather = jax.jit(self._gather_impl)
         self._jit_decode_multi = None
         if config.decode_fused_steps > 1:
-            self._jit_decode_multi = jax.jit(
-                partial(self._decode_multi_impl, self.model_cfg, self.mesh,
-                        config.decode_fused_steps),
-                donate_argnums=(1,),
-            )
+            self._jit_decode_multi = {
+                g: jax.jit(
+                    partial(self._decode_multi_impl, self.model_cfg,
+                            self.mesh, g, config.decode_fused_steps),
+                    donate_argnums=(1,),
+                )
+                for g in (False, True)
+            }
 
         self.waiting: List[_Slot] = []
         self._sched_calls: List[tuple] = []  # (fn, future) run between steps
@@ -223,6 +238,12 @@ class JaxEngine:
         self._qlock = threading.Lock()  # guards `waiting` across threads
         self._step_lock = threading.Lock()  # held for each _sched_step run
         self._slots: List[Optional[_Slot]] = [None] * config.max_num_seqs
+        # decode pipelining (decode_pipeline_depth): dispatched-but-unread
+        # bursts + the device-resident token chain feeding the next burst
+        self._inflight: deque = deque()
+        self._chain_tokens = None          # device [B] last burst's output
+        self._chain_owner: List[Optional[Tuple[str, int]]] = \
+            [None] * config.max_num_seqs   # (seq_id, epoch) per lane
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._loop_ref: Optional[asyncio.AbstractEventLoop] = None
@@ -247,27 +268,41 @@ class JaxEngine:
 
     # -- jitted programs --------------------------------------------------
     @staticmethod
-    def _decode_impl(model_cfg, mesh, params, kv, tokens, positions,
-                     block_tables, ctx_lens, seeds, steps, temps, top_ks,
-                     top_ps, valid):
+    def _decode_impl(model_cfg, mesh, greedy, params, kv, chain, use_chain,
+                     tokens, positions, block_tables, ctx_lens, seeds,
+                     steps, temps, top_ks, top_ps, valid):
+        """chain/use_chain: device-resident token chaining — lanes whose
+        previous burst is still unread take their input token from the
+        prior burst's on-device output instead of a host round-trip.
+        `greedy` is a static specialization: an all-greedy batch skips the
+        sampling machinery (sampler.py greedy_tokens)."""
+        tokens = jnp.where(use_chain, chain, tokens)
         logits, kv = llama.decode(
             params, model_cfg, kv, tokens, positions, block_tables,
             ctx_lens, valid=valid, mesh=mesh,
         )
-        next_tokens = sample_tokens(logits, seeds, steps, temps, top_ks, top_ps)
-        return next_tokens, kv
+        if greedy:
+            next_tokens = greedy_tokens(logits)
+        else:
+            next_tokens = sample_tokens(logits, seeds, steps, temps,
+                                        top_ks, top_ps)
+        return next_tokens[None], kv  # [1, B]: burst-shaped like multi
 
     @staticmethod
-    def _decode_multi_impl(model_cfg, mesh, num_steps, params, kv, tokens,
-                           positions, block_tables, ctx_lens, seeds, steps,
-                           temps, top_ks, top_ps, valid):
+    def _decode_multi_impl(model_cfg, mesh, greedy, num_steps, params, kv,
+                           chain, use_chain, tokens, positions,
+                           block_tables, ctx_lens, seeds, steps, temps,
+                           top_ks, top_ps, valid):
         """num_steps fused decode steps (models/llama.py decode_multi);
         sampling streams stay per-token identical to the single-step path
         (seed folded with the running step counter)."""
-
-        def sample_fn(logits, step_idx):
-            return sample_tokens(logits, seeds, steps + step_idx, temps,
-                                 top_ks, top_ps)
+        tokens = jnp.where(use_chain, chain, tokens)
+        if greedy:
+            sample_fn = None  # decode_multi defaults to argmax
+        else:
+            def sample_fn(logits, step_idx):
+                return sample_tokens(logits, seeds, steps + step_idx,
+                                     temps, top_ks, top_ps)
 
         return llama.decode_multi(
             params, model_cfg, kv, tokens, positions, block_tables,
@@ -357,18 +392,13 @@ class JaxEngine:
                 jnp.int32(a["top_k"]), jnp.float32(a["top_p"]),
             )
         elif kind in ("decode", "decode_multi"):
-            args = (
-                self.params, self.kv,
-                jnp.asarray(a["tokens"]), jnp.asarray(a["positions"]),
-                jnp.asarray(a["tables"]), jnp.asarray(a["ctx_lens"]),
-                jnp.asarray(a["seeds"]), jnp.asarray(a["steps"]),
-                jnp.asarray(a["temps"]), jnp.asarray(a["top_ks"]),
-                jnp.asarray(a["top_ps"]), jnp.asarray(a["valid"]),
+            # _dispatch_decode keeps the follower's device token chain
+            # symmetric with the leader's (use_chain lanes resolve to the
+            # follower's own previous burst, which is value-identical)
+            self._dispatch_decode(
+                self.config.decode_fused_steps if kind == "decode_multi"
+                else 1, a,
             )
-            if kind == "decode_multi":
-                _, self.kv = self._jit_decode_multi(*args)
-            else:
-                _, self.kv = self._jit_decode(*args)
         elif kind == "gather":
             # read-only, but still a collective program every process of
             # the slice must execute (KVBM offload, parked-KV extraction);
@@ -397,6 +427,7 @@ class JaxEngine:
             self._task.cancel()
             self._task = None
         self._fail_all_streams()
+        self._inflight.clear()  # drop unread bursts (streams already dead)
         if self.kvbm is not None:
             # quiesce: a cancelled loop task does not stop a _sched_step
             # already running in its thread, and that step may be mid-write
@@ -668,7 +699,8 @@ class JaxEngine:
                     # scheduler step is in flight while we await this
                     await asyncio.to_thread(self._drain_sched_calls)
                 self._reap_parked()
-                busy = any(s is not None for s in self._slots)
+                busy = (any(s is not None for s in self._slots)
+                        or bool(self._inflight))
                 if not busy and not self.waiting:
                     self._wake.clear()
                     if self._sched_calls:
@@ -719,6 +751,10 @@ class JaxEngine:
             self._prefill_step()
             if any(s is not None and not s.prefilling for s in self._slots):
                 self._decode_step()
+            elif self._inflight:
+                # no dispatchable decode work: flush the pipeline tail so
+                # trailing tokens/finishes are delivered promptly
+                self._drain_inflight()
 
     # -- KVBM offload/onboard ----------------------------------------------
     def _maybe_offload(self) -> None:
@@ -1101,26 +1137,52 @@ class JaxEngine:
     def _decode_step(self) -> None:
         c = self.config
         B = c.max_num_seqs
+        # pipeline: keep at most depth-1 unread bursts after this dispatch;
+        # processing the oldest here overlaps its (already-complete or
+        # nearly-complete) fetch with the device compute of newer bursts
+        depth = max(1, c.decode_pipeline_depth)
+        while len(self._inflight) >= depth:
+            self._process_oldest_burst()
         k = self._fused_k()
         active = [s for s in self._slots
                   if s is not None and not s.prefilling]
         if not active:
             return
-        # Every active slot MUST have a block for position ctx_len (preempt
-        # if even that fails); blocks for the rest of the burst are
-        # speculative — under allocation pressure degrade to k=1 instead of
-        # preempting a sequence for blocks it won't need for k-1 more steps.
+        # Every active slot MUST have a block for its next device position
+        # ctx_len + inflight (preempt if even that fails); blocks for the
+        # rest of the burst are speculative — under allocation pressure
+        # degrade to k=1 instead of preempting a sequence for blocks it
+        # won't need for k-1 more steps.
         for slot in active:
+            # an intra-loop drain (below) can finish LATER slots of this
+            # stale snapshot: growing a freed sequence would KeyError
+            if slot.finished or self._slots[slot.index] is not slot:
+                continue
+            eff = slot.ctx_len + slot.inflight
             nblocks = int(np.count_nonzero(slot.block_table))
-            if slot.ctx_len >= nblocks * c.block_size:
+            if eff >= nblocks * c.block_size:
+                if nblocks >= c.max_blocks_per_seq:
+                    # capacity: the in-flight tokens already reach the end
+                    # of the table — drain so the length-finish fires
+                    # before any further dispatch for this slot
+                    self._drain_inflight()
+                    return
                 grow = self.allocator.append_block(self._seq_id(slot))
                 self._emit_events(grow)
                 if grow.block_id is None:
-                    self._preempt(slot)
-                    continue
+                    # drain first: processing may finish the slot or free
+                    # enough blocks to retry; preemption is the last resort
+                    self._drain_inflight()
+                    if slot.finished or self._slots[slot.index] is not slot:
+                        continue
+                    grow = self.allocator.append_block(self._seq_id(slot))
+                    self._emit_events(grow)
+                    if grow.block_id is None:
+                        self._preempt(slot)
+                        continue
                 slot.block_table[nblocks] = grow.block_id
                 nblocks += 1
-            while k > 1 and slot.ctx_len + k - 1 >= nblocks * c.block_size:
+            while k > 1 and eff + k - 1 >= nblocks * c.block_size:
                 if nblocks >= c.max_blocks_per_seq:
                     # table is full: burst positions past it would clamp to
                     # the last column and overwrite that block's KV — run
@@ -1141,6 +1203,7 @@ class JaxEngine:
             return
 
         tokens = np.zeros(B, np.int32)
+        use_chain = np.zeros(B, bool)
         positions = np.zeros(B, np.int32)
         ctx_lens = np.zeros(B, np.int32)
         tables = np.zeros((B, c.max_blocks_per_seq), np.int32)
@@ -1153,46 +1216,103 @@ class JaxEngine:
         for s in active:
             i = s.index
             tokens[i] = s.last_token
-            positions[i] = s.ctx_len
-            ctx_lens[i] = s.ctx_len
+            # a lane whose previous burst is unread takes its input token
+            # from the device chain; host last_token would be k steps stale
+            use_chain[i] = (
+                self._chain_tokens is not None
+                and self._chain_owner[i] == (self._seq_id(s), s.epoch)
+                and s.inflight > 0
+            )
+            positions[i] = s.ctx_len + s.inflight
+            ctx_lens[i] = s.ctx_len + s.inflight
             tables[i] = s.block_table
             seeds[i] = s.sampling_seed
-            steps[i] = s.generated + 1
+            steps[i] = s.generated + s.inflight + 1
             temps[i] = s.request.sampling.temperature
             top_ks[i] = s.request.sampling.top_k
             top_ps[i] = s.request.sampling.top_p
             valid[i] = True
 
+        # ONE descriptor for both the step stream and the local dispatch —
+        # a key added to one but not the other would silently desynchronize
+        # follower replay from the leader
+        a = {
+            "tokens": tokens, "use_chain": use_chain,
+            "positions": positions, "tables": tables, "ctx_lens": ctx_lens,
+            "seeds": seeds, "steps": steps, "temps": temps,
+            "top_ks": top_ks, "top_ps": top_ps, "valid": valid,
+        }
         if self.step_sink is not None:
-            self.step_sink("decode_multi" if k > 1 else "decode", {
-                "tokens": tokens, "positions": positions, "tables": tables,
-                "ctx_lens": ctx_lens, "seeds": seeds, "steps": steps,
-                "temps": temps, "top_ks": top_ks, "top_ps": top_ps,
-                "valid": valid,
-            })
+            self.step_sink("decode_multi" if k > 1 else "decode", a)
+        burst = self._dispatch_decode(k, a)
+        # start the device->host copy NOW so the fetch in
+        # _process_oldest_burst (>= 1 iteration later) finds the data
+        # already local — a fresh fetch pays the full transport RTT
+        # (~150 ms through a tunneled device) even after compute finished
+        try:
+            burst.copy_to_host_async()
+        except AttributeError:  # non-jax stand-ins in tests
+            pass
+        lanes = {}
+        for s in active:
+            s.inflight += k
+            lanes[s.index] = (self._seq_id(s), s.epoch)
+            self._chain_owner[s.index] = lanes[s.index]
+        self._inflight.append({"burst": burst, "k": k, "lanes": lanes})
+
+    def _dispatch_decode(self, k: int, a: Dict[str, np.ndarray]):
+        """Dispatch one decode burst (shared by the scheduler and the
+        multihost follower replay, so chain state stays symmetric).
+        Returns the UNREAD burst device array [k, B] and updates the
+        device-side token chain."""
+        greedy = bool(np.all(np.asarray(a["temps"]) <= 0.0))
+        chain = self._chain_tokens
+        if chain is None:
+            chain = jnp.zeros((self.config.max_num_seqs,), jnp.int32)
         args = (
-            self.params, self.kv,
-            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
-            jnp.asarray(ctx_lens), jnp.asarray(seeds), jnp.asarray(steps),
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            jnp.asarray(valid),
+            self.params, self.kv, chain,
+            jnp.asarray(a["use_chain"]), jnp.asarray(a["tokens"]),
+            jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
+            jnp.asarray(a["ctx_lens"]), jnp.asarray(a["seeds"]),
+            jnp.asarray(a["steps"]), jnp.asarray(a["temps"]),
+            jnp.asarray(a["top_ks"]), jnp.asarray(a["top_ps"]),
+            jnp.asarray(a["valid"]),
         )
         if k > 1:
-            burst, self.kv = self._jit_decode_multi(*args)  # [k, B]
-            burst = np.asarray(burst)
+            burst, self.kv = self._jit_decode_multi[greedy](*args)
         else:
-            next_tokens, self.kv = self._jit_decode(*args)
-            burst = np.asarray(next_tokens)[None]  # [1, B]
-        for s in active:
-            for j in range(burst.shape[0]):
+            burst, self.kv = self._jit_decode[greedy](*args)
+        self._chain_tokens = burst[k - 1]
+        return burst
+
+    def _process_oldest_burst(self) -> None:
+        """Read back the oldest dispatched burst and apply it: stream
+        tokens, advance ctx, commit blocks, detect finishes.  Lanes whose
+        slot finished/preempted/cancelled since dispatch are discarded
+        (their KV writes went to blocks that are never committed past the
+        finish, or to since-freed blocks that device program order
+        guarantees were overwritten only by later dispatches)."""
+        e = self._inflight.popleft()
+        arr = np.asarray(e["burst"])  # [k, B]
+        for i, ident in e["lanes"].items():
+            s = self._slots[i] if i < len(self._slots) else None
+            if s is None or (self._seq_id(s), s.epoch) != ident \
+                    or s.finished:
+                continue
+            s.inflight -= e["k"]
+            for j in range(e["k"]):
                 s.ctx_len += 1
                 self.metrics["decode_tokens"] += 1
-                self._push_token(s, int(burst[j, s.index]))
+                self._push_token(s, int(arr[j, i]))
                 if s.finished:
                     # mid-burst finish: trailing sampled tokens discarded
                     # (their KV writes landed in this slot's own blocks,
                     # which are never committed past the finish ctx_len)
                     break
+
+    def _drain_inflight(self) -> None:
+        while self._inflight:
+            self._process_oldest_burst()
 
     def _commit_full_blocks(self, slot: _Slot) -> None:
         """Register newly-completed full blocks under their PLH.
@@ -1249,6 +1369,10 @@ class JaxEngine:
         slot.prompt_len = 0
         slot.committed_blocks = 0
         slot.block_table[:] = 0
+        # stale in-flight bursts for this slot must be discarded on
+        # processing (its lanes are keyed by (seq_id, epoch))
+        slot.epoch += 1
+        slot.inflight = 0
         with self._qlock:
             self.waiting.insert(0, slot)
 

@@ -3,6 +3,15 @@
 Runs inside the jitted decode/prefill step so only the sampled token ids
 cross back to the host.  All parameters are per-slot arrays so one compiled
 program serves heterogeneous batches (mixing greedy and sampled requests).
+
+Candidate-capped design: sampling is restricted to the CAP (64) highest
+logits per slot.  A full-vocab sort per token (3 sorts of 128k on Llama-3
+vocab) measured ~40% of the whole decode burst on v5e; lax.top_k over a
+64-candidate window costs ~nothing and is the standard serving
+approximation (requested top_k is clamped to CAP; top-p nucleus mass is
+computed against the TRUE full softmax via logsumexp, truncated to the
+window, so small-p nuclei are exact and only a pathological p over a
+near-uniform distribution feels the cap).
 """
 
 from __future__ import annotations
@@ -11,6 +20,9 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+#: sampling candidate window (max effective top-k)
+CAP = 64
 
 
 def sample_tokens(
@@ -22,36 +34,31 @@ def sample_tokens(
     top_p: jax.Array,         # [B] fp32; >=1 disables
 ) -> jax.Array:
     """Returns sampled token ids [B]."""
-    B, V = logits.shape
 
     def one(lg, seed, step, temp, tk, tp):
         greedy = jnp.argmax(lg)
-
-        def do_sample(_):
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
-            scaled = lg / jnp.maximum(temp, 1e-6)
-            # sort once; both top-k and top-p masks come from the sorted view
-            sorted_lg = jnp.sort(scaled)[::-1]
-            ranks = jnp.argsort(jnp.argsort(-scaled))  # rank of each token
-            # top-k mask
-            k_eff = jnp.where(tk > 0, tk, V)
-            keep_k = ranks < k_eff
-            # top-p (nucleus) mask over the sorted distribution
-            probs_sorted = jax.nn.softmax(sorted_lg)
-            cum = jnp.cumsum(probs_sorted)
-            # keep the smallest set with cumulative prob >= top_p; the first
-            # token is always kept
-            keep_sorted = jnp.concatenate(
-                [jnp.array([True]), cum[:-1] < tp]
-            )
-            keep_p = keep_sorted[ranks]
-            masked = jnp.where(keep_k & keep_p, scaled, NEG_INF)
-            return jax.random.categorical(key, masked)
-
-        return jax.lax.cond(temp <= 0.0, lambda _: greedy, do_sample,
-                            operand=None)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        scaled = lg / jnp.maximum(temp, 1e-6)
+        vals, idx = jax.lax.top_k(scaled, CAP)     # sorted descending
+        k_eff = jnp.clip(jnp.where(tk > 0, tk, CAP), 1, CAP)
+        keep_k = jnp.arange(CAP) < k_eff
+        # nucleus mass against the TRUE distribution (full-vocab logsumexp,
+        # no sort); first candidate always kept
+        probs = jnp.exp(vals - jax.scipy.special.logsumexp(scaled))
+        cum = jnp.cumsum(probs)
+        keep_p = jnp.concatenate([jnp.array([True]), cum[:-1] < tp])
+        masked = jnp.where(keep_k & keep_p, vals, NEG_INF)
+        sampled = idx[jax.random.categorical(key, masked)]
+        return jnp.where(temp <= 0.0, greedy, sampled)
 
     return jax.vmap(one)(logits, seeds, steps, temperature, top_k, top_p)
+
+
+def greedy_tokens(logits: jax.Array) -> jax.Array:
+    """Argmax-only fast path: the engine dispatches this specialization when
+    every slot in the batch is greedy (temperature <= 0), skipping the
+    sampling machinery entirely."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 def apply_penalties(
