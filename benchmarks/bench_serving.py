@@ -1,0 +1,137 @@
+"""Serving latency benchmark: trace replay against in-proc mocker clusters.
+
+CPU-only (no accelerator): the mocker's timing model simulates engine step
+latency, so this measures ORCHESTRATION quality — routing, admission,
+disagg hand-off — as TTFT/ITL percentiles and goodput, the same metric set
+as the reference's router benchmarks (benchmarks/router/README.md:4-46).
+
+Runs two topologies over the same synthesized trace and prints one JSON
+report line per config:
+
+  * agg     — N aggregated mocker workers, round-robin routing
+  * disagg  — prefill fleet + decode fleet behind the PrefillOrchestrator
+
+    python benchmarks/bench_serving.py [--requests 200] [--rate 16]
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+import uuid
+
+sys.path.insert(0, ".")
+
+from dynamo_tpu.disagg.prefill_router import (  # noqa: E402
+    ConditionalDisaggConfig,
+    PrefillOrchestrator,
+)
+from dynamo_tpu.loadgen import replay, synthesize  # noqa: E402
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker  # noqa: E402
+from dynamo_tpu.protocols import PreprocessedRequest  # noqa: E402
+from dynamo_tpu.runtime import (  # noqa: E402
+    DistributedRuntime,
+    RuntimeConfig,
+)
+
+BLOCK = 16
+
+
+def fresh_runtime():
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+def engine_args(role="both"):
+    return MockEngineArgs(model_name="bench", block_size=BLOCK,
+                          num_blocks=8192, speedup_ratio=1.0, role=role)
+
+
+async def bench_agg(rows, n_workers, args):
+    rt = await fresh_runtime().start()
+    workers = [
+        await MockerWorker(rt, engine_args(), component="backend").start()
+        for _ in range(n_workers)
+    ]
+    client = await (rt.namespace("dynamo").component("backend")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+    report = await replay(client.generate, rows, block_size=BLOCK,
+                          speedup=args.speedup)
+    await client.close()
+    for w in workers:
+        await w.close()
+    await rt.shutdown()
+    return report
+
+
+async def bench_disagg(rows, n_prefill, n_decode, args):
+    rt = await fresh_runtime().start()
+    prefills = [
+        await MockerWorker(rt, engine_args("prefill"),
+                           component="prefill").start()
+        for _ in range(n_prefill)
+    ]
+    decodes = [
+        await MockerWorker(rt, engine_args("decode"),
+                           component="backend").start()
+        for _ in range(n_decode)
+    ]
+    pclient = await (rt.namespace("dynamo").component("prefill")
+                     .endpoint("generate").client()).start()
+    dclient = await (rt.namespace("dynamo").component("backend")
+                     .endpoint("generate").client()).start()
+    await pclient.wait_for_instances()
+    await dclient.wait_for_instances()
+    orch = PrefillOrchestrator(
+        pclient, ConditionalDisaggConfig(always_remote=True))
+
+    async def client_fn(req_dict):
+        routed = await orch.maybe_prefill(
+            PreprocessedRequest.from_dict(req_dict))
+        async for item in dclient.generate(routed.to_dict()):
+            yield item
+
+    report = await replay(client_fn, rows, block_size=BLOCK,
+                          speedup=args.speedup)
+    await orch.close()
+    await pclient.close()
+    await dclient.close()
+    for w in prefills + decodes:
+        await w.close()
+    await rt.shutdown()
+    return report
+
+
+async def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=100)
+    p.add_argument("--rate", type=float, default=16.0)
+    p.add_argument("--input-len", type=int, default=384)
+    p.add_argument("--output-len", type=int, default=24)
+    p.add_argument("--prefix-groups", type=int, default=8)
+    p.add_argument("--speedup", type=float, default=1.0)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--slo-ttft", type=float, default=2.0)
+    p.add_argument("--slo-itl", type=float, default=0.025)
+    args = p.parse_args()
+
+    rows = synthesize(args.requests, rate_rps=args.rate,
+                      input_len=args.input_len, output_len=args.output_len,
+                      block_size=BLOCK, prefix_groups=args.prefix_groups,
+                      seed=11)
+
+    agg = await bench_agg(rows, args.workers, args)
+    print(json.dumps({"config": f"agg-{args.workers}w",
+                      **agg.summary(args.slo_ttft, args.slo_itl)}))
+    dis = await bench_disagg(rows, max(1, args.workers // 2),
+                             max(1, args.workers // 2), args)
+    print(json.dumps({
+        "config": f"disagg-{max(1, args.workers // 2)}p"
+                  f"{max(1, args.workers // 2)}d",
+        **dis.summary(args.slo_ttft, args.slo_itl),
+    }))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

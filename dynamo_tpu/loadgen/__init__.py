@@ -1,0 +1,27 @@
+"""Load generation + trace replay (the TPU-native analogue of the
+reference's lib/data-gen + lib/mocker/src/replay + benchmarks/router).
+
+trace.py  — mooncake-style JSONL trace rows (schema-compatible with the
+            reference's MooncakeRow, lib/data-gen/src/mooncake.rs:37-64),
+            synthetic generators, token materialization with hash_ids
+            prefix sharing.
+replay.py — open-loop replayer driving any async token-stream client at
+            trace timestamps; per-request TTFT/ITL capture; percentile +
+            goodput report (the metrics of docs/benchmarks/*.mdx).
+
+`python -m dynamo_tpu.loadgen` replays a trace (or synthesizes one)
+against a live cluster over the request plane and prints the report.
+"""
+
+from .replay import Report, replay
+from .trace import TraceRow, load_trace, materialize_tokens, save_trace, synthesize
+
+__all__ = [
+    "Report",
+    "TraceRow",
+    "load_trace",
+    "materialize_tokens",
+    "replay",
+    "save_trace",
+    "synthesize",
+]

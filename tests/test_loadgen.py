@@ -1,0 +1,87 @@
+"""Loadgen: trace schema round-trip, prefix-sharing materialization, and
+open-loop replay against a mocker worker with TTFT/ITL/goodput capture."""
+
+import asyncio
+import json
+import uuid
+
+from dynamo_tpu.loadgen import (
+    TraceRow,
+    load_trace,
+    materialize_tokens,
+    replay,
+    save_trace,
+    synthesize,
+)
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def test_trace_roundtrip_and_aliases(tmp_path):
+    rows = synthesize(10, rate_rps=100.0, input_len=64, output_len=8,
+                      prefix_groups=2, prefix_blocks=3, seed=1)
+    p = tmp_path / "t.jsonl"
+    save_trace(str(p), rows)
+    back = load_trace(str(p))
+    assert [r.request_id for r in back] == [r.request_id for r in rows]
+    assert [r.hash_ids for r in back] == [r.hash_ids for r in rows]
+    # upstream mooncake aliases load into the canonical fields
+    alias = tmp_path / "alias.jsonl"
+    alias.write_text(json.dumps({
+        "input_tokens": 32, "output_tokens": 4, "created_time": 1500.0,
+    }) + "\n")
+    [r] = load_trace(str(alias))
+    assert (r.input_length, r.output_length, r.timestamp) == (32, 4, 1500.0)
+
+
+def test_materialize_prefix_sharing():
+    a = TraceRow(request_id="a", input_length=40, hash_ids=[1, 2])
+    b = TraceRow(request_id="b", input_length=40, hash_ids=[1, 2])
+    c = TraceRow(request_id="c", input_length=40, hash_ids=[9, 2])
+    ta, tb, tc = (materialize_tokens(r, block_size=16) for r in (a, b, c))
+    assert len(ta) == 40
+    assert ta[:32] == tb[:32]          # shared hash_ids -> shared blocks
+    assert ta[:16] != tc[:16]          # different first block
+    assert ta[16:32] == tc[16:32]      # same second block
+    assert ta[32:] != tb[32:]          # per-request tail is unique
+
+
+async def test_replay_against_mocker_reports_latencies():
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem", event_plane="inproc"),
+        cluster_id=uuid.uuid4().hex,
+    ).start()
+    worker = await MockerWorker(
+        rt, MockEngineArgs(model_name="m", block_size=16, num_blocks=1024,
+                           speedup_ratio=50.0),
+        component="backend",
+    ).start()
+    client = await (rt.namespace("dynamo").component("backend")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+
+    rows = synthesize(12, rate_rps=200.0, input_len=48, output_len=6,
+                      prefix_groups=2, prefix_blocks=2, seed=3)
+    report = await replay(client.generate, rows, block_size=16,
+                          speedup=2.0)
+    s = report.summary(slo_ttft_s=30.0, slo_itl_s=30.0)
+    assert s["completed"] == 12 and s["errors"] == 0
+    assert s["output_tokens_per_s"] > 0
+    assert s["ttft_s"]["p50"] > 0 and s["ttft_s"]["p99"] >= s["ttft_s"]["p50"]
+    assert s["itl_s"]["p50"] > 0
+    # generous SLOs: everything is good -> goodput == completion rate
+    assert s["goodput"]["good_requests"] == 12
+
+    # session turns serialize: the follow-up fires only after turn 1
+    sess = [TraceRow(request_id="s0", session_id="S", input_length=32,
+                     output_length=4, timestamp=0.0),
+            TraceRow(request_id="s1", session_id="S", input_length=16,
+                     output_length=4, delay=10.0)]
+    rep2 = await replay(client.generate, sess, block_size=16)
+    r0 = next(r for r in rep2.results if r.request_id == "s0")
+    r1 = next(r for r in rep2.results if r.request_id == "s1")
+    assert r1.start_t >= r0.end_t
+
+    await client.close()
+    await worker.close()
+    await rt.shutdown()
