@@ -90,6 +90,7 @@ class _Slot:
     cached_tokens: int = 0   # prefix-cache reuse (for metrics)
     enqueued_t: float = 0.0
     first_token_t: float = 0.0
+    last_push_t: float = 0.0  # previous streamed-token time (ITL EMA)
 
     @property
     def prefilling(self) -> bool:
@@ -251,7 +252,9 @@ class JaxEngine:
         self.metrics: Dict[str, Any] = {
             "steps": 0, "prefill_tokens": 0, "decode_tokens": 0,
             "cache_hit_tokens": 0, "preemptions": 0, "step_time_s": 0.0,
+            "requests": 0, "prompt_tokens": 0,
         }
+        self.itl_ema_s = 0.0  # streamed inter-token latency (SLA planner)
 
     # -- cache ------------------------------------------------------------
     def _init_kv_cache(self):
@@ -470,6 +473,10 @@ class JaxEngine:
                       f"max_context is {self.config.max_context}",
             )
             return
+        # after validation: rejected requests cost no engine work and must
+        # not inflate the SLA planner's arrival rate / mean ISL
+        self.metrics["requests"] += 1
+        self.metrics["prompt_tokens"] += len(request.token_ids)
         preloaded = None
         dp = request.disaggregated_params
         if dp is not None and dp.get("engine") == "jax":
@@ -1335,6 +1342,14 @@ class JaxEngine:
 
     def _push_token(self, slot: _Slot, tok: int) -> None:
         """Append a generated token, stream it, handle finish."""
+        now = time.monotonic()
+        if slot.last_push_t > 0.0:
+            # per-slot gap EMA; burst-internal ~0 gaps and between-burst
+            # step gaps average out to the true mean inter-token latency
+            gap = now - slot.last_push_t
+            self.itl_ema_s = gap if self.itl_ema_s == 0.0 \
+                else 0.95 * self.itl_ema_s + 0.05 * gap
+        slot.last_push_t = now
         slot.seq.append(tok)
         slot.last_token = tok
         slot.generated += 1

@@ -376,6 +376,12 @@ class JaxEngineWorker:
                 "kv_usage": self.engine.kv_usage(),
                 "kv_total_blocks": self.config.num_blocks,
                 "engine_metrics": dict(self.engine.metrics),
+                # stable SLA-planner contract (planner/metrics.py
+                # differentiates these; engine_metrics above is an
+                # unversioned debug dump that happens to overlap)
+                "requests_total": self.engine.metrics["requests"],
+                "prompt_tokens_total": self.engine.metrics["prompt_tokens"],
+                "itl_ema_s": self.engine.itl_ema_s,
             })
 
     async def close(self) -> None:

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..runtime.metrics import percentile
 from .trace import TraceRow, materialize_tokens
 
 
@@ -24,7 +25,11 @@ from .trace import TraceRow, materialize_tokens
 class RequestResult:
     request_id: str
     scheduled_ms: float        # trace arrival offset
-    start_t: float = 0.0       # wall time the request was sent
+    start_t: float = 0.0       # wall time the request ARRIVED (its trace
+    #                            slot) — not when the concurrency gate let
+    #                            it through, so TTFT includes client-side
+    #                            queueing (no coordinated omission)
+    queue_wait_s: float = 0.0  # time spent waiting on the concurrency gate
     first_token_t: float = 0.0
     end_t: float = 0.0
     output_tokens: int = 0
@@ -41,7 +46,7 @@ class RequestResult:
 
 
 def _pct(xs: Sequence[float], q: float) -> float:
-    return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
+    return percentile(xs, q) if xs else float("nan")
 
 
 @dataclass
@@ -77,6 +82,12 @@ class Report:
             "itl_s": {"p50": round(_pct(itls, 50), 4),
                       "p90": round(_pct(itls, 90), 4),
                       "p99": round(_pct(itls, 99), 4)},
+            # nonzero p99 queue wait = the concurrency gate saturated and
+            # the replay degraded from open-loop toward closed-loop
+            "queue_wait_s": {
+                "p99": round(_pct([r.queue_wait_s for r in ok], 99), 4),
+                "max": round(max((r.queue_wait_s for r in ok), default=0.0),
+                             4)},
             "latency_s": {"p50": round(_pct([r.latency_s for r in ok], 50), 4),
                           "p99": round(_pct([r.latency_s for r in ok], 99), 4)},
         }
@@ -140,8 +151,9 @@ async def replay(
         res = RequestResult(row.request_id, row.timestamp or 0.0)
         results.append(res)
         req = row_to_request(row, block_size, vocab_size)
+        res.start_t = time.perf_counter()
         async with sem:
-            res.start_t = time.perf_counter()
+            res.queue_wait_s = time.perf_counter() - res.start_t
             last_t = None
             try:
                 async for out in client_fn(req):

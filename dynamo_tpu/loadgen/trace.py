@@ -50,7 +50,13 @@ class TraceRow:
 
     @classmethod
     def from_dict(cls, d: dict) -> "TraceRow":
-        norm = {_ALIASES.get(k, k): v for k, v in d.items()}
+        # canonical key wins over its aliases when a row carries both
+        # (otherwise JSON key order would decide, nondeterministically)
+        norm: dict = {}
+        for k, v in d.items():
+            canon = _ALIASES.get(k, k)
+            if canon not in norm or canon == k:
+                norm[canon] = v
         known = {f for f in cls.__dataclass_fields__}
         return cls(**{k: v for k, v in norm.items() if k in known})
 

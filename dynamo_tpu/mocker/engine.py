@@ -79,7 +79,9 @@ class MockEngine:
         self.metrics = {
             "steps": 0, "prefill_tokens": 0, "decode_tokens": 0,
             "preemptions": 0, "cache_hit_blocks": 0, "cache_lookup_blocks": 0,
+            "requests": 0, "prompt_tokens": 0,
         }
+        self.itl_ema_s = 0.0  # simulated inter-token latency (SLA planner)
 
     # -- public API -------------------------------------------------------
     def start(self) -> None:
@@ -113,6 +115,8 @@ class MockEngine:
     ) -> AsyncIterator[LLMEngineOutput]:
         """Enqueue a request and stream engine outputs (one token per item)."""
         self.start()
+        self.metrics["requests"] += 1
+        self.metrics["prompt_tokens"] += len(request.token_ids)
         seq = _Seq(
             request_id=request.request_id,
             request=request,
@@ -245,6 +249,10 @@ class MockEngine:
 
         self.metrics["steps"] += 1
         self.metrics["prefill_tokens"] += prefill_tokens
+        if decode_seqs:
+            # each decoding seq saw one token this step: step time IS the ITL
+            self.itl_ema_s = step_s if self.itl_ema_s == 0.0 \
+                else 0.9 * self.itl_ema_s + 0.1 * step_s
 
         for seq in decode_seqs:
             if seq.disagg_prefill:

@@ -110,6 +110,10 @@ class MockerWorker:
                 "active_seqs": self.engine.num_active_seqs,
                 "kv_usage": self.engine.kv_usage(),
                 "kv_total_blocks": self.engine.cache.num_blocks,
+                # SLA-planner inputs (planner/metrics.py differentiates)
+                "requests_total": self.engine.metrics["requests"],
+                "prompt_tokens_total": self.engine.metrics["prompt_tokens"],
+                "itl_ema_s": self.engine.itl_ema_s,
             })
 
     async def close(self) -> None:

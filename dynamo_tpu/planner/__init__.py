@@ -12,6 +12,7 @@ tests, subprocess fleets for single-host deployments.
 
 from .connectors import CallbackConnector, Connector, SubprocessConnector
 from .metrics import LoadObserver
+from .perf_model import PerfModel
 from .planner import Planner, PlannerConfig
 from .predictor import make_predictor
 
@@ -19,6 +20,7 @@ __all__ = [
     "CallbackConnector",
     "Connector",
     "LoadObserver",
+    "PerfModel",
     "Planner",
     "PlannerConfig",
     "SubprocessConnector",

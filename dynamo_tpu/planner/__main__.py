@@ -36,6 +36,13 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--cooldown", type=float, default=5.0)
     p.add_argument("--predictor", default="ema",
                    choices=["constant", "ema", "linear"])
+    # SLA mode: plan under latency targets against a profiled perf model
+    # (produce one with `python -m dynamo_tpu.profiler`)
+    p.add_argument("--mode", default="load", choices=["load", "sla"])
+    p.add_argument("--ttft-target-ms", type=float, default=None)
+    p.add_argument("--itl-target-ms", type=float, default=None)
+    p.add_argument("--perf-model", default=None,
+                   help="perf profile JSON (required for --mode sla)")
     return p
 
 
@@ -53,6 +60,12 @@ async def main() -> None:
             target_active_per_replica=args.target_active_per_replica,
             cooldown_s=args.cooldown,
             predictor=args.predictor,
+            mode=args.mode,
+            ttft_target_s=(args.ttft_target_ms / 1e3
+                           if args.ttft_target_ms else None),
+            itl_target_s=(args.itl_target_ms / 1e3
+                          if args.itl_target_ms else None),
+            perf_model_path=args.perf_model,
         ),
     )
     await connector.scale(args.min_replicas)

@@ -5,15 +5,21 @@ Workers already publish load_metrics.{ns}.{component} twice a second
 latest sample per worker, expires workers that stop publishing, and
 aggregates per component — no new wire protocol, the planner is a pure
 consumer of what serving already emits (ref: planner-design.md OBSERVE).
-"""
+
+For SLA planning the payload also carries cumulative counters
+(requests_total, prompt_tokens_total) and a decode-latency EMA; the
+observer differentiates the counters over a sliding window into request
+rate and mean ISL (the reference pulls the same shape from Prometheus:
+request count, ISL, OSL per throughput interval)."""
 
 from __future__ import annotations
 
 import asyncio
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Deque, Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -22,6 +28,7 @@ logger = logging.getLogger(__name__)
 class WorkerSample:
     active_seqs: int = 0
     kv_usage: float = 0.0
+    itl_ema_s: float = 0.0
     seen_t: float = field(default_factory=time.monotonic)
 
 
@@ -30,6 +37,9 @@ class AggregateLoad:
     workers: int = 0
     active_seqs: int = 0
     mean_kv_usage: float = 0.0
+    req_per_s: float = 0.0       # fleet-wide arrival rate (windowed)
+    mean_isl: float = 0.0        # mean prompt tokens per request (windowed)
+    mean_itl_s: float = 0.0      # mean decode inter-token latency (EMA)
 
     @property
     def active_per_worker(self) -> float:
@@ -38,11 +48,14 @@ class AggregateLoad:
 
 class LoadObserver:
     def __init__(self, runtime, namespace: str, component: str,
-                 stale_after_s: float = 3.0):
+                 stale_after_s: float = 3.0, rate_window_s: float = 10.0):
         self.runtime = runtime
         self.subject = f"load_metrics.{namespace}.{component}"
         self.stale_after_s = stale_after_s
+        self.rate_window_s = rate_window_s
         self.samples: Dict[int, WorkerSample] = {}
+        # per-worker cumulative-counter history: (t, requests, prompt_toks)
+        self._cum: Dict[int, Deque[Tuple[float, int, int]]] = {}
         self._cancel = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
 
@@ -76,9 +89,43 @@ class LoadObserver:
                 self.samples[w] = WorkerSample(
                     active_seqs=int(payload.get("active_seqs", 0)),
                     kv_usage=float(payload.get("kv_usage", 0.0)),
+                    itl_ema_s=float(payload.get("itl_ema_s", 0.0)),
                 )
+                if "requests_total" in payload:
+                    hist = self._cum.setdefault(w, deque(maxlen=64))
+                    req = int(payload.get("requests_total", 0))
+                    ptok = int(payload.get("prompt_tokens_total", 0))
+                    if hist and (req < hist[-1][1] or ptok < hist[-1][2]):
+                        # restart detected at insertion: endpoints-only
+                        # checks miss a restart whose new counters overtake
+                        # the old window start
+                        hist.clear()
+                    hist.append((time.monotonic(), req, ptok))
         except asyncio.CancelledError:
             pass
+
+    def _rates(self, now: float) -> Tuple[float, float]:
+        """(fleet req/s, mean ISL) differentiated over the rate window.
+        Counter resets (worker restart) discard that worker's window."""
+        req_rate = 0.0
+        d_req_total = 0
+        d_tok_total = 0
+        for w, hist in list(self._cum.items()):
+            if w not in self.samples:
+                del self._cum[w]
+                continue
+            while len(hist) > 1 and now - hist[0][0] > self.rate_window_s:
+                hist.popleft()
+            if len(hist) < 2:
+                continue
+            t0, r0, p0 = hist[0]
+            t1, r1, p1 = hist[-1]
+            dt = max(t1 - t0, 1e-6)
+            req_rate += (r1 - r0) / dt
+            d_req_total += r1 - r0
+            d_tok_total += p1 - p0
+        mean_isl = d_tok_total / d_req_total if d_req_total else 0.0
+        return req_rate, mean_isl
 
     def aggregate(self) -> AggregateLoad:
         now = time.monotonic()
@@ -88,8 +135,13 @@ class LoadObserver:
         live = list(self.samples.values())
         if not live:
             return AggregateLoad()
+        req_rate, mean_isl = self._rates(now)
+        itls = [s.itl_ema_s for s in live if s.itl_ema_s > 0]
         return AggregateLoad(
             workers=len(live),
             active_seqs=sum(s.active_seqs for s in live),
             mean_kv_usage=sum(s.kv_usage for s in live) / len(live),
+            req_per_s=req_rate,
+            mean_isl=mean_isl,
+            mean_itl_s=sum(itls) / len(itls) if itls else 0.0,
         )

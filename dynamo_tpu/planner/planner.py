@@ -47,16 +47,39 @@ class PlannerConfig:
     down_stable_ticks: int = 3       # consecutive low ticks before down
     predictor: str = "ema"
     predictor_window: int = 8
+    # -- SLA mode (ref planner-design.md "Throughput-Based Scaling"):
+    # PROPOSE inverts a profiled perf model under latency targets instead
+    # of a fixed active-per-replica constant.  Requires a perf model
+    # (PerfModel instance or perf_model_path profile JSON).
+    mode: str = "load"               # "load" | "sla"
+    ttft_target_s: Optional[float] = None
+    itl_target_s: Optional[float] = None
+    perf_model_path: Optional[str] = None
 
 
 class Planner:
     def __init__(self, runtime, namespace: str, component: str,
                  connector: Connector,
-                 config: Optional[PlannerConfig] = None):
+                 config: Optional[PlannerConfig] = None,
+                 perf_model=None):
         self.config = config or PlannerConfig()
         self.observer = LoadObserver(runtime, namespace, component)
         self.predictor = make_predictor(self.config.predictor,
                                         self.config.predictor_window)
+        # second forecast stream for SLA mode: request arrival rate
+        self.rate_predictor = make_predictor(self.config.predictor,
+                                             self.config.predictor_window)
+        self.perf_model = perf_model
+        if self.perf_model is None and self.config.perf_model_path:
+            from .perf_model import PerfModel
+            self.perf_model = PerfModel.load(self.config.perf_model_path)
+        if self.config.mode == "sla":
+            if self.perf_model is None:
+                raise ValueError("sla mode requires a perf model "
+                                 "(perf_model= or perf_model_path=)")
+            if not (self.config.itl_target_s or self.config.ttft_target_s):
+                raise ValueError("sla mode requires at least one of "
+                                 "itl_target_s / ttft_target_s")
         self.connector = connector
         self._task: Optional[asyncio.Task] = None
         self._last_action_t = 0.0
@@ -105,8 +128,12 @@ class Planner:
             return None
         self.predictor.observe(float(load.active_seqs))
         predicted = self.predictor.predict()
+        diag = {}
 
-        proposed = math.ceil(predicted / c.target_active_per_replica)
+        if c.mode == "sla":
+            proposed = self._propose_sla(load, predicted, diag)
+        else:
+            proposed = math.ceil(predicted / c.target_active_per_replica)
         if load.workers and load.mean_kv_usage >= c.kv_pressure_threshold:
             proposed += 1
         # min_replicas=0 is scale-to-zero: the floor comes only from config
@@ -134,9 +161,49 @@ class Planner:
             "t": now, "observed_active": load.active_seqs,
             "predicted": predicted, "kv_usage": load.mean_kv_usage,
             "current": current, "proposed": proposed, "applied": applied,
+            **diag,
         }
         self.decisions.append(decision)
         logger.info("planner: active=%d predicted=%.1f kv=%.2f %d->%d",
                     load.active_seqs, predicted, load.mean_kv_usage,
                     current, applied)
         return applied
+
+    def _propose_sla(self, load, predicted_active: float, diag: dict) -> int:
+        """SLA PROPOSE: invert the perf model under TTFT/ITL targets.
+
+        decode bound — replicas so per-replica concurrency keeps
+        estimated ITL <= target;
+        prefill/TTFT bound — replicas so per-replica request rate stays
+        within the profiled rate that holds TTFT <= target at the
+        observed ISL.  The larger bound wins (on a disagg fleet each
+        planner instance watches its own component, so only the relevant
+        bound binds).  Ref: planner-design.md Steps 3-4."""
+        c = self.config
+        pm = self.perf_model
+        isl = load.mean_isl or None
+        # online correction from live decode latency (FPM analogue)
+        if load.mean_itl_s > 0 and load.workers and load.active_seqs:
+            pm.observe_itl(load.active_per_worker, load.mean_itl_s, isl)
+
+        # decode bound: ITL capacity when targeted, else the load-mode
+        # constant — an arrival lull must never scale away a fleet that is
+        # still busy decoding long sequences
+        if c.itl_target_s:
+            cap = pm.max_active_for_itl(c.itl_target_s, isl)
+            diag["itl_capacity"] = cap
+        else:
+            cap = c.target_active_per_replica
+        n_itl = math.ceil(predicted_active / cap) if predicted_active else 0
+
+        self.rate_predictor.observe(load.req_per_s)
+        pred_rate = self.rate_predictor.predict()
+        n_ttft = 0
+        if c.ttft_target_s and pred_rate > 0:
+            rps_cap = pm.max_rps_for_ttft(isl or 512.0, c.ttft_target_s)
+            n_ttft = math.ceil(pred_rate / rps_cap)
+            diag["ttft_rps_capacity"] = rps_cap
+        diag.update(pred_req_rate=pred_rate, mean_isl=load.mean_isl,
+                    n_itl=n_itl, n_ttft=n_ttft,
+                    itl_correction=pm.itl_correction)
+        return max(n_itl, n_ttft)

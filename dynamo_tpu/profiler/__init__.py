@@ -1,0 +1,3 @@
+from .profile import PerfPoint, PerfProfile, profile_engine
+
+__all__ = ["PerfPoint", "PerfProfile", "profile_engine"]

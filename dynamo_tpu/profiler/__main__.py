@@ -1,0 +1,81 @@
+"""`python -m dynamo_tpu.profiler` — self-benchmark an engine and write
+the perf-profile JSON the SLA planner plans against.
+
+Ref: the reference's profiler component bootstraps the planner perf model
+from pre-deployment sweeps (planner-design.md "Capacity Estimation").
+Run `--engine jax` on the TPU host to profile real hardware; `--engine
+mock` profiles the simulator (CI / planner tests).
+
+    python -m dynamo_tpu.profiler --engine jax --model tiny \
+        --out profile.json --isls 128,512 --concurrencies 1,2,4,8
+"""
+
+import argparse
+import asyncio
+import logging
+
+
+def build_args() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dynamo_tpu.profiler")
+    p.add_argument("--engine", default="mock", choices=["mock", "jax"])
+    p.add_argument("--out", default="profile.json")
+    p.add_argument("--isls", default="128,512,2048",
+                   help="comma-separated prompt lengths")
+    p.add_argument("--concurrencies", default="1,2,4,8,16")
+    p.add_argument("--osl", type=int, default=32)
+    p.add_argument("--rounds", type=int, default=2)
+    # jax engine shape (mirrors dynamo_tpu.engine flags)
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--model-path", default="")
+    p.add_argument("--block-size", type=int, default=128)
+    p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--max-blocks-per-seq", type=int, default=64)
+    p.add_argument("--max-num-seqs", type=int, default=16)
+    p.add_argument("--tp", type=int, default=1)
+    return p
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    args = build_args().parse_args()
+    isls = [int(x) for x in args.isls.split(",") if x]
+    concs = [int(x) for x in args.concurrencies.split(",") if x]
+
+    if args.engine == "mock":
+        from ..mocker import MockEngine, MockEngineArgs
+
+        engine = MockEngine(MockEngineArgs(speedup_ratio=1.0))
+        name = "mock"
+    else:
+        from ..engine.config import EngineConfig
+        from ..engine.core import JaxEngine
+
+        config = EngineConfig(
+            model=args.model, model_path=args.model_path,
+            block_size=args.block_size, num_blocks=args.num_blocks,
+            max_blocks_per_seq=args.max_blocks_per_seq,
+            max_num_seqs=args.max_num_seqs, tp=args.tp,
+        )
+        engine = JaxEngine(config)
+        name = args.model_path or args.model
+
+    from . import profile_engine
+
+    try:
+        prof = await profile_engine(
+            engine, model_name=name, isls=isls, osl=args.osl,
+            concurrencies=concs, rounds=args.rounds,
+        )
+    finally:
+        await engine.close()
+    prof.save(args.out)
+    print(f"wrote {len(prof.points)} grid points to {args.out}", flush=True)
+    for pt in prof.points:
+        print(f"  isl={pt.isl:5d} c={pt.concurrency:3d} "
+              f"ttft_p95={pt.ttft_p95_s * 1e3:8.1f}ms "
+              f"itl_p95={pt.itl_p95_s * 1e3:7.2f}ms "
+              f"rps={pt.req_per_s:7.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Sequence
 
+import numpy as np
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -16,6 +17,12 @@ from prometheus_client import (
     Histogram,
     generate_latest,
 )
+
+
+def percentile(xs: Sequence[float], q: float) -> float:
+    """Shared percentile (q in [0, 100], numpy linear interpolation) so
+    profiler sweeps and loadgen reports are comparable on the same data."""
+    return float(np.percentile(np.asarray(xs), q)) if len(xs) else 0.0
 
 
 class MetricsHierarchy:

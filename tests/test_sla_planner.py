@@ -1,0 +1,286 @@
+"""SLA planner: profiler sweep, perf-model interpolation/inversion, and
+the PROPOSE loop holding latency targets (ref planner-design.md
+"Throughput-Based Scaling": predict traffic -> invert perf model under
+TTFT/ITL SLAs -> replica targets)."""
+
+import asyncio
+import math
+import uuid
+
+from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.planner import PerfModel, Planner, PlannerConfig, make_predictor
+from dynamo_tpu.planner.metrics import AggregateLoad, LoadObserver
+from dynamo_tpu.profiler import PerfPoint, PerfProfile, profile_engine
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def synthetic_profile(base=0.002, per_seq=0.001, prefill_per_tok=0.00002):
+    """Profile of a linear-timing engine (the mocker's model): ITL grows
+    with concurrency, TTFT with ISL and queueing."""
+    prof = PerfProfile(model_name="synth")
+    for isl in (128, 512):
+        for c in (1, 2, 4, 8, 16):
+            itl = base + per_seq * c
+            ttft = (base + prefill_per_tok * isl) * (1 + 0.3 * (c - 1))
+            prof.points.append(PerfPoint(
+                isl=isl, osl=32, concurrency=c,
+                ttft_p50_s=ttft * 0.9, ttft_p95_s=ttft,
+                itl_mean_s=itl * 0.95, itl_p95_s=itl,
+                req_per_s=c / (ttft + 32 * itl),
+                output_tok_per_s=32 * c / (ttft + 32 * itl),
+            ))
+    return prof
+
+
+# ----------------------------- profiler ----------------------------------
+
+
+async def test_profile_mock_engine_latency_surface():
+    """The sweep recovers the mocker's polynomial timing model: ITL rises
+    with concurrency, TTFT rises with ISL."""
+    engine = MockEngine(MockEngineArgs(
+        base_step_s=0.001, prefill_s_per_token=0.00002,
+        decode_s_per_seq=0.0005, max_batch_tokens=512,
+    ))
+    try:
+        prof = await profile_engine(
+            engine, model_name="mock", isls=(32, 256), osl=8,
+            concurrencies=(1, 8), rounds=2,
+        )
+    finally:
+        await engine.close()
+    assert len(prof.points) == 4
+    by = {(p.isl, p.concurrency): p for p in prof.points}
+    # ITL at c=8 must exceed c=1 (decode_s_per_seq dominates)
+    assert by[(32, 8)].itl_mean_s > by[(32, 1)].itl_mean_s
+    # TTFT at isl=256 must exceed isl=32 at the same concurrency
+    assert by[(256, 1)].ttft_p95_s > by[(32, 1)].ttft_p95_s
+    # round-trip through JSON preserves the surface
+    prof2 = PerfProfile.from_json(prof.to_json())
+    assert prof2.points[0].itl_mean_s == prof.points[0].itl_mean_s
+
+
+# ---------------------------- perf model ----------------------------------
+
+
+def test_perf_model_interpolation_and_inversion():
+    pm = PerfModel(synthetic_profile())
+    # interpolation between grid points: itl(6) between itl(4) and itl(8)
+    assert pm.itl(4) < pm.itl(6) < pm.itl(8)
+    # inversion: target 0.007 = base+per_seq*5 -> capacity ~5 seqs
+    cap = pm.max_active_for_itl(0.007)
+    assert 4.0 <= cap <= 6.0, cap
+    # extrapolation past the grid: target beyond c=16 still inverts
+    assert pm.max_active_for_itl(0.030) > 16.0
+    # unattainable ITL floors at 0.5 (over-provision, never div-zero)
+    assert pm.max_active_for_itl(0.0001) == 0.5
+    # TTFT rate capacity: looser target admits more throughput
+    tight = pm.max_rps_for_ttft(128, 0.003)
+    loose = pm.max_rps_for_ttft(128, 0.02)
+    assert loose >= tight > 0
+    # ISL interpolation: TTFT at 300 sits between the 128 and 512 curves
+    assert pm.ttft(128, 1) < pm.ttft(300, 1) < pm.ttft(512, 1)
+
+
+def test_perf_model_conservative_on_noisy_profile():
+    """A p95 outlier mid-grid (1-core measurement noise) must not let
+    linear extrapolation invent infinite capacity past the grid — found
+    live: planner refused to scale because itl(32) extrapolated negative."""
+    prof = PerfProfile(model_name="noisy")
+    for c, itl in ((1, 0.0034), (4, 0.1249), (8, 0.0062)):
+        prof.points.append(PerfPoint(isl=64, osl=8, concurrency=c,
+                                     ttft_p95_s=0.01, itl_p95_s=itl,
+                                     itl_mean_s=itl, req_per_s=c * 10.0))
+    pm = PerfModel(prof)
+    # beyond the grid the estimate never drops below the last sample
+    assert pm.itl(32) >= 0.0062
+    # capacity under a 4ms target stops at the first violation (~1)
+    assert pm.max_active_for_itl(0.004) < 1.5
+
+
+def test_perf_model_online_correction():
+    pm = PerfModel(synthetic_profile())
+    base_est = pm.itl(4)
+    # hardware consistently 2x slower than the stale profile
+    for _ in range(50):
+        pm.observe_itl(4, base_est * 2.0)
+    assert 1.7 <= pm.itl_correction <= 2.1
+    # corrected estimate halves the capacity at the same target
+    assert pm.max_active_for_itl(0.007) < 4.0
+    # correction is clamped against pathological samples
+    for _ in range(100):
+        pm.observe_itl(4, 100.0)
+    assert pm.itl_correction <= 4.0
+
+
+# ----------------------------- planner -----------------------------------
+
+
+class _FakeConnector:
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+        self.calls = []
+
+    async def current_replicas(self):
+        return self.replicas
+
+    async def scale(self, n):
+        self.calls.append(n)
+        self.replicas = n
+        return n
+
+
+class _FakeObserver:
+    def __init__(self):
+        self.load = None
+
+    async def start(self):
+        return self
+
+    async def close(self):
+        pass
+
+    def aggregate(self):
+        return self.load
+
+
+def _sla_planner(cfg, conn, pm):
+    p = Planner.__new__(Planner)
+    p.config = cfg
+    p.connector = conn
+    p.observer = _FakeObserver()
+    p.predictor = make_predictor("constant")
+    p.rate_predictor = make_predictor("constant")
+    p.perf_model = pm
+    p._task = None
+    p._last_action_t = 0.0
+    p._low_ticks = 0
+    p.decisions = []
+    return p
+
+
+async def test_sla_planner_holds_itl_slo_on_ramp():
+    """Ramping active sequences: replicas grow so per-replica concurrency
+    stays within the perf model's ITL capacity."""
+    pm = PerfModel(synthetic_profile())
+    cfg = PlannerConfig(mode="sla", itl_target_s=0.007, cooldown_s=0.0,
+                        min_replicas=1, max_replicas=8, max_step=8,
+                        down_stable_ticks=1)
+    conn = _FakeConnector(replicas=1)
+    p = _sla_planner(cfg, conn, pm)
+    cap = pm.max_active_for_itl(0.007)
+
+    for active in (4, 10, 22, 38):
+        p.observer.load = AggregateLoad(workers=conn.replicas,
+                                        active_seqs=active,
+                                        mean_kv_usage=0.2, mean_isl=128)
+        p.predictor = make_predictor("constant")
+        await p.tick()
+        want = math.ceil(active / cap)
+        assert conn.replicas == min(want, 8), (active, conn.replicas)
+        # the SLO holds at the applied fleet size
+        assert pm.itl(active / conn.replicas) <= 0.007 * 1.05
+
+    # drain scales back down to min
+    p.observer.load = AggregateLoad(workers=conn.replicas, active_seqs=0,
+                                    mean_kv_usage=0.0)
+    p.predictor = make_predictor("constant")
+    p.rate_predictor = make_predictor("constant")
+    for _ in range(8):
+        await p.tick()
+    assert conn.replicas == 1
+
+
+async def test_sla_planner_ttft_bound_scales_on_arrival_rate():
+    """Low active count but high arrival rate: the TTFT/rate bound must
+    drive scaling even when the ITL bound is satisfied."""
+    pm = PerfModel(synthetic_profile())
+    cfg = PlannerConfig(mode="sla", itl_target_s=0.02,
+                        ttft_target_s=0.004, cooldown_s=0.0,
+                        min_replicas=1, max_replicas=16, max_step=16)
+    conn = _FakeConnector(replicas=1)
+    p = _sla_planner(cfg, conn, pm)
+    rps_cap = pm.max_rps_for_ttft(128, 0.004)
+    p.observer.load = AggregateLoad(workers=1, active_seqs=2,
+                                    mean_kv_usage=0.1, req_per_s=rps_cap * 5,
+                                    mean_isl=128)
+    applied = await p.tick()
+    assert applied == math.ceil(5.0), applied  # 5x one replica's capacity
+
+
+def test_sla_mode_requires_perf_model():
+    try:
+        Planner(None, "ns", "c", _FakeConnector(),
+                PlannerConfig(mode="sla", itl_target_s=0.01))
+        raise AssertionError("sla mode without perf model must raise")
+    except ValueError:
+        pass
+
+
+# ----------------------------- observer -----------------------------------
+
+
+async def test_observer_differentiates_counters_into_rates():
+    """Cumulative requests/prompt-token counters become windowed arrival
+    rate and mean ISL; counter resets (worker restart) are discarded."""
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex).start()
+    obs = await LoadObserver(rt, "dynamo", "backend",
+                             rate_window_s=30.0).start()
+    subj = "load_metrics.dynamo.backend"
+    # 20 requests of 256 tokens over the sample stream
+    for i in range(5):
+        await rt.event_plane.publish(subj, {
+            "worker_id": 1, "active_seqs": 4, "kv_usage": 0.3,
+            "requests_total": i * 5, "prompt_tokens_total": i * 5 * 256,
+            "itl_ema_s": 0.004,
+        })
+        await asyncio.sleep(0.05)
+    agg = obs.aggregate()
+    assert agg.req_per_s > 0
+    assert abs(agg.mean_isl - 256) < 1e-6
+    assert abs(agg.mean_itl_s - 0.004) < 1e-9
+
+    # reset: counters go backwards -> window discarded, no negative rates
+    await rt.event_plane.publish(subj, {
+        "worker_id": 1, "active_seqs": 0, "kv_usage": 0.0,
+        "requests_total": 2, "prompt_tokens_total": 512,
+    })
+    await asyncio.sleep(0.05)
+    assert obs.aggregate().req_per_s >= 0.0
+    await obs.close()
+    await rt.shutdown()
+
+
+# ------------------------------- e2e --------------------------------------
+
+
+async def test_sla_planner_e2e_profile_then_plan_mocker():
+    """The full bootstrap chain on CPU: profile the mocker, build the perf
+    model, and verify the SLA proposer sizes a fleet for a load the
+    load-mode constant would get wrong."""
+    engine = MockEngine(MockEngineArgs(
+        base_step_s=0.001, prefill_s_per_token=0.00001,
+        decode_s_per_seq=0.0005,
+    ))
+    try:
+        prof = await profile_engine(engine, isls=(64,), osl=8,
+                                    concurrencies=(1, 4, 16), rounds=2)
+    finally:
+        await engine.close()
+    pm = PerfModel(prof)
+
+    # target just above the c=4 ITL: capacity lands in [4, 16)
+    target = pm.itl(4) * 1.2
+    cap = pm.max_active_for_itl(target)
+    assert 4.0 <= cap <= 16.0, (target, cap)
+
+    cfg = PlannerConfig(mode="sla", itl_target_s=target, cooldown_s=0.0,
+                        min_replicas=1, max_replicas=8, max_step=8)
+    conn = _FakeConnector(replicas=1)
+    p = _sla_planner(cfg, conn, pm)
+    p.observer.load = AggregateLoad(workers=1, active_seqs=32,
+                                    mean_kv_usage=0.2, mean_isl=64)
+    applied = await p.tick()
+    assert applied == min(8, math.ceil(32 / cap))
