@@ -34,8 +34,9 @@ def env_truthy(name: str, default: bool = False) -> bool:
 @dataclass
 class RuntimeConfig:
     # discovery plane (ref: docs/design-docs/distributed-runtime.md:40-48)
-    discovery_backend: str = "mem"  # mem | file
+    discovery_backend: str = "mem"  # mem | file | etcd
     discovery_path: str = ""  # root dir for the file backend
+    etcd_endpoint: str = ""   # etcd v3 JSON-gateway URL (etcd backend)
     lease_ttl_s: float = 5.0
 
     # request plane (ref: docs/design-docs/request-plane.md:8-47)
@@ -44,7 +45,9 @@ class RuntimeConfig:
     tcp_port: int = 0  # 0 = ephemeral
 
     # event plane (ref: docs/design-docs/event-plane.md:20-57)
-    event_plane: str = "auto"  # auto: zmq when file discovery, else inproc
+    event_plane: str = "auto"  # auto: zmq for file/etcd discovery
+    zmq_host: str = ""  # advertised ZMQ PUB bind host (multi-host: set
+    #                     to this host's reachable address, like tcp_host)
 
     namespace: str = "dynamo"
     system_port: int = 0  # /health /live /metrics server; 0 = disabled
@@ -56,11 +59,13 @@ class RuntimeConfig:
         cfg = cls(
             discovery_backend=os.environ.get("DYN_DISCOVERY_BACKEND", "mem"),
             discovery_path=os.environ.get("DYN_DISCOVERY_PATH", ""),
+            etcd_endpoint=os.environ.get("DYN_ETCD_ENDPOINT", ""),
             lease_ttl_s=float(os.environ.get("DYN_LEASE_TTL", "5.0")),
             request_plane=os.environ.get("DYN_REQUEST_PLANE", "tcp"),
             tcp_host=os.environ.get("DYN_TCP_HOST", "127.0.0.1"),
             tcp_port=int(os.environ.get("DYN_TCP_PORT", "0")),
             event_plane=os.environ.get("DYN_EVENT_PLANE", "auto"),
+            zmq_host=os.environ.get("DYN_ZMQ_HOST", ""),
             namespace=os.environ.get("DYN_NAMESPACE", "dynamo"),
             system_port=int(os.environ.get("DYN_SYSTEM_PORT", "0")),
         )

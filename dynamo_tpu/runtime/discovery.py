@@ -81,6 +81,24 @@ class WatchEvent:
     value: Optional[Dict[str, Any]] = None
 
 
+def diff_snapshot(known: Dict[str, str], snap: Dict[str, Dict[str, Any]],
+                  emit: Callable[[WatchEvent], None]) -> None:
+    """Diff a fresh prefix snapshot against `known` (key -> canonical
+    serialization), emitting puts for new/changed keys and deletes for
+    vanished ones, then update `known` in place.  Shared by every
+    poll/reconnect-style watch implementation so their event semantics
+    cannot drift."""
+    cur = {k: json.dumps(v, sort_keys=True) for k, v in snap.items()}
+    for k, ser in cur.items():
+        if known.get(k) != ser:
+            emit(WatchEvent("put", k, snap[k]))
+    for k in list(known):
+        if k not in cur:
+            emit(WatchEvent("delete", k))
+    known.clear()
+    known.update(cur)
+
+
 class DiscoveryBackend:
     """Lease-scoped KV store with prefix watch."""
 
@@ -279,14 +297,10 @@ class FileDiscovery(DiscoveryBackend):
         known: Dict[str, str] = {}
         while cancel is None or not cancel.is_set():
             snap = await self.get_prefix(prefix)
-            cur = {k: json.dumps(v, sort_keys=True) for k, v in snap.items()}
-            for k, ser in cur.items():
-                if known.get(k) != ser:
-                    yield WatchEvent("put", k, snap[k])
-            for k in list(known):
-                if k not in cur:
-                    yield WatchEvent("delete", k)
-            known = cur
+            pending: List[WatchEvent] = []
+            diff_snapshot(known, snap, pending.append)
+            for ev in pending:
+                yield ev
             try:
                 if cancel is not None:
                     await asyncio.wait_for(cancel.wait(), timeout=self.poll_s)
@@ -308,11 +322,19 @@ class FileDiscovery(DiscoveryBackend):
 
 
 def make_discovery(backend: str, *, path: str = "", ttl_s: float = 5.0,
-                   cluster_id: str = "default") -> DiscoveryBackend:
+                   cluster_id: str = "default",
+                   etcd_endpoint: str = "") -> DiscoveryBackend:
     if backend == "mem":
         return MemDiscovery(cluster_id=cluster_id)
     if backend == "file":
+        # dev fixture: multi-process single-host with zero infra; use the
+        # etcd backend for anything resembling production
         if not path:
             raise ValueError("file discovery requires DYN_DISCOVERY_PATH")
         return FileDiscovery(path, ttl_s=ttl_s)
+    if backend == "etcd":
+        from .etcd import EtcdDiscovery
+
+        return EtcdDiscovery(etcd_endpoint or "http://127.0.0.1:2379",
+                             ttl_s=ttl_s)
     raise ValueError(f"unknown discovery backend: {backend}")

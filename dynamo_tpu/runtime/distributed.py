@@ -37,12 +37,16 @@ class DistributedRuntime:
             path=self.config.discovery_path,
             ttl_s=self.config.lease_ttl_s,
             cluster_id=cluster_id,
+            etcd_endpoint=self.config.etcd_endpoint,
         )
         ep_kind = self.config.event_plane
         if ep_kind == "auto":
-            ep_kind = "zmq" if self.config.discovery_backend == "file" else "inproc"
+            # multi-process discovery backends need a cross-process bus
+            ep_kind = ("zmq" if self.config.discovery_backend
+                       in ("file", "etcd") else "inproc")
         self.event_plane: EventPlane = make_event_plane(
-            ep_kind, self.discovery, cluster_id
+            ep_kind, self.discovery, cluster_id,
+            host=self.config.zmq_host or self.config.tcp_host,
         )
         self.request_server = RequestPlaneServer(
             self.config.tcp_host, self.config.tcp_port,

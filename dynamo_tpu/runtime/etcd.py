@@ -1,0 +1,286 @@
+"""etcd discovery backend: lease-scoped KV with prefix watch over the
+etcd v3 JSON gateway.
+
+Ref: lib/runtime/src/discovery/kv_store.rs — the reference's production
+discovery is an etcd client holding one lease per runtime (primary lease),
+putting instance/MDC keys bound to it, and prefix-watching with delete
+events on lease expiry.  Same shape here, speaking the grpc-gateway JSON
+endpoints (`/v3/kv/*`, `/v3/lease/*`, `/v3/watch`) over aiohttp so no gRPC
+stack is required:
+
+  * one lease per backend instance, granted at start, kept alive at ttl/3
+  * put(lease=True) binds the key to it; crash -> etcd expires the lease
+    -> watchers see deletes (the failure-detection primitive)
+  * watch = range snapshot (puts) + streaming watch from the snapshot
+    revision; reconnects diff against the last known state so consumers
+    never miss a delete across a gap
+
+Select with DYN_DISCOVERY_BACKEND=etcd DYN_ETCD_ENDPOINT=http://host:2379.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+from typing import Any, AsyncIterator, Dict, Optional
+
+from .discovery import DiscoveryBackend, WatchEvent, diff_snapshot
+
+logger = logging.getLogger(__name__)
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def prefix_range_end(prefix: bytes) -> bytes:
+    """etcd range_end for a prefix scan: prefix with its last byte
+    incremented (carrying over 0xff bytes, per etcd semantics)."""
+    b = bytearray(prefix)
+    while b:
+        if b[-1] < 0xFF:
+            b[-1] += 1
+            return bytes(b)
+        b.pop()
+    return b"\0"  # whole keyspace
+
+
+class EtcdDiscovery(DiscoveryBackend):
+    def __init__(self, endpoint: str = "http://127.0.0.1:2379",
+                 ttl_s: float = 5.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.ttl_s = max(ttl_s, 1.0)  # etcd grants integer-second TTLs
+        self.lease_id: Optional[int] = None
+        self._session = None
+        self._ka_task: Optional[asyncio.Task] = None
+        self._closed = asyncio.Event()
+        self._start_lock = asyncio.Lock()
+        # leased key -> last value, so an expired lease (partition longer
+        # than TTL) can re-register everything under a fresh lease
+        self._owned: Dict[str, Dict[str, Any]] = {}
+
+    # -- transport --------------------------------------------------------
+
+    def _http(self):
+        import aiohttp
+
+        if self._closed.is_set():
+            # a watch generator outliving close() must not resurrect the
+            # session (it would never be closed) — fail its retry loop
+            raise RuntimeError("EtcdDiscovery is closed")
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30)
+            )
+        return self._session
+
+    async def _call(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        async with self._http().post(f"{self.endpoint}{path}",
+                                     json=body) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    # -- lease ------------------------------------------------------------
+
+    async def start(self) -> None:
+        async with self._start_lock:  # concurrent first puts race here
+            if self.lease_id is not None:
+                return
+            out = await self._call("/v3/lease/grant",
+                                   {"TTL": int(round(self.ttl_s)), "ID": 0})
+            self.lease_id = int(out["ID"])
+            if self._ka_task is None:
+                self._ka_task = asyncio.create_task(self._keepalive_loop())
+
+    async def _keepalive_loop(self) -> None:
+        """One keepalive POST per ttl/3.  The gateway answers TTL=0 for an
+        expired lease — detect it and re-register (a partition longer than
+        the TTL otherwise leaves a healthy worker permanently invisible)."""
+        interval = self.ttl_s / 3.0
+        while not self._closed.is_set():
+            try:
+                await asyncio.wait_for(self._closed.wait(), timeout=interval)
+                return
+            except asyncio.TimeoutError:
+                pass
+            try:
+                async with self._http().post(
+                    f"{self.endpoint}/v3/lease/keepalive",
+                    json={"ID": self.lease_id},
+                ) as resp:
+                    body = await resp.json()
+                expired = int((body.get("result") or {}).get("TTL", 0)) <= 0
+            except Exception as e:  # noqa: BLE001 — keepalive must survive
+                logger.warning("etcd keepalive failed: %s", e)
+                continue
+            if expired:
+                logger.warning("etcd lease %s expired; re-registering %d "
+                               "keys under a fresh lease", self.lease_id,
+                               len(self._owned))
+                try:
+                    await self._reregister()
+                except Exception as e:  # noqa: BLE001 — retry next tick
+                    logger.warning("etcd re-register failed: %s", e)
+
+    async def _reregister(self) -> None:
+        out = await self._call("/v3/lease/grant",
+                               {"TTL": int(round(self.ttl_s)), "ID": 0})
+        self.lease_id = int(out["ID"])
+        for key, value in list(self._owned.items()):
+            await self._call("/v3/kv/put", {
+                "key": _b64(key.encode()),
+                "value": _b64(json.dumps(value).encode()),
+                "lease": self.lease_id,
+            })
+
+    # -- kv ---------------------------------------------------------------
+
+    async def put(self, key: str, value: Dict[str, Any],
+                  lease: bool = True) -> None:
+        await self.start()
+        body = {
+            "key": _b64(key.encode()),
+            "value": _b64(json.dumps(value).encode()),
+        }
+        if lease:
+            body["lease"] = self.lease_id
+            self._owned[key] = value
+        await self._call("/v3/kv/put", body)
+
+    async def delete(self, key: str) -> None:
+        self._owned.pop(key, None)
+        await self._call("/v3/kv/deleterange", {"key": _b64(key.encode())})
+
+    async def _range(self, prefix: str):
+        out = await self._call("/v3/kv/range", {
+            "key": _b64(prefix.encode()),
+            "range_end": _b64(prefix_range_end(prefix.encode())),
+        })
+        kvs = {}
+        for kv in out.get("kvs", []) or []:
+            try:
+                kvs[_unb64(kv["key"]).decode()] = json.loads(
+                    _unb64(kv.get("value", "")).decode() or "null")
+            except (ValueError, KeyError):
+                continue
+        revision = int(out.get("header", {}).get("revision", 0))
+        return kvs, revision
+
+    async def get_prefix(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        kvs, _ = await self._range(prefix)
+        return kvs
+
+    # -- watch ------------------------------------------------------------
+
+    async def watch(
+        self, prefix: str, cancel: Optional[asyncio.Event] = None
+    ) -> AsyncIterator[WatchEvent]:
+        from .aio import iter_queue
+
+        q: asyncio.Queue = asyncio.Queue()
+        stop = asyncio.Event()
+        known: Dict[str, str] = {}
+
+        async def stream_loop() -> None:
+            backoff = 0.1
+            while not stop.is_set():
+                try:
+                    kvs, revision = await self._range(prefix)
+                    # snapshot diff: puts for new/changed, deletes for
+                    # keys that vanished during a stream gap
+                    diff_snapshot(known, kvs, q.put_nowait)
+                    body = {"create_request": {
+                        "key": _b64(prefix.encode()),
+                        "range_end": _b64(prefix_range_end(prefix.encode())),
+                        "start_revision": revision + 1,
+                    }}
+                    async with self._http().post(
+                        f"{self.endpoint}/v3/watch", json=body,
+                        timeout=self._aiohttp_stream_timeout(),
+                    ) as resp:
+                        resp.raise_for_status()
+                        backoff = 0.1
+                        async for line in resp.content:
+                            if stop.is_set():
+                                return
+                            line = line.strip()
+                            if not line:
+                                continue
+                            self._handle_watch_chunk(line, known, q)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — reconnect
+                    if stop.is_set() or self._closed.is_set():
+                        return
+                    logger.warning("etcd watch stream error (%s); "
+                                   "reconnecting in %.1fs", e, backoff)
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 5.0)
+
+        task = asyncio.create_task(stream_loop())
+        try:
+            async for ev in iter_queue(q, cancel):
+                yield ev
+        finally:
+            stop.set()
+            task.cancel()
+
+    def _aiohttp_stream_timeout(self):
+        import aiohttp
+
+        # watch streams are long-lived: no total timeout, generous read
+        return aiohttp.ClientTimeout(total=None, sock_read=None)
+
+    @staticmethod
+    def _handle_watch_chunk(line: bytes, known: Dict[str, str],
+                            q: asyncio.Queue) -> None:
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            return
+        result = msg.get("result") or {}
+        for ev in result.get("events", []) or []:
+            kv = ev.get("kv") or {}
+            try:
+                key = _unb64(kv["key"]).decode()
+            except (KeyError, ValueError):
+                continue
+            if ev.get("type") == "DELETE":
+                known.pop(key, None)
+                q.put_nowait(WatchEvent("delete", key))
+            else:  # PUT (etcd omits the type for PUT, its zero value)
+                try:
+                    value = json.loads(_unb64(kv.get("value", "")).decode())
+                except ValueError:
+                    continue
+                known[key] = json.dumps(value, sort_keys=True)
+                q.put_nowait(WatchEvent("put", key, value))
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def revoke_lease(self) -> None:
+        if self.lease_id is not None:
+            try:
+                await self._call("/v3/lease/revoke", {"ID": self.lease_id})
+            except Exception as e:  # noqa: BLE001 — best-effort on shutdown
+                logger.warning("etcd lease revoke failed: %s", e)
+            self.lease_id = None
+        self._owned.clear()
+
+    async def close(self) -> None:
+        if self._ka_task is not None:
+            self._ka_task.cancel()
+            self._ka_task = None
+        # revoke BEFORE flagging closed: _http() refuses new sessions once
+        # _closed is set, and the revoke is the last legitimate call
+        await self.revoke_lease()
+        self._closed.set()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+            self._session = None

@@ -164,9 +164,12 @@ class ZmqEventPlane(EventPlane):
 
 
 def make_event_plane(kind: str, discovery: DiscoveryBackend,
-                     cluster_id: str = "default") -> EventPlane:
+                     cluster_id: str = "default",
+                     host: str = "") -> EventPlane:
     if kind == "inproc":
         return InProcEventPlane(cluster_id)
     if kind == "zmq":
-        return ZmqEventPlane(discovery)
+        # host is the ADVERTISED bind address: must be reachable from other
+        # hosts when discovery spans hosts (etcd), not loopback
+        return ZmqEventPlane(discovery, host=host or "127.0.0.1")
     raise ValueError(f"unknown event plane: {kind}")

@@ -1,0 +1,198 @@
+"""In-process fake of the etcd v3 JSON gateway, for EtcdDiscovery tests.
+
+Implements the subset the backend speaks — kv put/range/deleterange,
+lease grant/keepalive/revoke with real TTL expiry, and streaming watch —
+with etcd's wire conventions (base64 keys/values, revision counter,
+DELETE/PUT event types, lease expiry deleting bound keys and notifying
+watchers).  Runs on an ephemeral localhost port via aiohttp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+class FakeEtcd:
+    def __init__(self, expiry_poll_s: float = 0.1):
+        # key -> (value_bytes, lease_id or None)
+        self.kv: Dict[bytes, Tuple[bytes, Optional[int]]] = {}
+        # lease_id -> (ttl_s, deadline)
+        self.leases: Dict[int, Tuple[float, float]] = {}
+        self.revision = 1
+        self._next_lease = 1000
+        self.watchers: List[Tuple[bytes, bytes, asyncio.Queue]] = []
+        self.expiry_poll_s = expiry_poll_s
+        self._runner = None
+        self.port: Optional[int] = None
+        self._expiry_task: Optional[asyncio.Task] = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> "FakeEtcd":
+        app = web.Application()
+        app.router.add_post("/v3/lease/grant", self._lease_grant)
+        app.router.add_post("/v3/lease/keepalive", self._lease_keepalive)
+        app.router.add_post("/v3/lease/revoke", self._lease_revoke)
+        app.router.add_post("/v3/kv/put", self._kv_put)
+        app.router.add_post("/v3/kv/range", self._kv_range)
+        app.router.add_post("/v3/kv/deleterange", self._kv_deleterange)
+        app.router.add_post("/v3/watch", self._watch)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._expiry_task = asyncio.create_task(self._expiry_loop())
+        return self
+
+    async def close(self) -> None:
+        if self._expiry_task is not None:
+            self._expiry_task.cancel()
+            self._expiry_task = None
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- internals --------------------------------------------------------
+
+    def _notify(self, ev_type: str, key: bytes,
+                value: bytes = b"") -> None:
+        self.revision += 1
+        ev = {"kv": {"key": _b64(key),
+                     "mod_revision": str(self.revision)}}
+        if ev_type == "DELETE":
+            ev["type"] = "DELETE"
+        else:
+            ev["kv"]["value"] = _b64(value)
+        for start, end, q in list(self.watchers):
+            if start <= key < end:
+                q.put_nowait(ev)
+
+    async def _expiry_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.expiry_poll_s)
+            now = time.monotonic()
+            for lid, (_ttl, deadline) in list(self.leases.items()):
+                if now > deadline:
+                    self._drop_lease(lid)
+
+    def _drop_lease(self, lid: int) -> None:
+        self.leases.pop(lid, None)
+        for key, (_v, key_lid) in list(self.kv.items()):
+            if key_lid == lid:
+                del self.kv[key]
+                self._notify("DELETE", key)
+
+    # -- handlers ---------------------------------------------------------
+
+    async def _lease_grant(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        ttl = float(body.get("TTL", 5))
+        self._next_lease += 1
+        lid = self._next_lease
+        self.leases[lid] = (ttl, time.monotonic() + ttl)
+        return web.json_response({"ID": str(lid), "TTL": str(int(ttl))})
+
+    async def _lease_keepalive(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        lid = int(body.get("ID", 0))
+        if lid in self.leases:
+            ttl = self.leases[lid][0]
+            self.leases[lid] = (ttl, time.monotonic() + ttl)
+            out = {"result": {"ID": str(lid), "TTL": str(int(ttl))}}
+        else:
+            out = {"result": {"ID": str(lid), "TTL": "0"}}  # expired
+        return web.json_response(out)
+
+    async def _lease_revoke(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self._drop_lease(int(body.get("ID", 0)))
+        return web.json_response({})
+
+    async def _kv_put(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        key = _unb64(body["key"])
+        value = _unb64(body.get("value", ""))
+        lease = int(body["lease"]) if body.get("lease") else None
+        if lease is not None and lease not in self.leases:
+            return web.json_response(
+                {"error": "lease not found", "code": 5}, status=400)
+        self.kv[key] = (value, lease)
+        self._notify("PUT", key, value)
+        return web.json_response(
+            {"header": {"revision": str(self.revision)}})
+
+    def _select(self, body: dict) -> List[bytes]:
+        key = _unb64(body["key"])
+        if body.get("range_end"):
+            end = _unb64(body["range_end"])
+            return [k for k in self.kv if key <= k < end]
+        return [k for k in self.kv if k == key]
+
+    async def _kv_range(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        keys = sorted(self._select(body))
+        return web.json_response({
+            "header": {"revision": str(self.revision)},
+            "kvs": [{"key": _b64(k), "value": _b64(self.kv[k][0])}
+                    for k in keys],
+            "count": str(len(keys)),
+        })
+
+    async def _kv_deleterange(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        keys = self._select(body)
+        for k in keys:
+            del self.kv[k]
+            self._notify("DELETE", k)
+        return web.json_response({
+            "header": {"revision": str(self.revision)},
+            "deleted": str(len(keys)),
+        })
+
+    async def _watch(self, req: web.Request) -> web.StreamResponse:
+        body = await req.json()
+        cr = body.get("create_request", {})
+        start = _unb64(cr["key"])
+        end = _unb64(cr["range_end"]) if cr.get("range_end") \
+            else start + b"\0"
+        q: asyncio.Queue = asyncio.Queue()
+        ent = (start, end, q)
+        self.watchers.append(ent)
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(req)
+        try:
+            # the gateway acks watch creation first
+            await resp.write(json.dumps(
+                {"result": {"created": True}}).encode() + b"\n")
+            while True:
+                ev = await q.get()
+                await resp.write(json.dumps(
+                    {"result": {"events": [ev]}}).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                self.watchers.remove(ent)
+            except ValueError:
+                pass
+        return resp
