@@ -71,26 +71,37 @@ class KvLayout:
     dtype: str
     tp: int = 1
     dp: int = 1
+    # MLA engines cache an asymmetric pair (latent R vs rope-key dr,
+    # models/deepseek.py) — 0 means "v matches k" (the GQA case)
+    head_dim_v: int = 0
+
+    @property
+    def hd_v(self) -> int:
+        return self.head_dim_v or self.head_dim
 
     def to_dict(self) -> Dict[str, Any]:
         return {
             "num_layers": self.num_layers, "num_blocks": self.num_blocks,
             "block_size": self.block_size, "kv_heads": self.kv_heads,
             "head_dim": self.head_dim, "dtype": self.dtype,
-            "tp": self.tp, "dp": self.dp,
+            "tp": self.tp, "dp": self.dp, "head_dim_v": self.head_dim_v,
         }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "KvLayout":
         return cls(**{k: d[k] for k in (
             "num_layers", "num_blocks", "block_size", "kv_heads",
-            "head_dim", "dtype")}, tp=d.get("tp", 1), dp=d.get("dp", 1))
+            "head_dim", "dtype")}, tp=d.get("tp", 1), dp=d.get("dp", 1),
+            head_dim_v=d.get("head_dim_v", 0))
 
     @classmethod
-    def of(cls, k: np.ndarray, tp: int = 1, dp: int = 1) -> "KvLayout":
+    def of(cls, k: np.ndarray, tp: int = 1, dp: int = 1,
+           v: Optional[np.ndarray] = None) -> "KvLayout":
         L, nb, bs, nkv, hd = k.shape
+        hd_v = v.shape[4] if v is not None and v.shape[4] != hd else 0
         return cls(num_layers=L, num_blocks=nb, block_size=bs, kv_heads=nkv,
-                   head_dim=hd, dtype=k.dtype.name, tp=tp, dp=dp)
+                   head_dim=hd, dtype=k.dtype.name, tp=tp, dp=dp,
+                   head_dim_v=hd_v)
 
     def check_compatible(self, other: "KvLayout") -> None:
         """Logical-geometry contract check (tp/dp intentionally excluded)."""
@@ -102,6 +113,11 @@ class KvLayout:
                     f"incompatible KV layout: {f} is {a} on the sender but "
                     f"{b} on the receiver"
                 )
+        if self.hd_v != other.hd_v:
+            raise ValueError(
+                f"incompatible KV layout: head_dim_v is {self.hd_v} on the "
+                f"sender but {other.hd_v} on the receiver"
+            )
 
 
 @dataclass
@@ -128,11 +144,12 @@ def iter_chunks(
     Slabs never span layers (keeps indexing trivial); within a layer the
     block axis is split so that k-bytes + v-bytes <= max_bytes (a single
     block larger than max_bytes still goes out whole — the bound is a
-    target, the frame cap is the hard limit)."""
-    assert k.shape == v.shape and k.dtype == v.dtype
+    target, the frame cap is the hard limit).  k and v may differ in their
+    last (head_dim) axis — the MLA latent/rope-key pair."""
+    assert k.shape[:4] == v.shape[:4] and k.dtype == v.dtype
     L, nb = k.shape[0], k.shape[1]
-    block_bytes = int(k[0, :1].nbytes) if nb else 0
-    per = max(1, max_bytes // max(1, 2 * block_bytes))
+    pair_bytes = (int(k[0, :1].nbytes) + int(v[0, :1].nbytes)) if nb else 0
+    per = max(1, max_bytes // max(1, pair_bytes))
     for layer in range(L):
         for b0 in range(0, nb, per):
             b1 = min(nb, b0 + per)
@@ -167,11 +184,11 @@ class ChunkAssembler:
                 f"the receiver's limit of {max_blocks}"
             )
         lo = self.layout
-        shape = (lo.num_layers, lo.num_blocks, lo.block_size, lo.kv_heads,
-                 lo.head_dim)
         dt = _np_dtype(lo.dtype)
-        self.k = np.zeros(shape, dt)
-        self.v = np.zeros(shape, dt)
+        self.k = np.zeros((lo.num_layers, lo.num_blocks, lo.block_size,
+                           lo.kv_heads, lo.head_dim), dt)
+        self.v = np.zeros((lo.num_layers, lo.num_blocks, lo.block_size,
+                           lo.kv_heads, lo.hd_v), dt)
         self._filled = np.zeros((lo.num_layers, lo.num_blocks), bool)
 
     def add(self, frame: Dict[str, Any]) -> None:
@@ -183,12 +200,13 @@ class ChunkAssembler:
                 b0 + n <= lo.num_blocks):
             raise ValueError(f"chunk out of bounds: layer={layer} "
                              f"blocks=[{b0},{b0 + n})")
-        shape = (n, lo.block_size, lo.kv_heads, lo.head_dim)
         dt = _np_dtype(lo.dtype)
         self.k[layer, b0:b0 + n] = np.frombuffer(
-            frame["k"], dtype=dt).reshape(shape)
+            frame["k"], dtype=dt).reshape(
+                (n, lo.block_size, lo.kv_heads, lo.head_dim))
         self.v[layer, b0:b0 + n] = np.frombuffer(
-            frame["v"], dtype=dt).reshape(shape)
+            frame["v"], dtype=dt).reshape(
+                (n, lo.block_size, lo.kv_heads, lo.hd_v))
         self._filled[layer, b0:b0 + n] = True
 
     def finish(self) -> KvBlockPayload:

@@ -6,14 +6,14 @@ from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
 from ..disagg.transfer import DEFAULT_CHUNK_BYTES
-from ..models.llama import PRESETS, LlamaConfig
+from ..models import PRESETS
 from ..parallel.mesh import MeshConfig
 
 
 @dataclass
 class EngineConfig:
-    model: str = "tiny"  # preset name (models/llama.py PRESETS)
-    model_config: Optional[LlamaConfig] = None
+    model: str = "tiny"  # preset name (models.PRESETS, all families)
+    model_config: Optional[object] = None  # LlamaConfig | DeepseekConfig
     model_name: str = ""  # served model name; defaults to preset name
     # local HF checkpoint dir (config.json + *.safetensors + tokenizer);
     # when set it overrides `model` and the engine serves real weights
@@ -87,7 +87,7 @@ class EngineConfig:
     eos_token_id: Optional[int] = None
     seed: int = 0
 
-    def resolve_model(self) -> LlamaConfig:
+    def resolve_model(self):
         if self.model_config is not None:
             return self.model_config
         if self.model_path:

@@ -35,8 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models import llama
-from ..parallel.mesh import MeshConfig, kv_cache_spec, make_mesh, shard_params
+from ..models import get_family
+from ..parallel.mesh import MeshConfig, make_mesh, shard_params
 from ..protocols import LLMEngineOutput, PreprocessedRequest
 from ..tokens import TokenBlockSequence
 from .block_allocator import BlockAllocator
@@ -132,6 +132,7 @@ class JaxEngine:
         and decode steps (followers require kvbm/disagg off)."""
         self.config = config
         self.model_cfg = config.resolve_model()
+        self.family = get_family(self.model_cfg)
         self.mesh = mesh if mesh is not None else make_mesh(
             MeshConfig(dp=config.dp, tp=config.tp)
         )
@@ -194,7 +195,7 @@ class JaxEngine:
                 )
             else:
                 if params is None:
-                    params = llama.init_params(
+                    params = self.family.init_params(
                         self.model_cfg, jax.random.PRNGKey(config.seed)
                     )
                 self.params = shard_params(params, self.mesh)
@@ -205,16 +206,18 @@ class JaxEngine:
         # large vocabs even top-k-capped)
         self._jit_decode = {
             g: jax.jit(
-                partial(self._decode_impl, self.model_cfg, self.mesh, g),
+                partial(self._decode_impl, self.family, self.model_cfg,
+                        self.mesh, g),
                 donate_argnums=(1,),
             )
             for g in (False, True)
         }
         self._jit_prefill = jax.jit(
-            partial(self._prefill_impl, self.model_cfg), donate_argnums=(1,)
+            partial(self._prefill_impl, self.family, self.model_cfg),
+            donate_argnums=(1,),
         )
         self._jit_prefill_batched = jax.jit(
-            partial(self._prefill_batched_impl, self.model_cfg),
+            partial(self._prefill_batched_impl, self.family, self.model_cfg),
             donate_argnums=(1,),
         )
         self._jit_inject = jax.jit(self._inject_impl, donate_argnums=(0,))
@@ -223,8 +226,9 @@ class JaxEngine:
         if config.decode_fused_steps > 1:
             self._jit_decode_multi = {
                 g: jax.jit(
-                    partial(self._decode_multi_impl, self.model_cfg,
-                            self.mesh, g, config.decode_fused_steps),
+                    partial(self._decode_multi_impl, self.family,
+                            self.model_cfg, self.mesh, g,
+                            config.decode_fused_steps),
                     donate_argnums=(1,),
                 )
                 for g in (False, True)
@@ -260,27 +264,29 @@ class JaxEngine:
     def _init_kv_cache(self):
         m = self.model_cfg
         c = self.config
-        # head-major transposed block layout (ops/paged_attention.py)
-        shape = (m.n_layers, m.n_kv_heads, c.num_blocks, m.head_dim,
-                 c.block_size)
-        sharding = NamedSharding(self.mesh, kv_cache_spec())
-        zeros = partial(jnp.zeros, shape, m.dtype)
-        k = jax.jit(zeros, out_shardings=sharding)()
-        v = jax.jit(zeros, out_shardings=sharding)()
+        # family-owned layout: GQA (k, v) or MLA (latent, rope-key) pair,
+        # both in the head-major transposed block layout
+        k_shape, v_shape = self.family.kv_cache_shapes(
+            m, c.num_blocks, c.block_size)
+        k_spec, v_spec = self.family.kv_cache_specs()
+        k = jax.jit(partial(jnp.zeros, k_shape, m.dtype),
+                    out_shardings=NamedSharding(self.mesh, k_spec))()
+        v = jax.jit(partial(jnp.zeros, v_shape, m.dtype),
+                    out_shardings=NamedSharding(self.mesh, v_spec))()
         return (k, v)
 
     # -- jitted programs --------------------------------------------------
     @staticmethod
-    def _decode_impl(model_cfg, mesh, greedy, params, kv, chain, use_chain,
-                     tokens, positions, block_tables, ctx_lens, seeds,
-                     steps, temps, top_ks, top_ps, valid):
+    def _decode_impl(family, model_cfg, mesh, greedy, params, kv, chain,
+                     use_chain, tokens, positions, block_tables, ctx_lens,
+                     seeds, steps, temps, top_ks, top_ps, valid):
         """chain/use_chain: device-resident token chaining — lanes whose
         previous burst is still unread take their input token from the
         prior burst's on-device output instead of a host round-trip.
         `greedy` is a static specialization: an all-greedy batch skips the
         sampling machinery (sampler.py greedy_tokens)."""
         tokens = jnp.where(use_chain, chain, tokens)
-        logits, kv = llama.decode(
+        logits, kv = family.decode(
             params, model_cfg, kv, tokens, positions, block_tables,
             ctx_lens, valid=valid, mesh=mesh,
         )
@@ -292,13 +298,13 @@ class JaxEngine:
         return next_tokens[None], kv  # [1, B]: burst-shaped like multi
 
     @staticmethod
-    def _decode_multi_impl(model_cfg, mesh, greedy, num_steps, params, kv,
-                           chain, use_chain, tokens, positions,
+    def _decode_multi_impl(family, model_cfg, mesh, greedy, num_steps,
+                           params, kv, chain, use_chain, tokens, positions,
                            block_tables, ctx_lens, seeds, steps, temps,
                            top_ks, top_ps, valid):
-        """num_steps fused decode steps (models/llama.py decode_multi);
-        sampling streams stay per-token identical to the single-step path
-        (seed folded with the running step counter)."""
+        """num_steps fused decode steps (family decode_multi); sampling
+        streams stay per-token identical to the single-step path (seed
+        folded with the running step counter)."""
         tokens = jnp.where(use_chain, chain, tokens)
         if greedy:
             sample_fn = None  # decode_multi defaults to argmax
@@ -307,7 +313,7 @@ class JaxEngine:
                 return sample_tokens(logits, seeds, steps + step_idx,
                                      temps, top_ks, top_ps)
 
-        return llama.decode_multi(
+        return family.decode_multi(
             params, model_cfg, kv, tokens, positions, block_tables,
             ctx_lens, num_steps, sample_fn, valid=valid, mesh=mesh,
         )
@@ -341,9 +347,10 @@ class JaxEngine:
         return kb, vb
 
     @staticmethod
-    def _prefill_impl(model_cfg, params, kv, tokens, positions, block_table,
-                      ctx_len, true_len, seed, temp, top_k, top_p):
-        logits, kv = llama.prefill(
+    def _prefill_impl(family, model_cfg, params, kv, tokens, positions,
+                      block_table, ctx_len, true_len, seed, temp, top_k,
+                      top_p):
+        logits, kv = family.prefill(
             params, model_cfg, kv, tokens, positions, block_table,
             ctx_len, true_len,
         )
@@ -354,14 +361,14 @@ class JaxEngine:
         return tok, kv
 
     @staticmethod
-    def _prefill_batched_impl(model_cfg, params, kv, toks, positions,
-                              tables, ctx_lens, true_lens, seeds, temps,
-                              top_ks, top_ps):
-        """Multi-sequence chunked prefill (models/llama.py prefill_batched):
+    def _prefill_batched_impl(family, model_cfg, params, kv, toks,
+                              positions, tables, ctx_lens, true_lens,
+                              seeds, temps, top_ks, top_ps):
+        """Multi-sequence chunked prefill (family prefill_batched):
         concurrent arrivals share one program instead of serializing B=1
         chunks.  First tokens are sampled per row; rows whose prompt is not
         finished this chunk have their sample discarded by the host."""
-        logits, kv = llama.prefill_batched(
+        logits, kv = family.prefill_batched(
             params, model_cfg, kv, toks, positions, tables,
             ctx_lens, true_lens,
         )

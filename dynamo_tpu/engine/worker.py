@@ -224,7 +224,8 @@ class JaxEngineWorker:
 
             rid = payload["request_id"]
             k, v, prompt_len = await self.engine.extract_parked_kv(rid)
-            layout = KvLayout.of(k, tp=self.config.tp, dp=self.config.dp)
+            layout = KvLayout.of(k, tp=self.config.tp, dp=self.config.dp,
+                                 v=v)
             yield make_header(prompt_len, layout)
             for frame in iter_chunks(k, v,
                                      self.config.transfer_chunk_bytes):
@@ -342,10 +343,17 @@ class JaxEngineWorker:
             await client.wait_for_instances()
             self._pull_clients[key] = client
         m = self.config.resolve_model()
+        # geometry from this engine's OWN cache arrays ([L, nkv, nb, hd,
+        # bs] head-major layout) — family-agnostic: GQA k==v shapes, MLA
+        # latent/rope-key pair with different head dims
+        k_cache, v_cache = self.engine.kv
         expect = KvLayout(
             num_layers=m.n_layers, num_blocks=0,
-            block_size=self.config.block_size, kv_heads=m.n_kv_heads,
-            head_dim=m.head_dim, dtype=np.dtype(m.dtype).name,
+            block_size=self.config.block_size,
+            kv_heads=k_cache.shape[1],
+            head_dim=k_cache.shape[3], dtype=np.dtype(m.dtype).name,
+            head_dim_v=(v_cache.shape[3]
+                        if v_cache.shape[3] != k_cache.shape[3] else 0),
         )
         asm = None
         async for item in client.generate(

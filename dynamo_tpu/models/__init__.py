@@ -1,3 +1,37 @@
-from .llama import LlamaConfig, init_params, PRESETS
+"""Model families, uniform functional contract per family module:
 
-__all__ = ["LlamaConfig", "init_params", "PRESETS"]
+    init_params(cfg, key)            parameter pytree
+    prefill / prefill_batched        chunked prompt over the paged cache
+    decode / decode_multi            batched token steps
+    kv_cache_shapes(cfg, nb, bs)     (k-like, v-like) cache shapes
+    kv_cache_specs()                 (k, v) PartitionSpecs under the mesh
+    PRESETS                          name -> config
+
+The engine binds a family once via get_family(cfg) and never branches on
+architecture again — Llama/Qwen/Mixtral (llama.py, GQA cache) and the
+DeepSeek MLA family (deepseek.py, latent cache) serve through identical
+plumbing."""
+
+from . import deepseek, llama
+from .deepseek import DeepseekConfig
+from .llama import LlamaConfig, init_params
+
+PRESETS = {**llama.PRESETS, **deepseek.PRESETS}
+
+
+def get_family(cfg):
+    """Model-family module for a config instance."""
+    if isinstance(cfg, DeepseekConfig):
+        return deepseek
+    if isinstance(cfg, LlamaConfig):
+        return llama
+    raise TypeError(f"unknown model config type: {type(cfg).__name__}")
+
+
+__all__ = [
+    "DeepseekConfig",
+    "LlamaConfig",
+    "PRESETS",
+    "get_family",
+    "init_params",
+]

@@ -78,6 +78,22 @@ class LlamaConfig:
         return self.n_kv_heads * self.head_dim
 
 
+def kv_cache_shapes(cfg: "LlamaConfig", num_blocks: int,
+                    block_size: int) -> tuple:
+    """(k, v) cache shapes in the head-major transposed block layout
+    (ops/paged_attention.py)."""
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_blocks, cfg.head_dim,
+             block_size)
+    return shape, shape
+
+
+def kv_cache_specs() -> tuple:
+    """kv_heads sharded over tp (parallel/mesh.py kv_cache_spec)."""
+    from ..parallel.mesh import kv_cache_spec
+
+    return kv_cache_spec(), kv_cache_spec()
+
+
 PRESETS: Dict[str, LlamaConfig] = {
     # test-scale
     "tiny": LlamaConfig(),
@@ -230,17 +246,18 @@ def _moe_router(layer, cfg: LlamaConfig, x: jax.Array):
     return jax.nn.softmax(top_w, axis=-1), top_e
 
 
-def _moe_mlp_dense(layer, cfg: LlamaConfig, x: jax.Array,
-                   valid: Optional[jax.Array] = None) -> jax.Array:
-    """Dropless masked-dense MoE: all experts compute all tokens, the
-    router matrix masks the combine.  Batch-invariant by construction.
+def moe_dispatch_dense(layer, cfg: LlamaConfig, x: jax.Array,
+                       top_w: jax.Array, top_e: jax.Array,
+                       valid: Optional[jax.Array] = None) -> jax.Array:
+    """Dropless masked-dense MoE dispatch for precomputed routing
+    (top_w/top_e [T, k]): all experts compute all tokens, the router
+    matrix masks the combine.  Batch-invariant by construction.
 
     With experts sharded over tp, the expert einsums run local to each
     shard and the final combine reduces over the expert axis (one psum on
     the way out) — no dispatch tensors, no all-to-all."""
     T, d = x.shape
     E = cfg.n_experts
-    top_w, top_e = _moe_router(layer, cfg, x)
     wmat = jnp.zeros((T, E), jnp.float32).at[
         jnp.arange(T)[:, None], top_e
     ].set(top_w)                                       # [T, E]
@@ -252,9 +269,17 @@ def _moe_mlp_dense(layer, cfg: LlamaConfig, x: jax.Array,
     return jnp.einsum("etd,te->td", eout, wmat.astype(cfg.dtype))
 
 
-def _moe_mlp(layer, cfg: LlamaConfig, x: jax.Array,
-             valid: Optional[jax.Array] = None) -> jax.Array:
-    """Top-k routed expert MLP, GShard capacity-dispatch formulation.
+def _moe_mlp_dense(layer, cfg: LlamaConfig, x: jax.Array,
+                   valid: Optional[jax.Array] = None) -> jax.Array:
+    top_w, top_e = _moe_router(layer, cfg, x)
+    return moe_dispatch_dense(layer, cfg, x, top_w, top_e, valid)
+
+
+def moe_dispatch_capacity(layer, cfg: LlamaConfig, x: jax.Array,
+                          top_w: jax.Array, top_e: jax.Array,
+                          valid: Optional[jax.Array] = None) -> jax.Array:
+    """Top-k routed expert MLP for precomputed routing, GShard
+    capacity-dispatch formulation.
 
     x [T, d] -> [T, d].  Every step is a static-shape einsum so GSPMD can
     shard the expert axis (EP over the "tp" mesh axis via the moe_w_* rules
@@ -272,7 +297,6 @@ def _moe_mlp(layer, cfg: LlamaConfig, x: jax.Array,
     E, k = cfg.n_experts, cfg.experts_per_token
     C = max(1, math.ceil(T * k / E * cfg.moe_capacity_factor))
 
-    top_w, top_e = _moe_router(layer, cfg, x)          # [T, k]
     e_flat = top_e.reshape(-1)                         # [T*k]
     w_flat = top_w.reshape(-1)
     onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)       # [Tk, E]
@@ -296,6 +320,12 @@ def _moe_mlp(layer, cfg: LlamaConfig, x: jax.Array,
     eout = jnp.einsum("ecf,efd->ecd", h, layer["moe_w_down"])
     out = jnp.einsum("sec,ecd->sd", comb.astype(cfg.dtype), eout)
     return out.reshape(T, k, d).sum(axis=1)
+
+
+def _moe_mlp(layer, cfg: LlamaConfig, x: jax.Array,
+             valid: Optional[jax.Array] = None) -> jax.Array:
+    top_w, top_e = _moe_router(layer, cfg, x)
+    return moe_dispatch_capacity(layer, cfg, x, top_w, top_e, valid)
 
 
 def _ffn(layer, cfg: LlamaConfig, x: jax.Array,

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.mesh import param_sharding_rules
+from .deepseek import DeepseekConfig
 from .llama import LlamaConfig
 
 _ARCHS = {
@@ -46,15 +47,62 @@ _ARCHS = {
     "Qwen3ForCausalLM": {"qk_norm": True},
 }
 
+# MLA family (models/deepseek.py).  V3 routing is sigmoid+bias; V2
+# declares scoring_func in its config.
+_DS_ARCHS = {"DeepseekV2ForCausalLM": "v2", "DeepseekV3ForCausalLM": "v3"}
 
-def load_hf_config(model_path: str, dtype=jnp.bfloat16) -> LlamaConfig:
-    """config.json -> LlamaConfig (dense Llama-family architectures)."""
+
+def _load_deepseek_config(hf: dict, lineage: str, name: str,
+                          dtype) -> DeepseekConfig:
+    eos = hf.get("eos_token_id", 2)
+    eos_ids = tuple(int(e) for e in eos) if isinstance(eos, list) else (
+        (int(eos),) if eos is not None else (2,))
+    scoring = ("sigmoid" if lineage == "v3"
+               else hf.get("scoring_func", "softmax"))
+    return DeepseekConfig(
+        name=name,
+        vocab_size=hf["vocab_size"],
+        d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        q_lora_rank=int(hf.get("q_lora_rank") or 0),
+        kv_lora_rank=int(hf["kv_lora_rank"]),
+        qk_nope_head_dim=int(hf["qk_nope_head_dim"]),
+        qk_rope_head_dim=int(hf["qk_rope_head_dim"]),
+        v_head_dim=int(hf["v_head_dim"]),
+        ffn_dim=hf["intermediate_size"],
+        moe_ffn_dim=int(hf.get("moe_intermediate_size") or 0),
+        n_experts=int(hf.get("n_routed_experts") or 0),
+        experts_per_token=int(hf.get("num_experts_per_tok") or 2),
+        n_shared_experts=int(hf.get("n_shared_experts") or 0),
+        first_k_dense=int(hf.get("first_k_dense_replace") or 0),
+        routed_scaling_factor=float(hf.get("routed_scaling_factor", 1.0)),
+        moe_scoring=scoring,
+        norm_topk_prob=bool(hf.get("norm_topk_prob", lineage == "v3")),
+        n_group=int(hf.get("n_group") or 1),
+        topk_group=int(hf.get("topk_group") or 1),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-6)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        max_context=int(hf.get("max_position_embeddings", 8192)),
+        dtype=dtype,
+        eos_token_ids=eos_ids,
+    )
+
+
+def load_hf_config(model_path: str, dtype=jnp.bfloat16):
+    """config.json -> LlamaConfig / DeepseekConfig by architecture."""
     with open(os.path.join(model_path, "config.json")) as f:
         hf = json.load(f)
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if arch in _DS_ARCHS:
+        name = os.path.basename(os.path.abspath(model_path)) \
+            or hf.get("model_type", "hf-model")
+        return _load_deepseek_config(hf, _DS_ARCHS[arch], name, dtype)
     if arch not in _ARCHS:
         raise ValueError(
-            f"unsupported architecture {arch!r}; have {sorted(_ARCHS)}"
+            f"unsupported architecture {arch!r}; have "
+            f"{sorted(_ARCHS) + sorted(_DS_ARCHS)}"
         )
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // n_heads
@@ -144,12 +192,208 @@ def _iter_safetensors(model_path: str):
                 yield name, f.get_tensor(name)
 
 
+class _ExpertStage:
+    """Streams per-expert tensors into ONE preallocated stacked [E, ...]
+    array per (layer, kind), flushing to `sink(li, key, buf)` when all
+    experts arrived (host RAM peak = one stacked array per in-flight
+    weight kind, not E copies + a stack).  Shared by the Mixtral and
+    DeepSeek loader paths."""
+
+    def __init__(self, n_experts: int, dtype, sink):
+        self.n_experts = n_experts
+        self.dtype = dtype
+        self.sink = sink
+        self._stage: Dict[int, Dict[str, Any]] = {}
+
+    def feed(self, li: int, e: int, key: str, t: np.ndarray) -> None:
+        stage = self._stage.setdefault(li, {})
+        if key not in stage:
+            stage[key] = (np.empty((self.n_experts,) + t.shape, self.dtype),
+                          set())
+        buf, got = stage[key]
+        buf[e] = t
+        got.add(e)
+        if len(got) == self.n_experts:
+            self.sink(li, key, buf)
+            del stage[key]
+
+    def pending(self):
+        """(layer, unfinished keys) pairs for completeness reporting."""
+        return [(li, sorted(parts)) for li, parts in self._stage.items()
+                if parts]
+
+
+def _deinterleave_rope_rows(w: np.ndarray, rope_dim: int) -> np.ndarray:
+    """HF DeepSeek checkpoints store rope output rows INTERLEAVED
+    (modeling's apply_rotary_pos_emb_interleave de-interleaves each head
+    dim at runtime via view(d//2, 2).transpose).  Permuting the weight
+    rows once at load time lets our half-split rope (llama.py) apply
+    directly.  `w` is the rope-row block [rope_dim, ...]."""
+    idx = np.concatenate([np.arange(0, rope_dim, 2),
+                          np.arange(1, rope_dim, 2)])
+    return w[idx]
+
+
+def _load_deepseek_params(model_path: str, cfg: DeepseekConfig,
+                          put) -> Dict[str, Any]:
+    """DeepSeek V2/V3 checkpoint -> deepseek.py params pytree.
+
+    Name mapping (HF Linear is [out, in]; our matmuls transpose):
+
+        self_attn.q_proj | q_a_proj/q_a_layernorm/q_b_proj   wq | wq_a/...
+        self_attn.kv_a_proj_with_mqa      wkv_a  (rope rows de-interleaved)
+        self_attn.kv_a_layernorm          kv_a_norm
+        self_attn.kv_b_proj               w_uk [nh,R,dn] + w_uv [nh,R,dv]
+        self_attn.o_proj                  wo
+        mlp.gate.weight / e_score_correction_bias   moe_gate / moe_gate_bias
+        mlp.experts.E.{gate,up,down}_proj           moe_w_* (stacked [E,..])
+        mlp.shared_experts.{gate,up,down}_proj      shared.w_*
+    """
+    with open(os.path.join(model_path, "config.json")) as f:
+        interleaved = bool(json.load(f).get("rope_interleave", True))
+    R, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+    nh = cfg.n_heads
+    norm_dt = jnp.float32
+
+    def perm_q(t: np.ndarray) -> np.ndarray:
+        """q/q_b rows are [nh * (dn+dr), in]; de-interleave each head's
+        rope block."""
+        if not interleaved:
+            return t
+        t = t.reshape(nh, dn + dr, -1)
+        rope_rows = _deinterleave_rope_rows(
+            np.ascontiguousarray(t[:, dn:].swapaxes(0, 1)), dr)
+        t = np.concatenate([t[:, :dn], rope_rows.swapaxes(0, 1)], axis=1)
+        return t.reshape(nh * (dn + dr), -1)
+
+    params: Dict[str, Any] = {
+        "layers": [dict() for _ in range(cfg.n_layers)]
+    }
+    stage = _ExpertStage(
+        cfg.n_experts, cfg.dtype,
+        lambda li, key, buf: params["layers"][li].__setitem__(
+            key, put(key, buf)))
+
+    expert_re = re.compile(
+        r"^mlp\.experts\.(\d+)\.(gate_proj|up_proj|down_proj)\.weight$")
+    shared_re = re.compile(
+        r"^mlp\.shared_experts\.(gate_proj|up_proj|down_proj)\.weight$")
+    w_map = {"gate_proj": "w_gate", "up_proj": "w_up", "down_proj": "w_down"}
+
+    for name, tensor in _iter_safetensors(model_path):
+        m = _LAYER_RE.match(name)
+        if m:
+            li, suffix = int(m.group(1)), m.group(2)
+            if li >= cfg.n_layers:
+                # V3/R1 checkpoints carry the multi-token-prediction (MTP)
+                # module as layer num_hidden_layers — not part of the
+                # serving model; skip it
+                continue
+            layer = params["layers"][li]
+            em = expert_re.match(suffix)
+            if em:
+                stage.feed(li, int(em.group(1)),
+                           "moe_" + w_map[em.group(2)],
+                           tensor.T.astype(cfg.dtype))
+                continue
+            sm = shared_re.match(suffix)
+            if sm:
+                layer.setdefault("shared", {})[w_map[sm.group(1)]] = put(
+                    w_map[sm.group(1)],
+                    np.ascontiguousarray(tensor.T).astype(cfg.dtype))
+                continue
+            if suffix == "mlp.gate.weight":
+                layer["moe_gate"] = put("moe_gate", np.ascontiguousarray(
+                    tensor.T).astype(cfg.dtype))
+            elif suffix == "mlp.gate.e_score_correction_bias":
+                layer["moe_gate_bias"] = jnp.asarray(tensor, jnp.float32)
+            elif suffix == "self_attn.q_proj.weight":
+                layer["wq"] = put("wq", np.ascontiguousarray(
+                    perm_q(tensor).T).astype(cfg.dtype))
+            elif suffix == "self_attn.q_a_proj.weight":
+                layer["wq_a"] = put("wq_a", np.ascontiguousarray(
+                    tensor.T).astype(cfg.dtype))
+            elif suffix == "self_attn.q_a_layernorm.weight":
+                layer["q_a_norm"] = {"norm": jnp.asarray(tensor, norm_dt)}
+            elif suffix == "self_attn.q_b_proj.weight":
+                layer["wq_b"] = put("wq_b", np.ascontiguousarray(
+                    perm_q(tensor).T).astype(cfg.dtype))
+            elif suffix == "self_attn.kv_a_proj_with_mqa.weight":
+                t = tensor
+                if interleaved:
+                    t = np.concatenate(
+                        [t[:R], _deinterleave_rope_rows(t[R:], dr)], axis=0)
+                layer["wkv_a"] = put("wkv_a", np.ascontiguousarray(
+                    t.T).astype(cfg.dtype))
+            elif suffix == "self_attn.kv_a_layernorm.weight":
+                layer["kv_a_norm"] = {"norm": jnp.asarray(tensor, norm_dt)}
+            elif suffix == "self_attn.kv_b_proj.weight":
+                # [nh*(dn+dv), R] -> per-head up-projections [nh, R, *]
+                t = tensor.reshape(nh, dn + dv, R)
+                layer["w_uk"] = put("w_uk", np.ascontiguousarray(
+                    t[:, :dn].swapaxes(1, 2)).astype(cfg.dtype))
+                layer["w_uv"] = put("w_uv", np.ascontiguousarray(
+                    t[:, dn:].swapaxes(1, 2)).astype(cfg.dtype))
+            elif suffix == "self_attn.o_proj.weight":
+                layer["wo"] = put("wo", np.ascontiguousarray(
+                    tensor.T).astype(cfg.dtype))
+            elif suffix == "input_layernorm.weight":
+                layer["attn_norm"] = {"norm": jnp.asarray(tensor, norm_dt)}
+            elif suffix == "post_attention_layernorm.weight":
+                layer["mlp_norm"] = {"norm": jnp.asarray(tensor, norm_dt)}
+            elif suffix in ("mlp.gate_proj.weight", "mlp.up_proj.weight",
+                            "mlp.down_proj.weight"):
+                key = w_map[suffix.split(".")[1]]
+                layer[key] = put(key, np.ascontiguousarray(
+                    tensor.T).astype(cfg.dtype))
+            else:
+                raise ValueError(f"unmapped deepseek tensor {name!r}")
+        elif name == "model.embed_tokens.weight":
+            params["embedding"] = put("embedding", tensor.astype(cfg.dtype))
+        elif name == "lm_head.weight":
+            params["lm_head"] = put("lm_head", np.ascontiguousarray(
+                tensor.T).astype(cfg.dtype))
+        elif name == "model.norm.weight":
+            params["final_norm"] = {"norm": jnp.asarray(tensor, norm_dt)}
+        else:
+            raise ValueError(f"unmapped deepseek tensor {name!r}")
+
+    if cfg.tie_embeddings:
+        params.pop("lm_head", None)
+    elif "lm_head" not in params:
+        params["lm_head"] = put("lm_head", np.ascontiguousarray(
+            np.asarray(params["embedding"]).T).astype(cfg.dtype))
+
+    # completeness: expected key count per layer from the config
+    missing = [k for k in ("embedding", "final_norm") if k not in params]
+    for li, layer in enumerate(params["layers"]):
+        want = 7  # attn_norm, mlp_norm, wkv_a, kv_a_norm, w_uk, w_uv, wo
+        want += 3 if cfg.q_lora_rank > 0 else 1
+        if cfg._moe_layer(li):
+            want += 4 + (1 if cfg.moe_scoring == "sigmoid" else 0) \
+                + (1 if cfg.n_shared_experts > 0 else 0)
+        else:
+            want += 3
+        if len(layer) != want:
+            missing.append(
+                f"model.layers.{li} ({len(layer)}/{want} tensors)")
+    missing.extend(
+        f"model.layers.{li} expert tensors {parts}"
+        for li, parts in stage.pending()
+    )
+    if missing:
+        raise ValueError(f"incomplete checkpoint {model_path}: missing "
+                         f"{missing[:5]}")
+    return params
+
+
 def load_params(
     model_path: str,
-    cfg: Optional[LlamaConfig] = None,
+    cfg=None,
     mesh=None,
 ) -> Dict[str, Any]:
-    """Load a HF checkpoint into the llama.py params pytree.
+    """Load a HF checkpoint into the matching family's params pytree.
 
     With a mesh, each tensor is device_put directly to its NamedSharding
     (per-weight streaming: host RAM holds one tensor at a time beyond the
@@ -169,34 +413,25 @@ def load_params(
             )
         return arr
 
+    if isinstance(cfg, DeepseekConfig):
+        return _load_deepseek_params(model_path, cfg, put)
+
     norm_dt = jnp.float32
     params: Dict[str, Any] = {
         "layers": [dict() for _ in range(cfg.n_layers)]
     }
-    # per-layer expert tensors stream into ONE preallocated stacked array
-    # (host RAM peak = one [E, ...] array per in-flight weight kind, not
-    # E separate copies + a stack)
-    moe_stage: Dict[int, Dict[str, Any]] = {}  # li -> w -> (buf, seen_set)
+    stage = _ExpertStage(
+        cfg.n_experts, cfg.dtype,
+        lambda li, key, buf: params["layers"][li].__setitem__(
+            key, put(key, buf)))
     for name, tensor in _iter_safetensors(model_path):
         m = _LAYER_RE.match(name)
         if m:
             li, suffix = int(m.group(1)), m.group(2)
             em = _MOE_EXPERT_RE.match(suffix)
             if em:
-                e, w = int(em.group(1)), _MOE_W_MAP[em.group(2)]
-                t = tensor.T
-                stage = moe_stage.setdefault(li, {})
-                if w not in stage:
-                    stage[w] = (
-                        np.empty((cfg.n_experts,) + t.shape, cfg.dtype),
-                        set(),
-                    )
-                buf, got = stage[w]
-                buf[e] = t
-                got.add(e)
-                if len(got) == cfg.n_experts:
-                    params["layers"][li][w] = put(w, buf)
-                    del stage[w]
+                stage.feed(li, int(em.group(1)), _MOE_W_MAP[em.group(2)],
+                           tensor.T)
                 continue
             if suffix == _MOE_GATE:
                 params["layers"][li]["moe_gate"] = put(
@@ -254,8 +489,8 @@ def load_params(
                  "mlp.down_proj.weight"}
         want |= {"moe_gate", "moe_w_gate", "moe_w_up", "moe_w_down"}
     missing.extend(
-        f"model.layers.{li} expert tensors {sorted(parts)}"
-        for li, parts in moe_stage.items() if parts
+        f"model.layers.{li} expert tensors {parts}"
+        for li, parts in stage.pending()
     )
     for li, layer in enumerate(params["layers"]):
         got = len(layer)

@@ -75,6 +75,14 @@ def param_sharding_rules() -> dict:
         "moe_w_gate": P("tp", None, None),  # [experts, d_model, ffn]
         "moe_w_up": P("tp", None, None),
         "moe_w_down": P("tp", None, None),
+        # MLA (DeepSeek family, models/deepseek.py): heads shard over tp
+        # through the query up-projection and the latent up-projections;
+        # the shared latent path (wkv_a) is replicated like the cache
+        "wq_a": P(None, None),              # [d_model, q_lora_rank]
+        "wq_b": P(None, "tp"),              # [q_lora_rank, nh*qk_head]
+        "wkv_a": P(None, None),             # [d_model, R+dr]
+        "w_uk": P("tp", None, None),        # [nh, R, dn]
+        "w_uv": P("tp", None, None),        # [nh, R, dv]
     }
 
 

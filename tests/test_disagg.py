@@ -212,11 +212,30 @@ async def test_disagg_resharding_prefill_tp1_decode_tp2():
 
 async def test_jax_engine_disagg_transfer_roundtrip():
     """KV computed on engine A must continue identically on engine B."""
+    await _engine_disagg_roundtrip(FP32)
+
+
+async def test_mla_engine_disagg_transfer_roundtrip():
+    """Same contract for the MLA family: the asymmetric latent/rope-key
+    cache pair (different head dims) rides the same transfer protocol
+    (KvLayout.head_dim_v)."""
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+
+    mla = DeepseekConfig(
+        name="mla-disagg", vocab_size=256, d_model=64, n_layers=2,
+        n_heads=4, q_lora_rank=24, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, ffn_dim=128,
+        dtype=jnp.float32,
+    )
+    await _engine_disagg_roundtrip(mla)
+
+
+async def _engine_disagg_roundtrip(model_config):
     from dynamo_tpu.engine import EngineConfig, JaxEngine
     from dynamo_tpu.engine.worker import JaxEngineWorker
 
     rt = await fresh_runtime().start()
-    ecfg = dict(model_config=FP32, block_size=4, num_blocks=64,
+    ecfg = dict(model_config=model_config, block_size=4, num_blocks=64,
                 max_blocks_per_seq=16, max_num_seqs=2,
                 prefill_buckets=(8, 16, 32), seed=7)
     prefill_worker = await JaxEngineWorker(
