@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models import get_family
 from ..parallel.mesh import MeshConfig, make_mesh, shard_params
 from ..protocols import LLMEngineOutput, PreprocessedRequest
-from ..tokens import TokenBlockSequence
+from ..tokens import TokenBlockSequence, request_salt
 from .block_allocator import BlockAllocator
 from .config import EngineConfig
 from .sampler import greedy_tokens, sample_tokens
@@ -502,7 +502,8 @@ class JaxEngine:
             request=request,
             seq=TokenBlockSequence(
                 request.token_ids, self.config.block_size,
-                salt=(request.lora_name or "").encode(),
+                salt=request_salt(request.lora_name,
+                                  request.media_hashes),
             ),
             out_q=asyncio.Queue(),
             block_table=np.zeros(self.config.max_blocks_per_seq, np.int32),

@@ -119,7 +119,7 @@ class ModelPipeline:
     """Everything the HTTP layer needs to serve one model."""
 
     def __init__(self, mdc: ModelDeploymentCard, client: Client,
-                 route=None, prefill=None):
+                 route=None, prefill=None, encoder=None):
         self.mdc = mdc
         self.preprocessor = OpenAIPreprocessor(mdc)
         self.client = client
@@ -128,12 +128,26 @@ class ModelPipeline:
         )
         # disaggregation: PrefillOrchestrator when a prefill fleet exists
         self.prefill = prefill
+        # multimodal: EncoderHop when an encoder fleet exists
+        self.encoder = encoder
 
     async def generate_deltas(
         self, request: PreprocessedRequest,
         token: Optional[CancellationToken] = None,
     ) -> AsyncIterator[ChatDelta]:
         """Engine stream → detokenized text deltas with stop-string handling."""
+        unencoded = any("data_uri" in m for m in request.multimodal or [])
+        if unencoded:
+            # (already-resolved items pass through: the HTTP layer encodes
+            # before usage accounting; this hop covers direct callers)
+            if self.encoder is None:
+                raise EngineError(
+                    "request has unencoded multimodal items but no encoder "
+                    "fleet is attached for this model")
+            # encode BEFORE the prefill hop: placeholder tokens must be in
+            # token_ids when conditional disagg measures prompt length
+            request = await self.encoder.encode_and_attach(request,
+                                                           token=token)
         if self.prefill is not None:
             request = await self.prefill.maybe_prefill(request, token=token)
         detok = self.preprocessor.tokenizer.make_detokenizer()

@@ -29,6 +29,10 @@ DEFAULT_CHAT_TEMPLATE = (
 
 DEFAULT_MAX_TOKENS = 512
 
+# literal marker threaded through chat templating to carry an image's
+# position into the tokenized prompt (split out in _build, never encoded)
+_IMAGE_MARKER = "\x00<|dyn_image|>\x00"
+
 
 class OpenAIPreprocessor:
     def __init__(self, mdc: ModelDeploymentCard,
@@ -44,9 +48,50 @@ class OpenAIPreprocessor:
             messages=messages, add_generation_prompt=True
         )
 
+    @staticmethod
+    def _flatten_content(messages: List[Dict[str, Any]]):
+        """OpenAI multimodal messages (content as a part list) -> string
+        content with image markers + the extracted data URIs, in order.
+
+        Ref: the reference preprocessor's multimodal fetch path
+        (preprocessor.rs media handling); no-egress policy restricts URLs
+        to data: URIs here."""
+        from ..multimodal.encoder import media_hash
+
+        flat = []
+        media: List[Dict[str, Any]] = []
+        for m in messages:
+            content = m.get("content")
+            if not isinstance(content, list):
+                flat.append(m)
+                continue
+            text_parts = []
+            for part in content:
+                ptype = part.get("type")
+                if ptype == "text":
+                    # the marker is in-band: strip it from user text so a
+                    # forged marker can neither desync the media count nor
+                    # leak into the prompt
+                    text_parts.append(
+                        part.get("text", "").replace(_IMAGE_MARKER, ""))
+                elif ptype == "image_url":
+                    uri = (part.get("image_url") or {}).get("url", "")
+                    if not uri.startswith("data:"):
+                        raise ValueError(
+                            "image_url must be a data: URI (no egress)")
+                    payload = uri.partition(",")[2].encode()
+                    media.append({"media_hash": media_hash(payload),
+                                  "data_uri": uri})
+                    text_parts.append(_IMAGE_MARKER)
+                else:
+                    raise ValueError(f"unsupported content part {ptype!r}")
+            flat.append({**m, "content": "".join(text_parts)})
+        return flat, media
+
     def preprocess_chat(self, body: Dict[str, Any]) -> PreprocessedRequest:
-        prompt = self.render_chat(body.get("messages", []))
-        return self._build(prompt, body)
+        messages, media = self._flatten_content(body.get("messages", []))
+        prompt = self.render_chat(messages)
+        return self._build(prompt, body, media=media)
 
     def preprocess_completion(self, body: Dict[str, Any]) -> PreprocessedRequest:
         prompt = body.get("prompt", "")
@@ -54,8 +99,25 @@ class OpenAIPreprocessor:
             prompt = "".join(prompt)
         return self._build(prompt, body)
 
-    def _build(self, prompt: str, body: Dict[str, Any]) -> PreprocessedRequest:
-        token_ids = self.tokenizer.encode(prompt)
+    def _build(self, prompt: str, body: Dict[str, Any],
+               media: Optional[List[Dict[str, Any]]] = None,
+               ) -> PreprocessedRequest:
+        multimodal = None
+        if media:
+            # tokenize per text segment; each descriptor records the token
+            # index where the EncoderHop splices its placeholder tokens
+            segments = prompt.split(_IMAGE_MARKER)
+            if len(segments) != len(media) + 1:
+                raise ValueError("image markers and media items diverged")
+            token_ids: List[int] = []
+            multimodal = []
+            for seg, item in zip(segments[:-1], media):
+                token_ids.extend(self.tokenizer.encode(seg) if seg else [])
+                multimodal.append({**item, "insert_pos": len(token_ids)})
+            if segments[-1]:
+                token_ids.extend(self.tokenizer.encode(segments[-1]))
+        else:
+            token_ids = self.tokenizer.encode(prompt)
         max_ctx = self.mdc.context_length
         if len(token_ids) >= max_ctx:
             raise ValueError(
@@ -88,4 +150,5 @@ class OpenAIPreprocessor:
             ),
             lora_name=body.get("lora_name"),
             annotations=body.get("nvext", {}).get("annotations", []),
+            multimodal=multimodal,
         )

@@ -61,6 +61,8 @@ class ModelWatcher:
         self._model_keys: Dict[str, set] = {}     # model name -> decode keys
         self._prefill_keys: Dict[str, set] = {}   # model name -> prefill keys
         self._prefill_orchs: Dict[str, Any] = {}  # model name -> orchestrator
+        self._encoder_keys: Dict[str, set] = {}   # model name -> encoder keys
+        self._encoder_hops: Dict[str, Any] = {}   # model name -> EncoderHop
 
     async def start(self) -> "ModelWatcher":
         if self._task is None:
@@ -91,6 +93,10 @@ class ModelWatcher:
             self._key_role[key] = "prefill"
             await self._add_prefill(key, mdc)
             return
+        if role == "encoder":
+            self._key_role[key] = "encoder"
+            await self._add_encoder(key, mdc)
+            return
         self._key_role[key] = "decode"
         self._model_keys.setdefault(mdc.name, set()).add(key)
         existing = self.manager.models.get(mdc.name)
@@ -104,6 +110,7 @@ class ModelWatcher:
             self.manager.models[mdc.name] = ModelPipeline(
                 mdc, existing.client, route=existing.migration.route,
                 prefill=existing.prefill or self._prefill_orchs.get(mdc.name),
+                encoder=existing.encoder or self._encoder_hops.get(mdc.name),
             )
             logger.info("model %s updated", mdc.name)
             return
@@ -119,6 +126,7 @@ class ModelWatcher:
         self.manager.models[mdc.name] = ModelPipeline(
             mdc, client, route=route,
             prefill=self._prefill_orchs.get(mdc.name),
+            encoder=self._encoder_hops.get(mdc.name),
         )
         self._clients[mdc.name] = client
         logger.info("model %s registered (endpoint %s/%s/%s)",
@@ -149,6 +157,33 @@ class ModelWatcher:
         logger.info("prefill fleet attached for model %s (%s/%s)",
                     mdc.name, mdc.namespace, mdc.component)
 
+    async def _add_encoder(self, key: str, mdc: ModelDeploymentCard) -> None:
+        """An encoder-fleet card: attach an EncoderHop to the model's
+        pipeline (ref: encoder_router.rs — the encode hop of
+        encode/prefill/decode disaggregation)."""
+        from ..multimodal.hop import EncoderHop
+
+        self._encoder_keys.setdefault(mdc.name, set()).add(key)
+        if mdc.name in self._encoder_hops:
+            return
+        ep = (
+            self.runtime.namespace(mdc.namespace)
+            .component(mdc.component)
+            .endpoint(mdc.endpoint)
+        )
+        eclient = await ep.client(RouterMode.ROUND_ROBIN).start()
+        hop = EncoderHop(
+            eclient,
+            image_token_id=int(
+                mdc.runtime_config.get("image_token_id", 0)),
+        )
+        self._encoder_hops[mdc.name] = hop
+        pipeline = self.manager.models.get(mdc.name)
+        if pipeline is not None:
+            pipeline.encoder = hop
+        logger.info("encoder fleet attached for model %s (%s/%s)",
+                    mdc.name, mdc.namespace, mdc.component)
+
     def _make_overlap_fn(self, name: str):
         """Effective-ISL input for conditional disagg: best decode-fleet
         prefix overlap, from the model's KV router index (0 without one)."""
@@ -165,7 +200,8 @@ class ModelWatcher:
 
             bs = pipeline.mdc.kv_cache_block_size
             hashes = compute_block_hashes_for_request(
-                request.token_ids, bs, lora_name=request.lora_name
+                request.token_ids, bs, lora_name=request.lora_name,
+                media_hashes=request.media_hashes,
             )
             overlaps = indexer.find_matches(hashes)
             return max(overlaps.values(), default=0) * bs
@@ -176,7 +212,8 @@ class ModelWatcher:
         name = self._key_to_name.pop(key, None)
         if name is None:
             return
-        if self._key_role.pop(key, "decode") == "prefill":
+        role = self._key_role.pop(key, "decode")
+        if role == "prefill":
             pkeys = self._prefill_keys.get(name)
             if pkeys is not None:
                 pkeys.discard(key)
@@ -190,6 +227,21 @@ class ModelWatcher:
             if orch is not None:
                 await orch.close()
             logger.info("prefill fleet for %s gone; serving aggregated", name)
+            return
+        if role == "encoder":
+            ekeys = self._encoder_keys.get(name)
+            if ekeys is not None:
+                ekeys.discard(key)
+                if ekeys:
+                    return
+            self._encoder_keys.pop(name, None)
+            hop = self._encoder_hops.pop(name, None)
+            pipeline = self.manager.models.get(name)
+            if pipeline is not None:
+                pipeline.encoder = None  # multimodal requests now fail fast
+            if hop is not None:
+                await hop.client.close()
+            logger.info("encoder fleet for %s gone", name)
             return
         keys = self._model_keys.get(name)
         if keys is not None:
@@ -216,6 +268,8 @@ class ModelWatcher:
             self._task.cancel()
         for orch in self._prefill_orchs.values():
             await orch.close()
+        for hop in self._encoder_hops.values():
+            await hop.client.close()
         for pipeline in self.manager.models.values():
             await self._close_route(pipeline)
         for client in self._clients.values():
@@ -297,6 +351,22 @@ class HttpService:
                    else pipeline.preprocessor.preprocess_completion(body))
         except Exception as e:
             return self._error(400, f"preprocessing failed: {e}")
+        if req.multimodal and pipeline.encoder is not None:
+            # encode here (not inside the pipeline) so usage accounting
+            # and conditional disagg see the spliced placeholder tokens
+            try:
+                req = await pipeline.encoder.encode_and_attach(req)
+            except Exception as e:
+                logger.exception("encoder hop failed")
+                return self._error(502, f"media encoding failed: {e}",
+                                   "server_error")
+            if len(req.token_ids) >= pipeline.mdc.context_length:
+                # re-validate: the splice can push a prompt that passed
+                # preprocessing past the context window
+                return self._error(
+                    400, f"prompt is {len(req.token_ids)} tokens with "
+                         f"image placeholders, exceeding the model's "
+                         f"context length of {pipeline.mdc.context_length}")
 
         token = self.runtime.root_token.child()
         self.inflight += 1

@@ -19,7 +19,7 @@ from dataclasses import dataclass, field
 from typing import AsyncIterator, Dict, List, Optional
 
 from ..protocols import LLMEngineOutput, PreprocessedRequest
-from ..tokens import TokenBlockSequence
+from ..tokens import TokenBlockSequence, request_salt
 
 logger = logging.getLogger(__name__)
 
@@ -122,7 +122,8 @@ class MockEngine:
             request=request,
             blocks=TokenBlockSequence(
                 request.token_ids, self.args.block_size,
-                salt=(request.lora_name or "").encode(),
+                salt=request_salt(request.lora_name,
+                                  request.media_hashes),
             ),
             out_queue=asyncio.Queue(),
             num_prompt_tokens=len(request.token_ids),

@@ -1,0 +1,45 @@
+"""Multimodal encoder disaggregation (BASELINE config 5).
+
+Ref: the reference's encode/prefill/decode disagg —
+components/src/dynamo/vllm/multimodal_handlers/encode_worker_handler.py
+(vision tower on a dedicated worker, embedding cache keyed by media hash,
+embeddings shipped to the LLM worker) and
+lib/llm/src/kv_router/encoder_router.rs (media-hash cache affinity).
+
+TPU-native shape:
+  * EncoderWorker serves an `encode` endpoint on the request plane: media
+    in, embeddings out, LRU-cached by media hash (multimodal/worker.py).
+  * The frontend preprocessor extracts image parts from OpenAI chat
+    messages into media descriptors; the EncoderHop in the model pipeline
+    encodes them (media-hash rendezvous routing for cache affinity) and
+    splices `n_tokens` placeholder tokens per image into the prompt
+    (multimodal/hop.py).
+  * media hashes SALT the KV block hashing everywhere (tokens/hashing.py
+    request_salt), so identical placeholder tokens with different media
+    never alias in the prefix cache, KVBM, or the router index.
+
+Engine-side embedding splicing (placeholder positions -> encoder output
+instead of the embedding table) is the remaining seam: the serving
+engines currently account for image tokens in scheduling, caching, and
+routing, but compute over placeholder embeddings.
+"""
+
+from .encoder import (
+    EmbeddingCache,
+    MockVisionEncoder,
+    VisionConfig,
+    VitEncoder,
+    media_hash,
+)
+from .hop import EncoderHop
+from .worker import EncoderWorker
+
+__all__ = [
+    "EmbeddingCache",
+    "EncoderHop",
+    "EncoderWorker",
+    "MockVisionEncoder",
+    "VisionConfig",
+    "VitEncoder",
+    "media_hash",
+]

@@ -1,0 +1,68 @@
+"""`python -m dynamo_tpu.multimodal` — run an encoder worker.
+
+The encode fleet of encoder/decoder disaggregation (BASELINE config 5);
+pair with an LLM fleet serving the same --model-name:
+
+    python -m dynamo_tpu.multimodal --model-name llava-x --encoder vit
+    python -m dynamo_tpu.mocker --model-name llava-x
+    python -m dynamo_tpu.frontend
+"""
+
+import argparse
+import asyncio
+import logging
+
+from ..runtime import DistributedRuntime
+from .encoder import MockVisionEncoder, VisionConfig, VitEncoder
+from .worker import EncoderWorker
+
+
+def build_args() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dynamo_tpu.multimodal")
+    p.add_argument("--model-name", required=True,
+                   help="LLM model this encoder fleet serves")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="encoder")
+    p.add_argument("--encoder", default="mock", choices=["mock", "vit"])
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--patch-size", type=int, default=16)
+    p.add_argument("--vision-dim", type=int, default=128)
+    p.add_argument("--vision-layers", type=int, default=2)
+    p.add_argument("--out-dim", type=int, default=512,
+                   help="LLM embedding width")
+    p.add_argument("--cache-capacity", type=int, default=32)
+    p.add_argument("--image-token-id", type=int, default=0,
+                   help="placeholder token the frontend splices per "
+                        "embedding position")
+    return p
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    args = build_args().parse_args()
+    if args.encoder == "vit":
+        encoder = VitEncoder(VisionConfig(
+            image_size=args.image_size, patch_size=args.patch_size,
+            d_model=args.vision_dim, n_layers=args.vision_layers,
+            out_dim=args.out_dim,
+        ))
+    else:
+        encoder = MockVisionEncoder(out_dim=args.out_dim)
+    rt = await DistributedRuntime.detached().start()
+    worker = await EncoderWorker(
+        rt, args.model_name, encoder=encoder,
+        namespace=args.namespace, component=args.component,
+        cache_capacity=args.cache_capacity,
+        image_token_id=args.image_token_id,
+    ).start()
+    print(f"ready instance_id={worker.served.instance_id}", flush=True)
+    try:
+        await rt.root_token.wait_killed()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await worker.close()
+    await rt.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,211 @@
+"""Vision encoders: a jittable JAX ViT tower + a CPU mock, with an
+embedding cache keyed by media hash.
+
+Ref role: encode_worker_handler.py loads a vision model (vLLM) and caches
+embeddings by item key; here the tower is a functional JAX ViT — patchify
+-> transformer blocks -> project to the LLM's embedding width — all
+static shapes so XLA compiles one program per image-size bucket and the
+matmuls land on the MXU.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import io
+import math
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+def media_hash(data: bytes) -> str:
+    """Stable content hash for a media item — the cache / routing /
+    KV-salt key (ref encoder_router.rs: routing by media hash)."""
+    return hashlib.sha256(data).hexdigest()[:32]
+
+
+def decode_data_uri(uri: str) -> Tuple[bytes, str]:
+    """data: URI -> (payload bytes, mime type)."""
+    if not uri.startswith("data:"):
+        raise ValueError("only data: URIs are supported (no egress)")
+    head, _, b64 = uri.partition(",")
+    mime = head[5:].split(";")[0] or "application/octet-stream"
+    return base64.b64decode(b64), mime
+
+
+def pixels_from_payload(data: bytes, mime: str,
+                        image_size: int) -> np.ndarray:
+    """Media payload -> [H, W, 3] float32 in [0, 1], resized to the
+    encoder's square input.  `.npy` payloads pass through (tests, raw
+    tensors); images decode via PIL when available."""
+    if mime == "application/x-npy" or data[:6] == b"\x93NUMPY":
+        arr = np.load(io.BytesIO(data))
+        arr = np.asarray(arr, np.float32)
+    else:
+        try:
+            from PIL import Image
+        except ImportError as e:  # pragma: no cover
+            raise ValueError(
+                f"cannot decode {mime!r} media without PIL; send an .npy "
+                "payload instead") from e
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        img = img.resize((image_size, image_size))
+        arr = np.asarray(img, np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = np.repeat(arr[..., None], 3, axis=-1)
+    if arr.shape[:2] != (image_size, image_size):
+        # nearest-neighbor resize without PIL (npy path)
+        ys = (np.arange(image_size) * arr.shape[0] // image_size)
+        xs = (np.arange(image_size) * arr.shape[1] // image_size)
+        arr = arr[ys][:, xs]
+    return np.ascontiguousarray(arr[..., :3], np.float32)
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 64
+    patch_size: int = 16
+    d_model: int = 128       # vision tower width
+    n_layers: int = 2
+    n_heads: int = 4
+    out_dim: int = 512       # LLM embedding width (projection target)
+    rms_eps: float = 1e-5
+    dtype: Any = np.float32  # jnp dtype; np.float32 keeps CPU tests exact
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+class VitEncoder:
+    """Functional ViT tower.  encode([B, H, W, 3]) -> [B, n_patches,
+    out_dim]; one jitted program per batch bucket."""
+
+    def __init__(self, cfg: VisionConfig, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self._jnp = jnp
+        key = jax.random.split(jax.random.PRNGKey(seed), 4 + cfg.n_layers)
+
+        def dense(k, shape):
+            scale = 1.0 / math.sqrt(shape[0])
+            return (jax.random.normal(k, shape, jnp.float32) * scale
+                    ).astype(cfg.dtype)
+
+        self.params: Dict[str, Any] = {
+            "patch_embed": dense(key[0], (cfg.patch_dim, cfg.d_model)),
+            "pos_embed": dense(key[1], (cfg.n_patches, cfg.d_model)),
+            "out_proj": dense(key[2], (cfg.d_model, cfg.out_dim)),
+            "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "layers": [],
+        }
+        for i in range(cfg.n_layers):
+            k = jax.random.split(key[3 + i], 6)
+            self.params["layers"].append({
+                "norm1": jnp.ones((cfg.d_model,), jnp.float32),
+                "norm2": jnp.ones((cfg.d_model,), jnp.float32),
+                "wqkv": dense(k[0], (cfg.d_model, 3 * cfg.d_model)),
+                "wo": dense(k[1], (cfg.d_model, cfg.d_model)),
+                "w1": dense(k[2], (cfg.d_model, 4 * cfg.d_model)),
+                "w2": dense(k[3], (4 * cfg.d_model, cfg.d_model)),
+            })
+        self._jit = jax.jit(self._forward)
+
+    @property
+    def n_tokens(self) -> int:
+        return self.cfg.n_patches
+
+    def _norm(self, x, w):
+        jnp = self._jnp
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        return (xf * (1.0 / jnp.sqrt(var + self.cfg.rms_eps)) * w).astype(
+            x.dtype)
+
+    def _forward(self, params, pixels):
+        jnp = self._jnp
+        cfg = self.cfg
+        B = pixels.shape[0]
+        p = cfg.patch_size
+        g = cfg.image_size // p
+        # [B, H, W, 3] -> [B, n_patches, patch_dim]
+        x = pixels.reshape(B, g, p, g, p, 3).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(B, cfg.n_patches, cfg.patch_dim).astype(cfg.dtype)
+        x = x @ params["patch_embed"] + params["pos_embed"]
+        nh = cfg.n_heads
+        hd = cfg.d_model // nh
+        for layer in params["layers"]:
+            h = self._norm(x, layer["norm1"])
+            qkv = (h @ layer["wqkv"]).reshape(B, cfg.n_patches, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            s = jnp.einsum("bihd,bjhd->bhij", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) / math.sqrt(hd)
+            pattn = jnp.exp(s - s.max(-1, keepdims=True))
+            pattn = pattn / pattn.sum(-1, keepdims=True)
+            o = jnp.einsum("bhij,bjhd->bihd", pattn,
+                           v.astype(jnp.float32)).astype(cfg.dtype)
+            x = x + o.reshape(B, cfg.n_patches, cfg.d_model) @ layer["wo"]
+            h = self._norm(x, layer["norm2"])
+            x = x + jnp.maximum(h @ layer["w1"], 0.0) @ layer["w2"]
+        x = self._norm(x, params["final_norm"])
+        return (x @ params["out_proj"]).astype(cfg.dtype)
+
+    def encode(self, pixels: np.ndarray) -> np.ndarray:
+        """[B, H, W, 3] -> [B, n_patches, out_dim] numpy."""
+        return np.asarray(self._jit(self.params, pixels))
+
+
+class MockVisionEncoder:
+    """Deterministic embeddings from the media bytes — the CPU test
+    double (same contract as VitEncoder.encode on decoded payloads, but
+    keyed on raw bytes so no pixel decoding is needed)."""
+
+    def __init__(self, n_tokens: int = 4, out_dim: int = 16):
+        self._n_tokens = n_tokens
+        self.out_dim = out_dim
+
+    @property
+    def n_tokens(self) -> int:
+        return self._n_tokens
+
+    def encode_bytes(self, data: bytes) -> np.ndarray:
+        seed = int.from_bytes(hashlib.sha256(data).digest()[:8], "big")
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal(
+            (self._n_tokens, self.out_dim)).astype(np.float32)
+
+
+class EmbeddingCache:
+    """LRU embeddings by media hash (ref: embedding_cache.py —
+    re-encoding the same image for every turn of a session is the main
+    encoder cost)."""
+
+    def __init__(self, capacity: int = 32):
+        self.capacity = capacity
+        self._d: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> Optional[np.ndarray]:
+        emb = self._d.get(key)
+        if emb is None:
+            self.misses += 1
+            return None
+        self._d.move_to_end(key)
+        self.hits += 1
+        return emb
+
+    def put(self, key: str, emb: np.ndarray) -> None:
+        self._d[key] = emb
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)

@@ -89,6 +89,17 @@ class PreprocessedRequest:
     disaggregated_params: Optional[Dict[str, Any]] = None
     # annotations requested by the client (e.g. request tracing)
     annotations: List[str] = field(default_factory=list)
+    # multimodal items (encoder disagg, multimodal/): before the encoder
+    # hop each item is a descriptor {media_hash, data_uri, insert_pos};
+    # after it, {media_hash, n_tokens, embedding(bytes), shape, dtype}.
+    # media_hash also salts KV block hashing so identical placeholder
+    # tokens with different media never alias in any cache.
+    multimodal: Optional[List[Dict[str, Any]]] = None
+
+    @property
+    def media_hashes(self) -> List[str]:
+        return [m["media_hash"] for m in self.multimodal or []
+                if m.get("media_hash")]
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -100,6 +111,7 @@ class PreprocessedRequest:
             "lora_name": self.lora_name,
             "disaggregated_params": self.disaggregated_params,
             "annotations": self.annotations,
+            "multimodal": self.multimodal,
         }
 
     @staticmethod
@@ -113,6 +125,7 @@ class PreprocessedRequest:
             lora_name=d.get("lora_name"),
             disaggregated_params=d.get("disaggregated_params"),
             annotations=d.get("annotations", []),
+            multimodal=d.get("multimodal"),
         )
 
 

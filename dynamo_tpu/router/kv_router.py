@@ -211,7 +211,8 @@ class KvRouter:
             await self.client.wait_for_instances()
             workers = self.client.instance_ids
         hashes = compute_block_hashes_for_request(
-            request.token_ids, self.block_size, lora_name=request.lora_name
+            request.token_ids, self.block_size, lora_name=request.lora_name,
+            media_hashes=request.media_hashes,
         )
         overlaps = self.indexer.find_matches(hashes)
         request_blocks = (len(request.token_ids) + self.block_size - 1) \

@@ -3,6 +3,7 @@ from .hashing import (
     PositionalLineageHash,
     compute_block_hashes,
     compute_block_hashes_for_request,
+    request_salt,
     local_block_hash,
 )
 from .blocks import TokenBlock, TokenBlockSequence, UniqueBlock
@@ -12,6 +13,7 @@ __all__ = [
     "PositionalLineageHash",
     "compute_block_hashes",
     "compute_block_hashes_for_request",
+    "request_salt",
     "local_block_hash",
     "TokenBlock",
     "TokenBlockSequence",

@@ -84,19 +84,35 @@ def compute_block_hashes(
     return out
 
 
+def request_salt(lora_name: Optional[str] = None,
+                 media_hashes: Optional[Sequence[str]] = None) -> bytes:
+    """THE canonical hashing salt for a request: LoRA adapter + multimodal
+    media hashes.  Every component that derives block hashes (engines,
+    router, frontend overlap probe) must build its salt here, or identical
+    placeholder tokens with different adapters/media would alias in the
+    prefix cache."""
+    parts = [lora_name or ""]
+    if media_hashes:
+        parts.extend(media_hashes)
+    salt = "|".join(parts)
+    return salt.encode() if salt != "" else b""
+
+
 def compute_block_hashes_for_request(
     token_ids: Sequence[int],
     block_size: int = DEFAULT_BLOCK_SIZE,
     *,
     lora_name: Optional[str] = None,
+    media_hashes: Optional[Sequence[str]] = None,
 ) -> list[PositionalLineageHash]:
     """The Request→Vec<PLH> contract (ref: lib/kv-hashing/src/lib.rs:2-14).
 
-    Pure computation, no I/O.  ``lora_name`` namespaces the lineage so KV from
-    different adapters never aliases.
+    Pure computation, no I/O.  ``lora_name`` and ``media_hashes`` namespace
+    the lineage so KV from different adapters/media never aliases.
     """
-    salt = lora_name.encode() if lora_name else b""
-    return compute_block_hashes(token_ids, block_size, salt=salt)
+    return compute_block_hashes(
+        token_ids, block_size,
+        salt=request_salt(lora_name, media_hashes))
 
 
 def prefix_overlap_blocks(

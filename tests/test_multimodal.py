@@ -1,0 +1,314 @@
+"""Multimodal encoder disagg: ViT tower, embedding cache, encode worker
+endpoint, frontend hop with placeholder splicing, media-hash KV salting,
+and the full chat e2e against a mocker fleet (BASELINE config 5 skeleton).
+
+Ref shape: encode_worker_handler.py (encode fleet + embedding cache) and
+encoder_router.rs (media-hash cache affinity)."""
+
+import asyncio
+import base64
+import io
+import uuid
+
+import aiohttp
+import numpy as np
+
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.multimodal import (
+    EmbeddingCache,
+    EncoderWorker,
+    MockVisionEncoder,
+    VisionConfig,
+    VitEncoder,
+    media_hash,
+)
+from dynamo_tpu.multimodal.hop import rendezvous_pick
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+from dynamo_tpu.tokens import compute_block_hashes_for_request
+
+
+def fresh_runtime() -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+def npy_data_uri(arr: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    b64 = base64.b64encode(buf.getvalue()).decode()
+    return f"data:application/x-npy;base64,{b64}"
+
+
+# ----------------------------- encoder ------------------------------------
+
+
+def test_vit_encoder_shapes_and_determinism():
+    cfg = VisionConfig(image_size=32, patch_size=16, d_model=32,
+                       n_layers=1, n_heads=2, out_dim=48)
+    enc = VitEncoder(cfg, seed=1)
+    assert enc.n_tokens == 4  # (32/16)^2
+    rng = np.random.default_rng(0)
+    px = rng.random((2, 32, 32, 3)).astype(np.float32)
+    out = enc.encode(px)
+    assert out.shape == (2, 4, 48)
+    np.testing.assert_array_equal(out, enc.encode(px))  # deterministic
+    assert not np.allclose(out[0], out[1])  # inputs matter
+
+
+def test_embedding_cache_lru():
+    c = EmbeddingCache(capacity=2)
+    a, b, d = (np.ones((2, 4)) * i for i in (1, 2, 3))
+    c.put("a", a)
+    c.put("b", b)
+    assert c.get("a") is not None  # refresh a
+    c.put("d", d)                  # evicts b (LRU)
+    assert c.get("b") is None
+    assert c.get("a") is not None and c.get("d") is not None
+    assert c.hits == 3 and c.misses == 1
+
+
+def test_rendezvous_pick_stability():
+    ids = [11, 22, 33]
+    key = "media-x"
+    first = rendezvous_pick(ids, key)
+    assert all(rendezvous_pick(ids, key) == first for _ in range(5))
+    # removing an unrelated instance keeps the mapping when possible
+    remaining = [i for i in ids if i != first]
+    moved = rendezvous_pick(remaining, key)
+    assert moved in remaining
+    assert rendezvous_pick([42], key) == 42
+
+
+# --------------------------- media KV salt ---------------------------------
+
+
+def test_media_hashes_salt_block_hashes():
+    toks = list(range(32))
+    plain = compute_block_hashes_for_request(toks, 16)
+    img_a = compute_block_hashes_for_request(toks, 16,
+                                             media_hashes=["aaa"])
+    img_b = compute_block_hashes_for_request(toks, 16,
+                                             media_hashes=["bbb"])
+    assert plain != img_a
+    assert img_a != img_b
+    # same media -> same lineage (prefix cache works across requests)
+    assert img_a == compute_block_hashes_for_request(
+        toks, 16, media_hashes=["aaa"])
+
+
+# --------------------------- preprocessor ----------------------------------
+
+
+def test_preprocessor_extracts_images_with_positions():
+    from dynamo_tpu.frontend.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.protocols import ModelDeploymentCard
+
+    pre = OpenAIPreprocessor(ModelDeploymentCard(name="m"))
+    uri = npy_data_uri(np.zeros((4, 4, 3), np.float32))
+    body = {
+        "model": "m",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "describe "},
+                {"type": "image_url", "image_url": {"url": uri}},
+                {"type": "text", "text": " briefly"},
+            ],
+        }],
+        "max_tokens": 4,
+    }
+    req = pre.preprocess_chat(body)
+    assert req.multimodal is not None and len(req.multimodal) == 1
+    item = req.multimodal[0]
+    assert item["media_hash"] == media_hash(uri.partition(",")[2].encode())
+    assert 0 < item["insert_pos"] <= len(req.token_ids)
+    # marker characters never leak into the prompt tokens
+    text = pre.tokenizer.decode(req.token_ids)
+    assert "dyn_image" not in text and "\x00" not in text
+
+
+def test_preprocessor_strips_forged_marker():
+    from dynamo_tpu.frontend.preprocessor import (
+        _IMAGE_MARKER,
+        OpenAIPreprocessor,
+    )
+    from dynamo_tpu.protocols import ModelDeploymentCard
+
+    pre = OpenAIPreprocessor(ModelDeploymentCard(name="m"))
+    uri = npy_data_uri(np.zeros((2, 2, 3), np.float32))
+    body = {
+        "model": "m",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": f"evil {_IMAGE_MARKER} text "},
+            {"type": "image_url", "image_url": {"url": uri}},
+        ]}],
+        "max_tokens": 4,
+    }
+    req = pre.preprocess_chat(body)  # must not raise marker/media divergence
+    assert len(req.multimodal) == 1
+
+
+async def test_hop_preserves_adjacent_image_order():
+    """Two images with no text between them share an insert_pos; the
+    splice must keep the user's order (a back-to-front splice reverses
+    them)."""
+    from dynamo_tpu.multimodal.hop import EncoderHop
+    from dynamo_tpu.protocols import PreprocessedRequest
+
+    class FakeClient:
+        instance_ids = [1]
+
+        async def generate(self, payload, instance_id=None, token=None):
+            for it in payload["items"]:
+                # n_tokens differs per image so order is observable
+                n = 2 if it["media_hash"] == "A" else 3
+                yield {"media_hash": it["media_hash"], "n_tokens": n,
+                       "shape": [n, 4], "dtype": "float32",
+                       "embedding": b"\0" * (n * 16)}
+
+    req = PreprocessedRequest(
+        token_ids=[10, 11], request_id="r",
+        multimodal=[{"media_hash": "A", "data_uri": "data:x,", "insert_pos": 1},
+                    {"media_hash": "B", "data_uri": "data:x,", "insert_pos": 1}],
+    )
+    out = await EncoderHop(FakeClient(), image_token_id=99
+                           ).encode_and_attach(req)
+    # [10][A: 2 tokens][B: 3 tokens][11]
+    assert out.token_ids == [10, 99, 99, 99, 99, 99, 11]
+    assert [m["media_hash"] for m in out.multimodal] == ["A", "B"]
+    assert [m["n_tokens"] for m in out.multimodal] == [2, 3]
+
+
+# ------------------------- worker + hop e2e --------------------------------
+
+
+async def test_encoder_worker_endpoint_and_cache():
+    rt = await fresh_runtime().start()
+    w = await EncoderWorker(rt, "mm-model",
+                            encoder=MockVisionEncoder(n_tokens=3,
+                                                      out_dim=8)).start()
+    client = await (rt.namespace("dynamo").component("encoder")
+                    .endpoint("encode").client()).start()
+    await client.wait_for_instances()
+    uri = npy_data_uri(np.ones((4, 4, 3), np.float32))
+    h = media_hash(uri.partition(",")[2].encode())
+
+    async def encode_once():
+        frames = []
+        async for f in client.generate(
+            {"request_id": "r1",
+             "items": [{"media_hash": h, "data_uri": uri}]}
+        ):
+            frames.append(f)
+        return frames
+
+    first = (await encode_once())[0]
+    assert first["media_hash"] == h and first["n_tokens"] == 3
+    assert not first["cached"]
+    emb = np.frombuffer(first["embedding"],
+                        dtype=first["dtype"]).reshape(first["shape"])
+    assert emb.shape == (3, 8)
+    second = (await encode_once())[0]
+    assert second["cached"]
+    np.testing.assert_array_equal(
+        emb, np.frombuffer(second["embedding"],
+                           dtype=second["dtype"]).reshape(second["shape"]))
+    await client.close()
+    await w.close()
+    await rt.shutdown()
+
+
+async def test_multimodal_chat_e2e_with_mocker():
+    """Full path: OpenAI chat with an image part -> preprocessor
+    descriptors -> EncoderHop (placeholder splice) -> mocker generation.
+    The encoder fleet attaches via its role=encoder MDC."""
+    rt = await fresh_runtime().start()
+    args = MockEngineArgs(model_name="mm-model", block_size=4,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0)
+    worker = await MockerWorker(rt, args).start()
+    enc = await EncoderWorker(
+        rt, "mm-model",
+        encoder=MockVisionEncoder(n_tokens=5, out_dim=8)).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        p = manager.get("mm-model")
+        if p is not None and p.encoder is not None:
+            break
+        await asyncio.sleep(0.02)
+    p = manager.get("mm-model")
+    assert p is not None and p.encoder is not None
+
+    uri = npy_data_uri(np.full((4, 4, 3), 0.25, np.float32))
+    body = {
+        "model": "mm-model",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "what is in "},
+                {"type": "image_url", "image_url": {"url": uri}},
+            ],
+        }],
+        "max_tokens": 6,
+        "ignore_eos": True,
+    }
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                          json=body) as r:
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            assert data["usage"]["completion_tokens"] == 6
+            # the 5 image placeholder tokens count as prompt tokens
+            text_only = dict(body)
+            text_only["messages"] = [
+                {"role": "user", "content": "what is in "}]
+            async with s.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json=text_only,
+            ) as r2:
+                base = (await r2.json())["usage"]["prompt_tokens"]
+            assert data["usage"]["prompt_tokens"] == base + 5
+    assert enc.metrics["items"] == 1
+
+    await service.close()
+    await watcher.close()
+    await enc.close()
+    await worker.close()
+    await rt.shutdown()
+
+
+async def test_multimodal_without_encoder_fleet_fails_fast():
+    rt = await fresh_runtime().start()
+    args = MockEngineArgs(model_name="mm-x", block_size=4,
+                          base_step_s=0.0005)
+    worker = await MockerWorker(rt, args).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        if manager.get("mm-x"):
+            break
+        await asyncio.sleep(0.02)
+    uri = npy_data_uri(np.zeros((2, 2, 3), np.float32))
+    body = {
+        "model": "mm-x",
+        "messages": [{"role": "user", "content": [
+            {"type": "image_url", "image_url": {"url": uri}}]}],
+        "max_tokens": 4,
+    }
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                          json=body) as r:
+            assert r.status == 500
+            assert "encoder" in (await r.text())
+    await service.close()
+    await watcher.close()
+    await worker.close()
+    await rt.shutdown()
