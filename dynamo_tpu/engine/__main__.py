@@ -46,6 +46,9 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--role", default="both",
                    choices=["both", "prefill", "decode"])
+    p.add_argument("--reasoning-parser", default="",
+                   help="advertise a reasoning parser (e.g. deepseek_r1) "
+                        "so frontends split <think> spans")
     return p
 
 
@@ -67,6 +70,7 @@ async def main() -> None:
         disk_cache_dir=args.disk_cache_dir or None,
         disk_cache_blocks=args.disk_cache_blocks,
         role=args.role,
+        reasoning_parser=args.reasoning_parser,
     )
     rt = await DistributedRuntime.detached().start()
     worker = await JaxEngineWorker(

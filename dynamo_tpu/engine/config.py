@@ -85,6 +85,9 @@ class EngineConfig:
 
     # None = resolve from the checkpoint's config.json (model_path) or 2
     eos_token_id: Optional[int] = None
+    # output parsing advertised in the MDC: frontends split <think> spans
+    # into reasoning_content when set (e.g. "deepseek_r1")
+    reasoning_parser: str = ""
     seed: int = 0
 
     def resolve_model(self):

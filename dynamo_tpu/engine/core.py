@@ -652,6 +652,37 @@ class JaxEngine:
                 else:
                     _set_result_safe(fut, result)
 
+    @property
+    def supports_embedding(self) -> bool:
+        return hasattr(self.family, "embed_text")
+
+    async def embed(self, token_ids: List[int]) -> np.ndarray:
+        """Pooled text embedding (family embed_text), bucketed like
+        prefill so repeat lengths hit the jit cache."""
+        if not self.supports_embedding:
+            raise RuntimeError(
+                f"model family {self.family.__name__} has no embed_text")
+        if len(token_ids) > self.config.prefill_buckets[-1]:
+            raise ValueError(
+                f"input is {len(token_ids)} tokens; embedding max is "
+                f"{self.config.prefill_buckets[-1]}")
+        bucket = self._bucket_for(len(token_ids))
+        jit = getattr(self, "_jit_embed", None)
+        if jit is None:
+            jit = self._jit_embed = jax.jit(
+                partial(self.family.embed_text, self.params,
+                        self.model_cfg))
+        toks = np.zeros(bucket, np.int32)
+        toks[: len(token_ids)] = token_ids
+
+        def run():
+            with self.mesh:
+                return np.asarray(
+                    jit(jnp.asarray(toks), jnp.int32(len(token_ids))),
+                    np.float32)
+
+        return await asyncio.to_thread(run)
+
     async def clear_kv_blocks(self) -> int:
         """Drop the reusable prefix cache (active sequences keep theirs)."""
         def do_clear():

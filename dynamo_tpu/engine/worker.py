@@ -103,6 +103,8 @@ class JaxEngineWorker:
                 "tp": self.config.tp,
                 "dp": self.config.dp,
                 "role": self.config.role,
+                **({"reasoning_parser": self.config.reasoning_parser}
+                   if self.config.reasoning_parser else {}),
             },
         )
 
@@ -246,6 +248,17 @@ class JaxEngineWorker:
             await comp.endpoint("kv_pull").serve_endpoint(
                 kv_pull_handler, instance_id=instance_id),
         ]
+        if self.engine.supports_embedding and self.mh.world == 1:
+            # multi-host slices serve generate only: embed does not ride
+            # the step broadcast, so a leader-only dispatch would hang the
+            # slice's collective schedule
+            async def embed_handler(payload, ctx):
+                vec = await self.engine.embed(payload["token_ids"])
+                yield {"embedding": vec.tolist(), "dim": int(vec.shape[0])}
+
+            self._aux_served.append(
+                await comp.endpoint("embed").serve_endpoint(
+                    embed_handler, instance_id=instance_id))
         await register_model(rt, self.card, instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
         logger.info("jax engine worker %d serving %s (tp=%d)",

@@ -130,6 +130,11 @@ class ModelPipeline:
         self.prefill = prefill
         # multimodal: EncoderHop when an encoder fleet exists
         self.encoder = encoder
+        # /v1/embeddings: lazily-created client on the fleet's `embed`
+        # endpoint (HttpService.h_embeddings); the lock serializes the
+        # first-call creation so racers don't leak clients
+        self.embed_client = None
+        self.embed_lock = asyncio.Lock()
 
     async def generate_deltas(
         self, request: PreprocessedRequest,

@@ -43,9 +43,10 @@ class OpenAIPreprocessor:
         self.template = env.from_string(mdc.chat_template or DEFAULT_CHAT_TEMPLATE)
 
     # -- request builders -------------------------------------------------
-    def render_chat(self, messages: List[Dict[str, Any]]) -> str:
+    def render_chat(self, messages: List[Dict[str, Any]],
+                    tools: Optional[List[Dict[str, Any]]] = None) -> str:
         return self.template.render(
-            messages=messages, add_generation_prompt=True
+            messages=messages, add_generation_prompt=True, tools=tools
         )
 
     @staticmethod
@@ -90,7 +91,17 @@ class OpenAIPreprocessor:
 
     def preprocess_chat(self, body: Dict[str, Any]) -> PreprocessedRequest:
         messages, media = self._flatten_content(body.get("messages", []))
-        prompt = self.render_chat(messages)
+        tools = body.get("tools")
+        if tools and "tools" not in (self.mdc.chat_template or ""):
+            # no native tools template: inject the hermes-style preamble
+            # (parsers.py); tools-aware templates receive `tools` directly
+            # in render_chat instead
+            from .parsers import render_tools_preamble
+
+            messages = [{"role": "system",
+                         "content": render_tools_preamble(tools)}
+                        ] + messages
+        prompt = self.render_chat(messages, tools=tools)
         return self._build(prompt, body, media=media)
 
     def preprocess_completion(self, body: Dict[str, Any]) -> PreprocessedRequest:

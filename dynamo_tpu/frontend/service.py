@@ -17,7 +17,12 @@ from typing import Any, Dict, Optional
 from aiohttp import web
 
 from ..protocols import ModelDeploymentCard
-from ..runtime import CancellationToken, DistributedRuntime, RouterMode
+from ..runtime import (
+    CancellationToken,
+    DistributedRuntime,
+    EngineError,
+    RouterMode,
+)
 from ..runtime.discovery import MDC_PREFIX
 from .pipeline import ModelPipeline
 
@@ -261,6 +266,9 @@ class ModelWatcher:
         route = getattr(getattr(pipeline, "migration", None), "route", None)
         if route is not None and hasattr(route, "close"):
             await route.close()
+        ec = getattr(pipeline, "embed_client", None)
+        if ec is not None:
+            await ec.close()
 
     async def close(self) -> None:
         self._cancel.set()
@@ -293,6 +301,7 @@ class HttpService:
         self.app.router.add_get("/v1/models", self.h_models)
         self.app.router.add_post("/v1/chat/completions", self.h_chat)
         self.app.router.add_post("/v1/completions", self.h_completions)
+        self.app.router.add_post("/v1/embeddings", self.h_embeddings)
         self.app.router.add_get("/health", self.h_health)
         self.app.router.add_get("/metrics", self.h_metrics)
 
@@ -329,6 +338,87 @@ class HttpService:
 
     async def h_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_inference(request, chat=False)
+
+    async def h_embeddings(self, request: web.Request) -> web.Response:
+        """/v1/embeddings: input (string | [string] | [ints] | [[ints]])
+        -> pooled vectors from the worker fleet's `embed` endpoint (ref:
+        the reference's embeddings route family).  Shares the inference
+        routes' overload gate and request metrics — a dense forward per
+        item is not a cheap route."""
+        if self._busy():
+            return self._error(503, "service busy", "overloaded_error")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return self._error(400, "invalid JSON body")
+        model = body.get("model", "")
+        pipeline = self.manager.get(model)
+        if pipeline is None:
+            return self._error(
+                404, f"model {model!r} not found; available: "
+                     f"{sorted(self.manager.models)}", "not_found_error")
+        raw = body.get("input")
+        if raw is None:
+            return self._error(400, "'input' is required")
+        if isinstance(raw, str):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw \
+                and all(isinstance(x, int) for x in raw):
+            inputs = [raw]
+        elif isinstance(raw, list):
+            inputs = raw
+        else:
+            return self._error(400, "'input' must be a string, token "
+                                    "array, or list thereof")
+        try:
+            tok_lists = [
+                list(item) if isinstance(item, list)
+                else pipeline.preprocessor.tokenizer.encode(item)
+                for item in inputs
+            ]
+        except (TypeError, ValueError, AttributeError) as e:
+            return self._error(400, f"invalid embedding input: {e}")
+        async with pipeline.embed_lock:  # concurrent first calls race
+            client = pipeline.embed_client
+            if client is None:
+                mdc = pipeline.mdc
+                ep = (self.runtime.namespace(mdc.namespace)
+                      .component(mdc.component).endpoint("embed"))
+                client = await ep.client().start()
+                pipeline.embed_client = client
+
+        async def one(i: int, toks: list) -> dict:
+            async for out in client.generate({"token_ids": toks}):
+                return {"object": "embedding", "index": i,
+                        "embedding": out["embedding"]}
+            raise EngineError("embed endpoint returned no frames")
+
+        self.inflight += 1
+        self._m_requests.inc("dynamo_frontend_requests_total", model=model)
+        t0 = time.monotonic()
+        try:
+            data = await asyncio.gather(
+                *(one(i, t) for i, t in enumerate(tok_lists)))
+        except Exception as e:
+            msg = str(e)
+            if "tokens; embedding max is" in msg:
+                # deterministic client error surfaced from the engine
+                return self._error(400, msg)
+            logger.exception("embeddings failed")
+            return self._error(
+                500, f"embeddings failed (does this model family support "
+                     f"embedding?): {e}", "server_error")
+        finally:
+            self.inflight -= 1
+            self._m_requests.observe(
+                "dynamo_frontend_request_duration_seconds",
+                time.monotonic() - t0, model=model)
+        prompt_tokens = sum(len(t) for t in tok_lists)
+        return web.json_response({
+            "object": "list", "model": model, "data": list(data),
+            "usage": {"prompt_tokens": prompt_tokens,
+                      "total_tokens": prompt_tokens},
+        })
 
     async def _handle_inference(self, request: web.Request,
                                 chat: bool) -> web.StreamResponse:
@@ -368,6 +458,22 @@ class HttpService:
                          f"image placeholders, exceeding the model's "
                          f"context length of {pipeline.mdc.context_length}")
 
+        # output parsers (ref preprocessor.rs stream parsers): tool-call
+        # extraction when the request advertises tools; reasoning spans
+        # when the model card declares a reasoning parser
+        from .parsers import OutputParser
+
+        parser = None
+        if chat and (body.get("tools")
+                     or pipeline.mdc.runtime_config.get("reasoning_parser")):
+            parser = OutputParser(
+                reasoning=pipeline.mdc.runtime_config.get(
+                    "reasoning_parser") or False,
+                tools=bool(body.get("tools")),
+            )
+        include_usage = bool(
+            (body.get("stream_options") or {}).get("include_usage"))
+
         token = self.runtime.root_token.child()
         self.inflight += 1
         self._m_requests.inc("dynamo_frontend_requests_total", model=model)
@@ -375,8 +481,10 @@ class HttpService:
         try:
             if body.get("stream"):
                 return await self._stream_response(
-                    request, pipeline, req, token, chat, model)
-            return await self._unary_response(pipeline, req, token, chat, model)
+                    request, pipeline, req, token, chat, model,
+                    parser=parser, include_usage=include_usage)
+            return await self._unary_response(pipeline, req, token, chat,
+                                              model, parser=parser)
         finally:
             self.inflight -= 1
             self._m_requests.observe(
@@ -385,19 +493,37 @@ class HttpService:
             token.detach()
 
     async def _unary_response(self, pipeline: ModelPipeline, req, token,
-                              chat: bool, model: str) -> web.Response:
+                              chat: bool, model: str,
+                              parser=None) -> web.Response:
         text_parts: list[str] = []
+        reasoning_parts: list[str] = []
+        tool_calls: list[dict] = []
         finish = None
         ntok = 0
+
+        def feed(text: str) -> None:
+            if parser is None:
+                text_parts.append(text)
+                return
+            out = parser.push(text)
+            text_parts.append(out.content)
+            reasoning_parts.append(out.reasoning)
+            tool_calls.extend(out.tool_calls)
+
         try:
             async for d in pipeline.generate_deltas(req, token=token):
-                text_parts.append(d.text)
+                feed(d.text)
                 ntok += d.token_count
                 if d.finish_reason:
                     finish = d.finish_reason
         except Exception as e:
             logger.exception("generation failed")
             return self._error(500, f"generation failed: {e}", "server_error")
+        if parser is not None:
+            out = parser.flush()
+            text_parts.append(out.content)
+            reasoning_parts.append(out.reasoning)
+            tool_calls.extend(out.tool_calls)
         text = "".join(text_parts)
         usage = {
             "prompt_tokens": len(req.token_ids),
@@ -407,12 +533,19 @@ class HttpService:
         rid = req.request_id
         created = int(time.time())
         if chat:
+            message: Dict[str, Any] = {"role": "assistant", "content": text}
+            reasoning = "".join(reasoning_parts)
+            if reasoning:
+                message["reasoning_content"] = reasoning
+            if tool_calls:
+                message["tool_calls"] = tool_calls
+                finish = "tool_calls"
             payload = {
                 "id": rid, "object": "chat.completion", "created": created,
                 "model": model,
                 "choices": [{
                     "index": 0,
-                    "message": {"role": "assistant", "content": text},
+                    "message": message,
                     "finish_reason": finish or "stop",
                 }],
                 "usage": usage,
@@ -429,7 +562,9 @@ class HttpService:
 
     async def _stream_response(self, request: web.Request,
                                pipeline: ModelPipeline, req, token,
-                               chat: bool, model: str) -> web.StreamResponse:
+                               chat: bool, model: str, parser=None,
+                               include_usage: bool = False,
+                               ) -> web.StreamResponse:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -439,13 +574,18 @@ class HttpService:
         created = int(time.time())
 
         def chunk(delta_text: Optional[str], finish: Optional[str],
-                  first: bool = False) -> bytes:
+                  first: bool = False, reasoning: str = "",
+                  tool_calls: Optional[list] = None) -> bytes:
             if chat:
                 delta: Dict[str, Any] = {}
                 if first:
                     delta["role"] = "assistant"
                 if delta_text:
                     delta["content"] = delta_text
+                if reasoning:
+                    delta["reasoning_content"] = reasoning
+                if tool_calls:
+                    delta["tool_calls"] = tool_calls
                 choice = {"index": 0, "delta": delta, "finish_reason": finish}
                 obj = {"id": rid, "object": "chat.completion.chunk",
                        "created": created, "model": model, "choices": [choice]}
@@ -456,15 +596,48 @@ class HttpService:
                                     "finish_reason": finish}]}
             return f"data: {json.dumps(obj)}\n\n".encode()
 
+        def usage_chunk(ntok: int) -> bytes:
+            # stream_options.include_usage: a final chunk with empty
+            # choices carrying the usage block (OpenAI semantics)
+            obj = {"id": rid,
+                   "object": ("chat.completion.chunk" if chat
+                              else "text_completion"),
+                   "created": created, "model": model, "choices": [],
+                   "usage": {"prompt_tokens": len(req.token_ids),
+                             "completion_tokens": ntok,
+                             "total_tokens": len(req.token_ids) + ntok}}
+            return f"data: {json.dumps(obj)}\n\n".encode()
+
         first = True
+        ntok = 0
+        saw_tools = False
         disconnected = False
         try:
             async for d in pipeline.generate_deltas(req, token=token):
-                if d.text or d.finish_reason or first:
-                    await resp.write(chunk(d.text, d.finish_reason, first))
+                ntok += d.token_count
+                finish = d.finish_reason
+                text, reasoning, calls = d.text, "", None
+                if parser is not None:
+                    out = parser.push(d.text)
+                    if finish is not None:
+                        fl = parser.flush()
+                        out.content += fl.content
+                        out.reasoning += fl.reasoning
+                        out.tool_calls.extend(fl.tool_calls)
+                    text, reasoning, calls = (out.content, out.reasoning,
+                                              out.tool_calls)
+                    saw_tools |= bool(calls)
+                    if finish is not None and saw_tools:
+                        finish = "tool_calls"
+                if text or reasoning or calls or finish or first:
+                    await resp.write(chunk(text, finish, first,
+                                           reasoning=reasoning,
+                                           tool_calls=calls))
                     first = False
                 if d.finish_reason:
                     break
+            if include_usage:
+                await resp.write(usage_chunk(ntok))
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             token.kill()  # client went away; stop the engine

@@ -42,6 +42,10 @@ class MockEngineArgs:
     speedup_ratio: float = 1.0  # >1 runs faster than "real time"
     # disagg role: "both" | "prefill" | "decode"
     role: str = "both"
+    # emit exactly this text (as byte-token ids the frontend's mock
+    # tokenizer decodes verbatim), then EOS — lets frontend tests drive
+    # the output parsers (tool calls / reasoning) with structured text
+    canned_text: str = ""
 
 
 @dataclass
@@ -308,6 +312,11 @@ class MockEngine:
                 self._publish(res)
 
     def _next_token(self, seq: _Seq) -> int:
+        if self.args.canned_text:
+            data = self.args.canned_text.encode()
+            if seq.generated < len(data):
+                return 3 + data[seq.generated]  # MockTokenizer BYTE_BASE
+            return self.args.eos_token_id
         # deterministic pseudo-random stream; occasionally the EOS token
         r = seq.rng
         if not seq.request.stop.ignore_eos and r.random() < 0.005:

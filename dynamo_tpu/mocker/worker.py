@@ -26,12 +26,13 @@ LOAD_SUBJECT_PREFIX = "load_metrics"
 class MockerWorker:
     def __init__(self, runtime: DistributedRuntime, args: MockEngineArgs,
                  namespace: str = "dynamo", component: str = "mocker",
-                 migration_limit: int = 0):
+                 migration_limit: int = 0, reasoning_parser: str = ""):
         self.runtime = runtime
         self.args = args
         self.namespace = namespace
         self.component = component
         self.migration_limit = migration_limit
+        self.reasoning_parser = reasoning_parser
         self.publisher: Optional[KvEventPublisher] = None
         self.engine: Optional[MockEngine] = None
         self.served = None
@@ -51,6 +52,8 @@ class MockerWorker:
                 "total_kv_blocks": self.args.num_blocks,
                 "max_num_seqs": self.args.max_num_seqs,
                 "role": self.args.role,
+                **({"reasoning_parser": self.reasoning_parser}
+                   if self.reasoning_parser else {}),
             },
         )
 
@@ -79,6 +82,20 @@ class MockerWorker:
             n = await self.engine.clear_kv_blocks()
             yield {"cleared_blocks": n}
 
+        async def embed_handler(payload, ctx):
+            # deterministic unit vector from the token ids (test double
+            # for the JAX engine's pooled embed_text)
+            import hashlib
+
+            import numpy as np
+
+            toks = payload["token_ids"]
+            seed = int.from_bytes(hashlib.sha256(
+                np.asarray(toks, np.int64).tobytes()).digest()[:8], "big")
+            vec = np.random.default_rng(seed).standard_normal(32)
+            vec = vec / np.linalg.norm(vec)
+            yield {"embedding": vec.tolist(), "dim": 32}
+
         self.served = await gen_ep.serve_endpoint(
             generate_handler,
             metadata={"model": self.args.model_name, "role": self.args.role},
@@ -90,6 +107,9 @@ class MockerWorker:
             ),
             await comp.endpoint("kv_events_replay").serve_endpoint(
                 self.publisher.replay_handler, instance_id=instance_id
+            ),
+            await comp.endpoint("embed").serve_endpoint(
+                embed_handler, instance_id=instance_id
             ),
         ]
         await register_model(rt, self.card, instance_id)

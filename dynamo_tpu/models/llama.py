@@ -450,6 +450,43 @@ def prefill_batched(
     return logits, (k_cache, v_cache)
 
 
+def embed_text(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    token_ids: jax.Array,   # [T_pad] int32
+    true_len: jax.Array,    # scalar int32: valid tokens
+) -> jax.Array:
+    """Pooled text embedding: dense causal forward (no paging), final
+    norm, mean-pool over valid positions, L2-normalize.  Serves the
+    /v1/embeddings route (ref: the reference's embeddings route family,
+    lib/llm/src/http/service/openai.rs) — any generative checkpoint
+    doubles as a pooled embedder, vLLM's `embed` task semantics."""
+    T = token_ids.shape[0]
+    positions = jnp.arange(T)
+    valid = positions < true_len
+    x = params["embedding"][token_ids].astype(cfg.dtype)
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
+        q, k, v = _qkv(layer, cfg, h, positions)
+        group = cfg.n_heads // cfg.n_kv_heads
+        kr = jnp.repeat(k, group, axis=1)
+        vr = jnp.repeat(v, group, axis=1)
+        s = jnp.einsum("ihd,jhd->hij", q.astype(jnp.float32),
+                       kr.astype(jnp.float32)) / jnp.sqrt(
+            jnp.float32(cfg.head_dim))
+        causal = jnp.tril(jnp.ones((T, T), bool)) & valid[None, :]
+        s = jnp.where(causal[None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("hij,jhd->ihd", p, vr.astype(jnp.float32))
+        x = x + o.reshape(T, cfg.q_dim).astype(cfg.dtype) @ layer["wo"]
+        h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
+        x = x + _ffn(layer, cfg, h, valid=valid)
+    x = rms_norm(x, params["final_norm"]["norm"], cfg.rms_eps)
+    w = valid.astype(jnp.float32)[:, None]
+    pooled = (x.astype(jnp.float32) * w).sum(0) / jnp.maximum(w.sum(), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+
+
 # ---------------------------------------------------------------------------
 # decode: one token per active slot, batched
 # ---------------------------------------------------------------------------

@@ -1,0 +1,278 @@
+"""API breadth: reasoning/tool-call stream parsing, SSE usage chunks,
+and /v1/embeddings (ref: the reference's http route families +
+preprocessor.rs stream parsers)."""
+
+import asyncio
+import json
+import uuid
+
+import aiohttp
+import numpy as np
+
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.frontend.parsers import (
+    OutputParser,
+    ReasoningParser,
+    ToolCallParser,
+)
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def fresh_runtime() -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+# ------------------------------ parsers ------------------------------------
+
+
+def test_reasoning_parser_split_across_chunks():
+    p = ReasoningParser()
+    # the tags arrive split across arbitrary chunk boundaries
+    chunks = ["<th", "ink>let me ", "think</thi", "nk>the answer"]
+    content, reasoning = "", ""
+    for c in chunks:
+        co, re = p.push(c)
+        content += co
+        reasoning += re
+    co, re = p.flush()
+    content += co
+    reasoning += re
+    assert content == "the answer"
+    assert reasoning == "let me think"
+
+
+def test_reasoning_parser_unclosed_span_stays_reasoning():
+    p = ReasoningParser()
+    c1, r1 = p.push("<think>truncated stream")
+    c2, r2 = p.flush()
+    assert c1 + c2 == ""
+    assert r1 + r2 == "truncated stream"
+
+
+def test_reasoning_parser_r1_implicit_open():
+    """R1-style templates end the prompt with <think>: the model emits
+    only the close tag, so the parser must start inside the span."""
+    p = ReasoningParser(start_in_reasoning=True)
+    content, reasoning = "", ""
+    for c in ("chain of ", "thought</th", "ink>answer"):
+        co, re = p.push(c)
+        content += co
+        reasoning += re
+    co, re = p.flush()
+    assert content + co == "answer"
+    assert reasoning + re == "chain of thought"
+    # a model that repeats the open tag anyway is also handled
+    p2 = ReasoningParser(start_in_reasoning=True)
+    co1, re1 = p2.push("<think>x</think>y")
+    co2, re2 = p2.flush()
+    assert co1 + co2 == "y" and re1 + re2 == "x"
+
+
+def test_tool_call_parser_extracts_openai_shape():
+    p = ToolCallParser()
+    text = ('before <tool_call>{"name": "get_weather", "arguments": '
+            '{"city": "SF"}}</tool_call> after')
+    content, calls = "", []
+    for i in range(0, len(text), 7):  # arbitrary chunking
+        c, cs = p.push(text[i:i + 7])
+        content += c
+        calls += cs
+    content += p.flush()
+    assert content == "before  after"
+    assert len(calls) == 1
+    call = calls[0]
+    assert call["type"] == "function"
+    assert call["function"]["name"] == "get_weather"
+    assert json.loads(call["function"]["arguments"]) == {"city": "SF"}
+
+
+def test_tool_call_parser_malformed_json_falls_back_to_content():
+    p = ToolCallParser()
+    content, calls = p.push("<tool_call>not json</tool_call>done")
+    content += p.flush()
+    assert calls == []
+    assert "not json" in content and "done" in content
+
+
+def test_tool_call_parser_unterminated_flushes_verbatim():
+    p = ToolCallParser()
+    content, calls = p.push('x <tool_call>{"name": "f"')
+    assert content == "x " and calls == []
+    assert p.flush() == '<tool_call>{"name": "f"'
+
+
+def test_output_parser_composes_reasoning_then_tools():
+    p = OutputParser(reasoning=True, tools=True)
+    text = ('<think>plan the call</think>ok '
+            '<tool_call>{"name": "f", "arguments": {"a": 1}}</tool_call>')
+    content, reasoning, calls = "", "", []
+    for i in range(0, len(text), 5):
+        out = p.push(text[i:i + 5])
+        content += out.content
+        reasoning += out.reasoning
+        calls += out.tool_calls
+    out = p.flush()
+    content += out.content
+    assert reasoning == "plan the call"
+    assert content.strip() == "ok"
+    assert len(calls) == 1 and p.saw_tool_call
+
+
+# ----------------------------- service e2e ---------------------------------
+
+
+CANNED = ('<think>I should call f</think>hello '
+          '<tool_call>{"name": "f", "arguments": {"x": 2}}</tool_call>')
+
+
+async def start_stack(model_name="api-model", canned="", reasoning="",
+                      **kw):
+    rt = await fresh_runtime().start()
+    args = MockEngineArgs(model_name=model_name, block_size=4,
+                          base_step_s=0.0002, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0, canned_text=canned, **kw)
+    worker = await MockerWorker(rt, args,
+                                reasoning_parser=reasoning).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        if manager.get(model_name):
+            break
+        await asyncio.sleep(0.02)
+    assert manager.get(model_name)
+    return rt, worker, watcher, service, f"http://127.0.0.1:{port}"
+
+
+async def stop_stack(rt, worker, watcher, service):
+    await service.close()
+    await watcher.close()
+    await worker.close()
+    await rt.shutdown()
+
+
+async def test_chat_tools_and_reasoning_unary():
+    stack = await start_stack(canned=CANNED, reasoning="deepseek_r1")
+    rt, worker, watcher, service, url = stack
+    try:
+        body = {
+            "model": "api-model",
+            "messages": [{"role": "user", "content": "weather?"}],
+            "max_tokens": 300,
+            "tools": [{"type": "function",
+                       "function": {"name": "f", "parameters": {}}}],
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                data = await r.json()
+        msg = data["choices"][0]["message"]
+        assert msg["reasoning_content"] == "I should call f"
+        assert msg["content"].strip() == "hello"
+        assert msg["tool_calls"][0]["function"]["name"] == "f"
+        assert json.loads(
+            msg["tool_calls"][0]["function"]["arguments"]) == {"x": 2}
+        assert data["choices"][0]["finish_reason"] == "tool_calls"
+    finally:
+        await stop_stack(*stack[:4])
+
+
+async def test_chat_stream_parsers_and_usage_chunk():
+    stack = await start_stack(canned=CANNED, reasoning="deepseek_r1")
+    rt, worker, watcher, service, url = stack
+    try:
+        body = {
+            "model": "api-model",
+            "messages": [{"role": "user", "content": "go"}],
+            "max_tokens": 300,
+            "stream": True,
+            "stream_options": {"include_usage": True},
+            "tools": [{"type": "function",
+                       "function": {"name": "f", "parameters": {}}}],
+        }
+        reasoning, content, calls, usage = "", "", [], None
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: ") or line.endswith(
+                            "[DONE]"):
+                        continue
+                    obj = json.loads(line[6:])
+                    if obj.get("usage") is not None:
+                        usage = obj["usage"]
+                    for ch in obj.get("choices", []):
+                        d = ch.get("delta", {})
+                        reasoning += d.get("reasoning_content", "")
+                        content += d.get("content", "")
+                        calls += d.get("tool_calls") or []
+        assert reasoning == "I should call f"
+        assert content.strip() == "hello"
+        assert len(calls) == 1 and calls[0]["function"]["name"] == "f"
+        assert usage is not None and usage["completion_tokens"] > 0
+    finally:
+        await stop_stack(*stack[:4])
+
+
+async def test_embeddings_route_with_mocker():
+    stack = await start_stack()
+    rt, worker, watcher, service, url = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "api-model",
+                    "input": ["hello world", "other text"]}
+            async with s.post(f"{url}/v1/embeddings", json=body) as r:
+                assert r.status == 200, await r.text()
+                data = await r.json()
+            assert data["object"] == "list" and len(data["data"]) == 2
+            v0 = np.asarray(data["data"][0]["embedding"])
+            v1 = np.asarray(data["data"][1]["embedding"])
+            assert abs(np.linalg.norm(v0) - 1.0) < 1e-6
+            assert not np.allclose(v0, v1)
+            assert data["usage"]["prompt_tokens"] > 0
+            # determinism: same input -> same vector
+            async with s.post(f"{url}/v1/embeddings", json={
+                "model": "api-model", "input": "hello world"}) as r2:
+                d2 = await r2.json()
+            np.testing.assert_allclose(
+                v0, np.asarray(d2["data"][0]["embedding"]))
+            # token-array input form
+            async with s.post(f"{url}/v1/embeddings", json={
+                "model": "api-model", "input": [5, 6, 7]}) as r3:
+                assert r3.status == 200
+                d3 = await r3.json()
+                assert len(d3["data"]) == 1
+    finally:
+        await stop_stack(*stack[:4])
+
+
+async def test_jax_engine_embed_pooled_unit_vector():
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    import jax.numpy as jnp
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(name="t32", vocab_size=128, d_model=32, n_layers=2,
+                      n_heads=4, n_kv_heads=2, head_dim=8, ffn_dim=64,
+                      dtype=jnp.float32)
+    eng = JaxEngine(EngineConfig(model_config=cfg, block_size=4,
+                                 num_blocks=16, max_blocks_per_seq=8,
+                                 max_num_seqs=2, prefill_buckets=(8, 16)))
+    try:
+        v1 = await eng.embed([5, 9, 13])
+        v2 = await eng.embed([5, 9, 13])
+        v3 = await eng.embed([7, 7, 7, 7])
+        assert v1.shape == (32,)
+        assert abs(float(np.linalg.norm(v1)) - 1.0) < 1e-5
+        np.testing.assert_allclose(v1, v2)
+        assert not np.allclose(v1, v3)
+        # bucketing: a length crossing into the next bucket still works
+        v4 = await eng.embed(list(range(3, 15)))
+        assert v4.shape == (32,)
+    finally:
+        await eng.close()
