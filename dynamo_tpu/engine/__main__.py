@@ -6,7 +6,6 @@ The TPU-native equivalent of `python -m dynamo.vllm`
 
 import argparse
 import asyncio
-import logging
 import os
 
 if os.environ.get("DYN_JAX_PLATFORM"):
@@ -19,6 +18,7 @@ if os.environ.get("DYN_JAX_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["DYN_JAX_PLATFORM"])
 
 from ..runtime import DistributedRuntime
+from ..runtime.logging import setup_logging
 from .config import EngineConfig
 from .worker import JaxEngineWorker
 
@@ -53,7 +53,7 @@ def build_args() -> argparse.ArgumentParser:
 
 
 async def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    setup_logging()
     args = build_args().parse_args()
     config = EngineConfig(
         model=args.model,

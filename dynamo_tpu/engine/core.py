@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import zlib
 import threading
 import time
 from collections import deque
@@ -510,7 +511,9 @@ class JaxEngine:
             sampling_seed=(
                 request.sampling.seed
                 if request.sampling.seed is not None
-                else hash(request.request_id) & 0x7FFFFFFF
+                # stable across processes (unlike hash(): PYTHONHASHSEED)
+                # so a replayed/migrated request samples the same stream
+                else zlib.crc32(request.request_id.encode()) & 0x7FFFFFFF
             ),
             enqueued_t=time.monotonic(),
         )

@@ -387,6 +387,9 @@ class JaxEngineWorker:
 
     async def _load_loop(self) -> None:
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"
+        # local /metrics surface (system-status server): queue depth,
+        # active sequences, KV pressure per worker
+        m = self.runtime.metrics.scoped(component=self.component)
         while True:
             await asyncio.sleep(0.5)
             if self.engine is None or self.served is None:
@@ -404,6 +407,10 @@ class JaxEngineWorker:
                 "prompt_tokens_total": self.engine.metrics["prompt_tokens"],
                 "itl_ema_s": self.engine.itl_ema_s,
             })
+            m.set("dynamo_engine_active_seqs", self.engine.num_active_seqs)
+            m.set("dynamo_engine_waiting_seqs", len(self.engine.waiting))
+            m.set("dynamo_engine_kv_usage", self.engine.kv_usage())
+            m.set("dynamo_engine_itl_ema_seconds", self.engine.itl_ema_s)
 
     async def close(self) -> None:
         if self._follower is not None:

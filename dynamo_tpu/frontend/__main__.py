@@ -3,9 +3,9 @@ router in one process (ref: components/src/dynamo/frontend/main.py)."""
 
 import argparse
 import asyncio
-import logging
 
 from ..runtime import DistributedRuntime, RouterMode
+from ..runtime.logging import setup_logging
 from .service import HttpService, ModelManager, ModelWatcher
 
 
@@ -28,7 +28,7 @@ def build_args() -> argparse.ArgumentParser:
 
 
 async def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    setup_logging()
     args = build_args().parse_args()
     rt = await DistributedRuntime.detached().start()
     manager = ModelManager()

@@ -284,6 +284,34 @@ class ModelWatcher:
             await client.close()
 
 
+class _LatencyProbe:
+    """Per-request TTFT/ITL/output-token recorder over the delta stream."""
+
+    def __init__(self, metrics, model: str):
+        self.m = metrics
+        self.model = model
+        self.t0 = time.monotonic()
+        self.last: Optional[float] = None
+
+    def on_delta(self, token_count: int) -> None:
+        if token_count <= 0:
+            return
+        now = time.monotonic()
+        if self.last is None:
+            self.m.observe("dynamo_frontend_ttft_seconds", now - self.t0,
+                           model=self.model)
+        else:
+            # a burst of n tokens arriving together = n ITL samples of
+            # gap/n (token-level spacing, same convention as loadgen)
+            per_tok = (now - self.last) / token_count
+            for _ in range(token_count):
+                self.m.observe("dynamo_frontend_itl_seconds", per_tok,
+                               model=self.model)
+        self.last = now
+        self.m.inc("dynamo_frontend_output_tokens_total", token_count,
+                   model=self.model)
+
+
 class HttpService:
     def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
                  host: str = "0.0.0.0", port: int = 8000,
@@ -297,6 +325,17 @@ class HttpService:
         self._runner: Optional[web.AppRunner] = None
         m = runtime.metrics.scoped(component="frontend")
         self._m_requests = m
+        # latency surface (ref metrics.rs: the reference's frontend
+        # exports TTFT/ITL/inflight so routing regressions are diagnosable
+        # from /metrics alone)
+        _lat_buckets = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+        m.histogram("dynamo_frontend_ttft_seconds",
+                    "time to first streamed token", ("model",),
+                    buckets=_lat_buckets)
+        m.histogram("dynamo_frontend_itl_seconds",
+                    "inter-token latency (per-token delta gaps)",
+                    ("model",), buckets=_lat_buckets)
         self.app = web.Application()
         self.app.router.add_get("/v1/models", self.h_models)
         self.app.router.add_post("/v1/chat/completions", self.h_chat)
@@ -306,6 +345,10 @@ class HttpService:
         self.app.router.add_get("/metrics", self.h_metrics)
 
     # -- helpers ----------------------------------------------------------
+    def _inflight_delta(self, d: int) -> None:
+        self.inflight += d
+        self._m_requests.set("dynamo_frontend_inflight", self.inflight)
+
     def _busy(self) -> bool:
         return (
             self.busy_threshold is not None
@@ -393,7 +436,7 @@ class HttpService:
                         "embedding": out["embedding"]}
             raise EngineError("embed endpoint returned no frames")
 
-        self.inflight += 1
+        self._inflight_delta(+1)
         self._m_requests.inc("dynamo_frontend_requests_total", model=model)
         t0 = time.monotonic()
         try:
@@ -409,7 +452,7 @@ class HttpService:
                 500, f"embeddings failed (does this model family support "
                      f"embedding?): {e}", "server_error")
         finally:
-            self.inflight -= 1
+            self._inflight_delta(-1)
             self._m_requests.observe(
                 "dynamo_frontend_request_duration_seconds",
                 time.monotonic() - t0, model=model)
@@ -475,7 +518,7 @@ class HttpService:
             (body.get("stream_options") or {}).get("include_usage"))
 
         token = self.runtime.root_token.child()
-        self.inflight += 1
+        self._inflight_delta(+1)
         self._m_requests.inc("dynamo_frontend_requests_total", model=model)
         t0 = time.monotonic()
         try:
@@ -486,7 +529,7 @@ class HttpService:
             return await self._unary_response(pipeline, req, token, chat,
                                               model, parser=parser)
         finally:
-            self.inflight -= 1
+            self._inflight_delta(-1)
             self._m_requests.observe(
                 "dynamo_frontend_request_duration_seconds",
                 time.monotonic() - t0, model=model)
@@ -510,9 +553,11 @@ class HttpService:
             reasoning_parts.append(out.reasoning)
             tool_calls.extend(out.tool_calls)
 
+        probe = _LatencyProbe(self._m_requests, model)
         try:
             async for d in pipeline.generate_deltas(req, token=token):
                 feed(d.text)
+                probe.on_delta(d.token_count)
                 ntok += d.token_count
                 if d.finish_reason:
                     finish = d.finish_reason
@@ -612,8 +657,10 @@ class HttpService:
         ntok = 0
         saw_tools = False
         disconnected = False
+        probe = _LatencyProbe(self._m_requests, model)
         try:
             async for d in pipeline.generate_deltas(req, token=token):
+                probe.on_delta(d.token_count)
                 ntok += d.token_count
                 finish = d.finish_reason
                 text, reasoning, calls = d.text, "", None

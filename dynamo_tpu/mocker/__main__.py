@@ -6,9 +6,9 @@ for frontend/router/planner testing.
 
 import argparse
 import asyncio
-import logging
 
 from ..runtime import DistributedRuntime
+from ..runtime.logging import setup_logging
 from .engine import MockEngineArgs
 from .worker import MockerWorker
 
@@ -31,7 +31,7 @@ def build_args() -> argparse.ArgumentParser:
 
 
 async def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    setup_logging()
     args = build_args().parse_args()
     engine_args = MockEngineArgs(
         model_name=args.model_name,

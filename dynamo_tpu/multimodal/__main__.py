@@ -10,9 +10,9 @@ pair with an LLM fleet serving the same --model-name:
 
 import argparse
 import asyncio
-import logging
 
 from ..runtime import DistributedRuntime
+from ..runtime.logging import setup_logging
 from .encoder import MockVisionEncoder, VisionConfig, VitEncoder
 from .worker import EncoderWorker
 
@@ -38,7 +38,7 @@ def build_args() -> argparse.ArgumentParser:
 
 
 async def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    setup_logging()
     args = build_args().parse_args()
     if args.encoder == "vit":
         encoder = VitEncoder(VisionConfig(

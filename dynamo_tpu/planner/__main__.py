@@ -15,6 +15,7 @@ import asyncio
 import logging
 
 from ..runtime import DistributedRuntime
+from ..runtime.logging import setup_logging
 from .connectors import SubprocessConnector
 from .planner import Planner, PlannerConfig
 
@@ -47,7 +48,7 @@ def build_args() -> argparse.ArgumentParser:
 
 
 async def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    setup_logging()
     args = build_args().parse_args()
     rt = await DistributedRuntime.detached().start()
     connector = SubprocessConnector(args.worker_module, args.worker_arg)

@@ -12,7 +12,8 @@ mock` profiles the simulator (CI / planner tests).
 
 import argparse
 import asyncio
-import logging
+
+from ..runtime.logging import setup_logging
 
 
 def build_args() -> argparse.ArgumentParser:
@@ -36,7 +37,7 @@ def build_args() -> argparse.ArgumentParser:
 
 
 async def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    setup_logging()
     args = build_args().parse_args()
     isls = [int(x) for x in args.isls.split(",") if x]
     concs = [int(x) for x in args.concurrencies.split(",") if x]

@@ -28,6 +28,7 @@ import logging
 
 from ..protocols import PreprocessedRequest
 from ..runtime import DistributedRuntime
+from ..runtime.logging import setup_logging
 from ..runtime.discovery import new_instance_id
 from .kv_router import KvRouter
 from .selector import KvRouterConfig
@@ -49,7 +50,7 @@ def build_args() -> argparse.ArgumentParser:
 
 
 async def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    setup_logging()
     args = build_args().parse_args()
     rt = await DistributedRuntime.detached().start()
     client = await (rt.namespace(args.namespace).component(args.component)

@@ -52,6 +52,13 @@ class KvRouter:
             if replica_sync else None
         )
         self.states: Dict[int, WorkerState] = {}
+        # per-worker routing observability (ref metrics.rs): a skewed
+        # fleet or a dead-prefix regression shows up here first
+        self._metrics = runtime.metrics.scoped(component="router")
+        self._metrics.histogram(
+            "dynamo_router_overlap_blocks",
+            "prefix-cache overlap of the chosen worker (blocks)",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
         self._cancel = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
         self._replay_client: Optional[Client] = None
@@ -234,6 +241,11 @@ class KvRouter:
             if self.sync is not None:
                 self.sync.publish_add(request.request_id, choice, blocks,
                                       overlap)
+            self._metrics.inc("dynamo_router_routed_requests_total",
+                              worker=str(choice))
+            self._metrics.observe("dynamo_router_overlap_blocks", overlap)
+        else:
+            self._metrics.inc("dynamo_router_no_worker_total")
         return choice
 
     def mark_prefill_completed(self, request_id: str) -> None:

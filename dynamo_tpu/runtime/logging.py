@@ -1,0 +1,75 @@
+"""Structured logging: one JSON object per line when DYN_LOG_JSON is
+truthy, human-readable otherwise.
+
+Ref: the reference's structured/OTEL logging surface (lib/runtime
+logging + observability docs) — machine-parseable records with stable
+keys so a routing regression is greppable from worker logs:
+
+    {"ts": 1712... , "level": "INFO", "logger": "dynamo_tpu.router",
+     "msg": "...", "worker_id": 42, ...}
+
+`extra={...}` fields on a log call land as top-level JSON keys.  Every
+`python -m dynamo_tpu.*` entrypoint calls setup_logging().
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Optional
+
+from .config import env_truthy
+
+_STD_KEYS = frozenset(logging.LogRecord(
+    "", 0, "", 0, "", (), None).__dict__) | {"message", "asctime",
+                                             "taskName"}
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        for k, v in record.__dict__.items():
+            if k not in _STD_KEYS and not k.startswith("_"):
+                try:
+                    json.dumps(v)
+                    out[k] = v
+                except (TypeError, ValueError):
+                    out[k] = repr(v)
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def setup_logging(level: Optional[int] = None,
+                  json_lines: Optional[bool] = None) -> None:
+    """Configure the root logger once (idempotent).  DYN_LOG_JSON=1
+    switches to JSONL; DYN_LOG_LEVEL overrides the level."""
+    import os
+
+    if json_lines is None:
+        json_lines = env_truthy("DYN_LOG_JSON")
+    if level is None:
+        level = getattr(logging, os.environ.get("DYN_LOG_LEVEL", "INFO")
+                        .upper(), logging.INFO)
+    root = logging.getLogger()
+    root.setLevel(level)
+    def formatter() -> logging.Formatter:
+        return JsonFormatter() if json_lines else logging.Formatter(
+            "%(levelname)s:%(name)s:%(message)s")
+
+    if root.handlers:
+        # re-invocation (tests, multiple workers in-proc): keep handlers,
+        # just swap formatters if the mode changed (either direction)
+        for h in root.handlers:
+            if json_lines != isinstance(h.formatter, JsonFormatter):
+                h.setFormatter(formatter())
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(formatter())
+    root.addHandler(handler)

@@ -1,0 +1,133 @@
+"""Observability batch: structured JSONL logging, frontend TTFT/ITL/
+queue-depth metrics, and per-worker routing counters (ref: the
+reference's metrics.rs hierarchy + structured logging surface)."""
+
+import asyncio
+import json
+import logging
+import uuid
+
+import aiohttp
+
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+from dynamo_tpu.runtime.logging import JsonFormatter
+
+
+def fresh_runtime() -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+# ------------------------------ logging ------------------------------------
+
+
+def test_json_formatter_structured_fields():
+    fmt = JsonFormatter()
+    rec = logging.LogRecord("dynamo_tpu.router", logging.INFO, "f.py", 10,
+                            "routed %s", ("r1",), None)
+    rec.worker_id = 42
+    rec.overlap_blocks = 7
+    out = json.loads(fmt.format(rec))
+    assert out["level"] == "INFO"
+    assert out["logger"] == "dynamo_tpu.router"
+    assert out["msg"] == "routed r1"
+    assert out["worker_id"] == 42 and out["overlap_blocks"] == 7
+    assert isinstance(out["ts"], float)
+
+
+def test_json_formatter_handles_unserializable_extra():
+    fmt = JsonFormatter()
+    rec = logging.LogRecord("x", logging.WARNING, "f.py", 1, "m", (), None)
+    rec.weird = object()
+    out = json.loads(fmt.format(rec))
+    assert out["weird"].startswith("<object object")
+
+
+def test_json_formatter_exception():
+    fmt = JsonFormatter()
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        import sys
+
+        rec = logging.LogRecord("x", logging.ERROR, "f.py", 1, "failed",
+                                (), sys.exc_info())
+    out = json.loads(fmt.format(rec))
+    assert "ValueError: boom" in out["exc"]
+
+
+# ------------------------------ metrics ------------------------------------
+
+
+async def test_frontend_latency_metrics_exported():
+    """A served chat request must leave TTFT/ITL samples, the inflight
+    gauge, and output-token counters on /metrics."""
+    rt = await fresh_runtime().start()
+    args = MockEngineArgs(model_name="obs-model", block_size=4,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0)
+    worker = await MockerWorker(rt, args).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        if manager.get("obs-model"):
+            break
+        await asyncio.sleep(0.02)
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "obs-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 8, "ignore_eos": True}
+            async with s.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+            async with s.get(f"http://127.0.0.1:{port}/metrics") as r:
+                text = await r.text()
+        assert 'dynamo_frontend_ttft_seconds_count{' in text
+        assert 'dynamo_frontend_itl_seconds_count{' in text
+        assert 'model="obs-model"' in text
+        assert "dynamo_frontend_inflight" in text
+        # 8 generated tokens counted
+        for line in text.splitlines():
+            if line.startswith("dynamo_frontend_output_tokens_total{"):
+                assert float(line.rsplit(" ", 1)[1]) == 8.0
+                break
+        else:
+            raise AssertionError("output_tokens_total not exported")
+    finally:
+        await service.close()
+        await watcher.close()
+        await worker.close()
+        await rt.shutdown()
+
+
+async def test_router_pick_counters():
+    """KV-routed requests appear in per-worker routing counters."""
+    from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.router import KvRouter
+
+    rt = await fresh_runtime().start()
+    args = MockEngineArgs(model_name="m", block_size=4, base_step_s=0.0005)
+    w = await MockerWorker(rt, args).start()
+    client = await (rt.namespace("dynamo").component("mocker")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+    router = await KvRouter(rt, "dynamo", "mocker", client,
+                            block_size=4).start()
+    req = PreprocessedRequest(token_ids=list(range(12)), request_id="r1",
+                              stop=StopConditions(max_tokens=4))
+    choice = await router.pick(req)
+    assert choice == w.served.instance_id
+    text = rt.metrics.render().decode()
+    assert "dynamo_router_routed_requests_total" in text
+    assert f'worker="{choice}"' in text
+    assert "dynamo_router_overlap_blocks_count" in text
+    await router.close()
+    await client.close()
+    await w.close()
+    await rt.shutdown()
