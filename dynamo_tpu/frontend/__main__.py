@@ -3,6 +3,7 @@ router in one process (ref: components/src/dynamo/frontend/main.py)."""
 
 import argparse
 import asyncio
+import os
 
 from ..runtime import DistributedRuntime, RouterMode
 from ..runtime.logging import setup_logging
@@ -24,6 +25,11 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--disagg-min-isl", type=int, default=2048)
     p.add_argument("--disagg-ratio", type=float, default=0.7)
     p.add_argument("--always-disagg", action="store_true")
+    p.add_argument(
+        "--session-affinity-ttl", type=float,
+        default=float(os.environ.get("DYN_SESSION_AFFINITY_TTL", 0)) or None,
+        help="seconds an idle agent session stays pinned to its worker "
+             "(0/unset disables sticky sessions)")
     return p
 
 
@@ -50,9 +56,15 @@ async def main() -> None:
         min_effective_ratio=args.disagg_ratio,
         always_remote=args.always_disagg,
     )
+    # "0 disables": normalize sub-second/zero TTLs to off here, where the
+    # error is visible, instead of raising per-MDC inside the watcher loop
+    affinity_ttl = args.session_affinity_ttl
+    if affinity_ttl is not None and affinity_ttl < 1.0:
+        affinity_ttl = None
     watcher = await ModelWatcher(
         rt, manager, router_mode=mode, make_route=make_route,
         disagg_config=disagg_config,
+        session_affinity_ttl=affinity_ttl,
     ).start()
     service = await HttpService(
         rt, manager, host=args.host, port=args.port,

@@ -51,13 +51,16 @@ class ModelWatcher:
 
     def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
                  router_mode: RouterMode = RouterMode.ROUND_ROBIN,
-                 make_route=None, disagg_config=None):
+                 make_route=None, disagg_config=None,
+                 session_affinity_ttl: Optional[float] = None):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         # make_route(mdc) -> optional coroutine route(req, avoid) -> instance_id
         self.make_route = make_route
         self.disagg_config = disagg_config
+        # sticky agent-session routing (ref session_affinity/): None = off
+        self.session_affinity_ttl = session_affinity_ttl
         self._cancel = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._clients: Dict[str, Any] = {}        # model name -> client
@@ -128,6 +131,16 @@ class ModelWatcher:
         route = None
         if self.make_route is not None:
             route = await self.make_route(mdc, client)
+        if self.session_affinity_ttl is not None:
+            from .affinity import AffinityCoordinator, SessionAffinityRouter
+
+            coord = AffinityCoordinator(
+                self.session_affinity_ttl,
+                metrics=self.runtime.metrics.scoped(component="frontend"),
+            ).start()
+            await coord.enable_replica_sync(self.runtime, mdc.namespace,
+                                            mdc.component)
+            route = SessionAffinityRouter(coord, client, inner=route)
         self.manager.models[mdc.name] = ModelPipeline(
             mdc, client, route=route,
             prefill=self._prefill_orchs.get(mdc.name),
@@ -484,6 +497,11 @@ class HttpService:
                    else pipeline.preprocessor.preprocess_completion(body))
         except Exception as e:
             return self._error(400, f"preprocessing failed: {e}")
+        # agent session identity from headers (ref protocols/agents.rs)
+        from .affinity import session_affinity_from_headers
+
+        req.session_id, req.session_final = session_affinity_from_headers(
+            request.headers)
         if req.multimodal and pipeline.encoder is not None:
             # encode here (not inside the pipeline) so usage accounting
             # and conditional disagg see the spliced placeholder tokens

@@ -85,6 +85,10 @@ class PreprocessedRequest:
     sampling: SamplingOptions = field(default_factory=SamplingOptions)
     stop: StopConditions = field(default_factory=StopConditions)
     lora_name: Optional[str] = None
+    # agent session identity (ref protocols/agents.rs): sticky routing via
+    # session affinity; session_final marks the session's last request
+    session_id: Optional[str] = None
+    session_final: bool = False
     # disaggregation: set by the prefill worker, consumed by decode
     disaggregated_params: Optional[Dict[str, Any]] = None
     # annotations requested by the client (e.g. request tracing)
@@ -109,6 +113,8 @@ class PreprocessedRequest:
             "sampling": self.sampling.to_dict(),
             "stop": self.stop.to_dict(),
             "lora_name": self.lora_name,
+            "session_id": self.session_id,
+            "session_final": self.session_final,
             "disaggregated_params": self.disaggregated_params,
             "annotations": self.annotations,
             "multimodal": self.multimodal,
@@ -123,6 +129,8 @@ class PreprocessedRequest:
             sampling=SamplingOptions.from_dict(d.get("sampling", {})),
             stop=StopConditions.from_dict(d.get("stop", {})),
             lora_name=d.get("lora_name"),
+            session_id=d.get("session_id"),
+            session_final=bool(d.get("session_final", False)),
             disaggregated_params=d.get("disaggregated_params"),
             annotations=d.get("annotations", []),
             multimodal=d.get("multimodal"),

@@ -248,6 +248,26 @@ class KvRouter:
             self._metrics.inc("dynamo_router_no_worker_total")
         return choice
 
+    def charge(self, request: PreprocessedRequest, worker_id: int) -> None:
+        """Record a placement decided outside this router (session
+        affinity, explicit backend_instance_id) so the worker's load
+        accounting stays truthful for subsequent picks."""
+        hashes = compute_block_hashes_for_request(
+            request.token_ids, self.block_size, lora_name=request.lora_name,
+            media_hashes=request.media_hashes,
+        )
+        overlap = self.indexer.find_matches(hashes).get(worker_id, 0)
+        blocks = ((len(request.token_ids) + self.block_size - 1)
+                  // self.block_size
+                  + request.stop.max_tokens // self.block_size)
+        self.sequences.add_request(request.request_id, worker_id, blocks,
+                                   overlap)
+        if self.sync is not None:
+            self.sync.publish_add(request.request_id, worker_id, blocks,
+                                  overlap)
+        self._metrics.inc("dynamo_router_routed_requests_total",
+                          worker=str(worker_id))
+
     def mark_prefill_completed(self, request_id: str) -> None:
         self.sequences.mark_prefill_completed(request_id)
         if self.sync is not None:

@@ -1,0 +1,295 @@
+"""Session affinity: sticky agent-session routing (ref:
+lib/llm/src/session_affinity/ + protocols/agents.rs)."""
+
+import asyncio
+import uuid
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.frontend.affinity import (
+    AffinityCoordinator,
+    SessionAffinityRouter,
+    session_affinity_from_headers,
+)
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig
+
+
+def fresh_runtime() -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+# --------------------------- header extraction ------------------------------
+
+
+def test_header_priority_and_agent_mappings():
+    assert session_affinity_from_headers({}) == (None, False)
+    # dynamo-native header wins over agent headers
+    sid, final = session_affinity_from_headers({
+        "x-dynamo-session-id": "d1",
+        "x-claude-code-session-id": "c1",
+    })
+    assert (sid, final) == ("d1", False)
+    # agent child id preferred over root session
+    sid, _ = session_affinity_from_headers({
+        "x-claude-code-session-id": "root",
+        "x-claude-code-agent-id": "sub",
+    })
+    assert sid == "sub"
+    sid, _ = session_affinity_from_headers({"session-id": "codex"})
+    assert sid == "codex"
+    sid, _ = session_affinity_from_headers({"x-session-id": "oc"})
+    assert sid == "oc"
+    _, final = session_affinity_from_headers({
+        "x-session-id": "oc", "x-dynamo-session-final": "true"})
+    assert final is True
+    # blank values ignored
+    assert session_affinity_from_headers({"x-session-id": "  "}) == (
+        None, False)
+
+
+# --------------------------- coordinator ------------------------------------
+
+
+async def test_bind_release_ttl_expiry():
+    coord = AffinityCoordinator(ttl_s=1.0).start()
+    e = await coord.acquire("s1")
+    assert e is not None and not e.bound
+    coord.bind("s1", e, 42)
+    # a second acquire sees the binding
+    e2 = await coord.acquire("s1")
+    assert e2.bound and e2.worker_id == 42
+    coord.release("s1", e2)
+    coord.release("s1", e)
+    # not expired yet
+    e3 = await coord.acquire("s1")
+    assert e3.worker_id == 42
+    coord.release("s1", e3)
+    # force expiry
+    e3.idle_deadline = 0.0
+    e4 = await coord.acquire("s1")
+    assert not e4.bound  # fresh initializing entry
+    coord.abort("s1", e4)
+    await coord.close()
+
+
+async def test_concurrent_first_requests_converge():
+    """The initializing barrier: concurrent first requests on one session
+    wait for the winner's bind instead of racing to different workers."""
+    coord = AffinityCoordinator(ttl_s=5.0).start()
+
+    e1 = await coord.acquire("s")
+    got = []
+
+    async def second():
+        e = await coord.acquire("s")
+        got.append(e.worker_id)
+
+    t = asyncio.create_task(second())
+    await asyncio.sleep(0.05)
+    assert not got  # blocked on the initializing entry
+    coord.bind("s", e1, 7)
+    await asyncio.wait_for(t, 2.0)
+    assert got == [7]
+    await coord.close()
+
+
+async def test_abort_unblocks_waiters():
+    coord = AffinityCoordinator(ttl_s=5.0).start()
+    e1 = await coord.acquire("s")
+
+    async def second():
+        return await coord.acquire("s")
+
+    t = asyncio.create_task(second())
+    await asyncio.sleep(0.02)
+    coord.abort("s", e1)  # routing failed
+    e2 = await asyncio.wait_for(t, 2.0)
+    assert e2 is not None and not e2.bound  # waiter takes over as binder
+    coord.abort("s", e2)
+    await coord.close()
+
+
+async def test_capacity_cap_skips_affinity():
+    coord = AffinityCoordinator(ttl_s=60.0, max_entries=2).start()
+    for i in range(2):
+        e = await coord.acquire(f"s{i}")
+        coord.bind(f"s{i}", e, i)
+        coord.release(f"s{i}", e)
+    assert await coord.acquire("s-over") is None  # full, nothing expired
+    assert await coord.acquire("x" * 300) is None  # oversized id
+    await coord.close()
+
+
+async def test_replica_sync_converges():
+    rt = await fresh_runtime().start()
+    try:
+        a = AffinityCoordinator(ttl_s=30.0).start()
+        b = AffinityCoordinator(ttl_s=30.0).start()
+        await a.enable_replica_sync(rt, "ns", "comp")
+        await b.enable_replica_sync(rt, "ns", "comp")
+        e = await a.acquire("shared")
+        a.bind("shared", e, 99)
+        a.release("shared", e)
+        for _ in range(100):
+            be = b.entries.get("shared")
+            if be is not None and be.bound:
+                break
+            await asyncio.sleep(0.02)
+        be = await b.acquire("shared")
+        assert be.bound and be.worker_id == 99
+        b.release("shared", be)
+        await a.close()
+        await b.close()
+    finally:
+        await rt.shutdown()
+
+
+# --------------------------- router wrapper ---------------------------------
+
+
+async def _mock_fleet(rt, n=2, model="aff-model"):
+    args = MockEngineArgs(model_name=model, block_size=4,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0)
+    workers = [await MockerWorker(rt, args).start() for _ in range(n)]
+    client = await (rt.namespace("dynamo").component("mocker")
+                    .endpoint("generate")
+                    .client(RouterMode.ROUND_ROBIN)).start()
+    await client.wait_for_instances()
+    while len(client.instances) < n:
+        await asyncio.sleep(0.02)
+    return workers, client
+
+
+def _req(rid: str, sid=None, final=False) -> PreprocessedRequest:
+    return PreprocessedRequest(token_ids=list(range(8)), request_id=rid,
+                               stop=StopConditions(max_tokens=2),
+                               session_id=sid, session_final=final)
+
+
+async def test_sticky_routing_and_failover():
+    rt = await fresh_runtime().start()
+    try:
+        workers, client = await _mock_fleet(rt)
+        coord = AffinityCoordinator(ttl_s=30.0).start()
+        router = SessionAffinityRouter(coord, client)
+
+        first = await router(_req("r1", sid="sess"))
+        assert first in client.instance_ids
+        router.complete("r1")
+        # round-robin inner would alternate; affinity pins
+        for i in range(4):
+            rid = f"r{i + 2}"
+            assert await router(_req(rid, sid="sess")) == first
+            router.complete(rid)
+        # no session id -> no pin; the client's own push router picks
+        assert await router(_req("n0")) is None
+
+        # bound worker dies -> rebind to the survivor
+        dead = next(w for w in workers if w.served.instance_id == first)
+        await dead.close()
+        while first in client.instance_ids:
+            await asyncio.sleep(0.02)
+        second = await router(_req("rf", sid="sess"))
+        assert second != first and second in client.instance_ids
+        router.complete("rf")
+        await router.close()
+        await client.close()
+        for w in workers:
+            if w is not dead:
+                await w.close()
+    finally:
+        await rt.shutdown()
+
+
+async def test_avoid_set_overrides_binding():
+    """Migration's avoid-set must beat stickiness (the pinned worker just
+    failed this very request)."""
+    rt = await fresh_runtime().start()
+    try:
+        workers, client = await _mock_fleet(rt)
+        coord = AffinityCoordinator(ttl_s=30.0).start()
+        router = SessionAffinityRouter(coord, client)
+        first = await router(_req("r1", sid="s"))
+        second = await router(_req("r1", sid="s"), avoid={first})
+        assert second != first
+        router.complete("r1")
+        # rebound: later requests follow the new worker
+        nxt = await router(_req("r2", sid="s"))
+        assert nxt == second
+        router.complete("r2")
+        await router.close()
+        await client.close()
+        for w in workers:
+            await w.close()
+    finally:
+        await rt.shutdown()
+
+
+async def test_session_final_evicts_binding():
+    rt = await fresh_runtime().start()
+    try:
+        workers, client = await _mock_fleet(rt)
+        coord = AffinityCoordinator(ttl_s=30.0).start()
+        router = SessionAffinityRouter(coord, client)
+        await router(_req("r1", sid="s", final=True))
+        router.complete("r1")
+        assert "s" not in coord.entries
+        await router.close()
+        await client.close()
+        for w in workers:
+            await w.close()
+    finally:
+        await rt.shutdown()
+
+
+# --------------------------- HTTP e2e ---------------------------------------
+
+
+async def test_http_session_header_pins_worker():
+    """Full stack: chat requests carrying an agent session header all land
+    on one worker; unpinned requests round-robin."""
+    rt = await fresh_runtime().start()
+    model = "aff-http"
+    args = MockEngineArgs(model_name=model, block_size=4,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0)
+    workers = [await MockerWorker(rt, args).start() for _ in range(2)]
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager,
+                                 session_affinity_ttl=30.0).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        if manager.get(model):
+            break
+        await asyncio.sleep(0.02)
+    try:
+        body = {"model": model,
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2, "ignore_eos": True}
+        async with aiohttp.ClientSession() as s:
+            for _ in range(4):
+                async with s.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json=body,
+                        headers={"x-claude-code-session-id": "cc"}) as r:
+                    assert r.status == 200
+        route = manager.get(model).migration.route
+        assert isinstance(route, SessionAffinityRouter)
+        entry = route.coordinator.entries.get("cc")
+        assert entry is not None and entry.bound
+        served = [w.engine.metrics["requests"] for w in workers]
+        assert sorted(served) == [0, 4]  # all four on the pinned worker
+    finally:
+        await service.close()
+        await watcher.close()
+        for w in workers:
+            await w.close()
+        await rt.shutdown()
