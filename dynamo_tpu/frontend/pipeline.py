@@ -49,7 +49,9 @@ class MigrationOperator:
         self.route = route
 
     async def generate(
-        self, request: PreprocessedRequest, token: Optional[CancellationToken] = None
+        self, request: PreprocessedRequest,
+        token: Optional[CancellationToken] = None,
+        tracker=None,
     ) -> AsyncIterator[LLMEngineOutput]:
         attempts = 0
         emitted: list[int] = []
@@ -71,7 +73,9 @@ class MigrationOperator:
                 try:
                     first = True
                     async for item in self.client.generate(
-                        req.to_dict(), instance_id=instance_id, token=token
+                        req.to_dict(), instance_id=instance_id, token=token,
+                        on_pick=(tracker.on_dispatch if tracker is not None
+                                 else None),
                     ):
                         out = LLMEngineOutput.from_dict(item)
                         if out.finish_reason == "error":
@@ -139,6 +143,7 @@ class ModelPipeline:
     async def generate_deltas(
         self, request: PreprocessedRequest,
         token: Optional[CancellationToken] = None,
+        tracker=None,
     ) -> AsyncIterator[ChatDelta]:
         """Engine stream → detokenized text deltas with stop-string handling."""
         unencoded = any("data_uri" in m for m in request.multimodal or [])
@@ -155,10 +160,15 @@ class ModelPipeline:
                                                            token=token)
         if self.prefill is not None:
             request = await self.prefill.maybe_prefill(request, token=token)
+            if (tracker is not None and request.disaggregated_params
+                    and request.disaggregated_params.get("instance_id")):
+                tracker.on_prefill_worker(
+                    request.disaggregated_params["instance_id"])
         detok = self.preprocessor.tokenizer.make_detokenizer()
         stops = request.stop.stop or []
         pending = ""  # holdback buffer for partial stop-string matches
-        async for out in self.migration.generate(request, token=token):
+        async for out in self.migration.generate(request, token=token,
+                                                 tracker=tracker):
             delta = detok.push(out.token_ids)
             finish = out.finish_reason
             if stops:

@@ -1,0 +1,263 @@
+"""Per-request trace records: who served it, how fast, and why it ended.
+
+Ref: lib/llm/src/request_trace/{types.rs,record.rs,sink.rs,config.rs} —
+one `request_end` record per request in a stable JSONL schema
+(`dynamo.request.trace.v1`), so a latency or routing regression can be
+diagnosed per-request after the fact, not just from aggregate histograms.
+
+Differences from the reference, by design:
+- Sinks are file-JSONL and the structured logger (runtime/logging.py);
+  the OTEL exporter is out of scope (zero-egress environment), but the
+  W3C `traceparent` header is parsed and propagated so records join an
+  external trace by trace_id.
+- Payload capture (full request/response bodies) is omitted: records are
+  metadata, never content — matching the reference's stated intent for
+  finish metadata (types.rs: "traces remain metadata, not payload logs").
+
+Config (ref config.rs env vocabulary):
+    DYN_REQUEST_TRACE=1                 enable
+    DYN_REQUEST_TRACE_FILE_PATH=...     JSONL sink (default when enabled:
+                                        ./request_trace.jsonl)
+    DYN_REQUEST_TRACE_SINKS=file,log    sink selection
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+SCHEMA = "dynamo.request.trace.v1"
+X_REQUEST_ID_HEADER = "x-request-id"
+TRACEPARENT_HEADER = "traceparent"
+
+
+# --------------------------- config / sinks ---------------------------------
+
+
+@dataclass
+class TraceConfig:
+    enabled: bool = False
+    sinks: tuple = ("file",)
+    file_path: str = "request_trace.jsonl"
+
+    @staticmethod
+    def from_env() -> "TraceConfig":
+        enabled = os.environ.get("DYN_REQUEST_TRACE", "").lower() in (
+            "1", "true", "yes", "on")
+        sinks = tuple(
+            s.strip() for s in
+            os.environ.get("DYN_REQUEST_TRACE_SINKS", "file").split(",")
+            if s.strip() in ("file", "log"))
+        return TraceConfig(
+            enabled=enabled,
+            sinks=sinks or ("file",),
+            file_path=os.environ.get("DYN_REQUEST_TRACE_FILE_PATH",
+                                     "request_trace.jsonl"),
+        )
+
+
+class TraceSink:
+    """Fan-out writer for trace records."""
+
+    def __init__(self, config: TraceConfig):
+        self.config = config
+        self._file = None
+        if config.enabled and "file" in config.sinks:
+            try:
+                self._file = open(config.file_path, "a", buffering=1)
+            except OSError:
+                # an observability option must not take down serving
+                logger.warning("request trace file %r not writable; file "
+                               "sink disabled", config.file_path,
+                               exc_info=True)
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        if not self.config.enabled:
+            return
+        line = json.dumps(record, separators=(",", ":"))
+        if self._file is not None:
+            try:
+                self._file.write(line + "\n")
+            except OSError:
+                logger.warning("request trace file write failed",
+                               exc_info=True)
+        if "log" in self.config.sinks:
+            logger.info("request_trace", extra={"trace_record": record})
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+# --------------------------- trace context ----------------------------------
+
+
+def parse_traceparent(value: Optional[str]):
+    """W3C traceparent: version-traceid-spanid-flags.  Returns
+    (trace_id, parent_span_id) or (None, None)."""
+    if not value:
+        return None, None
+    parts = value.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None, None
+    try:
+        int(parts[1], 16), int(parts[2], 16)
+    except ValueError:
+        return None, None  # W3C: ignore invalid traceparent, start fresh
+    if set(parts[1]) <= set("0") or set(parts[2]) <= set("0"):
+        return None, None
+    return parts[1].lower(), parts[2].lower()
+
+
+@dataclass
+class RequestTracker:
+    """Accumulates one request's timing/placement facts; emits the
+    request_end record (ref record.rs emit_request_end)."""
+
+    request_id: str
+    model: str
+    sink: Optional[TraceSink] = None
+    x_request_id: Optional[str] = None
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
+    session_id: Optional[str] = None
+    endpoint: str = "chat"
+    input_tokens: int = 0
+
+    span_id: str = field(default_factory=lambda: secrets.token_hex(8))
+    received_unix_ms: int = field(
+        default_factory=lambda: int(time.time() * 1000))
+    _t0: float = field(default_factory=time.monotonic)
+    _first_token_t: Optional[float] = None
+    _last_token_t: Optional[float] = None
+    output_tokens: int = 0
+    cached_tokens: Optional[int] = None
+    queue_depth: Optional[int] = None
+    decode_worker_id: Optional[int] = None
+    prefill_worker_id: Optional[int] = None
+    migrations: int = 0
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+    tool_call_names: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def from_headers(headers, request_id: str, model: str,
+                     sink: Optional[TraceSink], **kw) -> "RequestTracker":
+        trace_id, parent = parse_traceparent(headers.get(TRACEPARENT_HEADER))
+        return RequestTracker(
+            request_id=request_id, model=model, sink=sink,
+            x_request_id=headers.get(X_REQUEST_ID_HEADER) or request_id,
+            trace_id=trace_id, parent_span_id=parent, **kw)
+
+    # -- hooks along the pipeline ----------------------------------------
+    def on_dispatch(self, instance_id: Optional[int]) -> None:
+        """Called per dispatch attempt (MigrationOperator): the last one
+        wins as the decode worker; earlier ones count as migrations."""
+        if self.decode_worker_id is not None:
+            self.migrations += 1
+        self.decode_worker_id = instance_id
+
+    def on_prefill_worker(self, instance_id: int) -> None:
+        self.prefill_worker_id = instance_id
+
+    def add_tool_calls(self, calls) -> None:
+        """Record tool-call names (never arguments) from parser output."""
+        self.tool_call_names.extend(
+            (tc.get("function") or {}).get("name") or tc.get("name", "")
+            for tc in calls or [])
+
+    def on_tokens(self, n: int) -> None:
+        if n <= 0:
+            return
+        now = time.monotonic()
+        if self._first_token_t is None:
+            self._first_token_t = now
+        self._last_token_t = now
+        self.output_tokens += n
+
+    def traceparent(self) -> Optional[str]:
+        """Outgoing context for downstream hops (worker annotations)."""
+        if self.trace_id is None:
+            return None
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    # -- record ----------------------------------------------------------
+    def finish(self, finish_reason: Optional[str] = None,
+               error: Optional[str] = None) -> Dict[str, Any]:
+        now = time.monotonic()
+        total_ms = (now - self._t0) * 1000.0
+        ttft_ms = ((self._first_token_t - self._t0) * 1000.0
+                   if self._first_token_t is not None else None)
+        avg_itl_ms = None
+        if (self.output_tokens > 1 and self._first_token_t is not None
+                and self._last_token_t is not None
+                and self._last_token_t > self._first_token_t):
+            avg_itl_ms = ((self._last_token_t - self._first_token_t)
+                          * 1000.0 / (self.output_tokens - 1))
+        request: Dict[str, Any] = {
+            "request_id": self.request_id,
+            "x_request_id": self.x_request_id,
+            "model": self.model,
+            "input_tokens": self.input_tokens,
+            "output_tokens": self.output_tokens,
+            "request_received_ms": self.received_unix_ms,
+            "total_time_ms": round(total_ms, 3),
+        }
+        if ttft_ms is not None:
+            request["ttft_ms"] = round(ttft_ms, 3)
+        if avg_itl_ms is not None:
+            request["avg_itl_ms"] = round(avg_itl_ms, 3)
+        if self.cached_tokens is not None:
+            request["cached_tokens"] = self.cached_tokens
+            if self.input_tokens:
+                request["kv_hit_rate"] = round(
+                    self.cached_tokens / self.input_tokens, 4)
+        if self.queue_depth is not None:
+            request["queue_depth"] = self.queue_depth
+        worker: Dict[str, Any] = {}
+        if self.decode_worker_id is not None:
+            worker["decode_worker_id"] = self.decode_worker_id
+        if self.prefill_worker_id is not None:
+            worker["prefill_worker_id"] = self.prefill_worker_id
+        if worker:
+            request["worker"] = worker
+        if self.migrations:
+            request["migrations"] = self.migrations
+        finish_md: Dict[str, Any] = {}
+        if finish_reason or self.finish_reason:
+            finish_md["finish_reason"] = finish_reason or self.finish_reason
+        if self.tool_call_names:
+            # names only — metadata, never arguments (ref types.rs)
+            finish_md["tool_calls"] = [
+                {"name": n} for n in self.tool_call_names]
+        if finish_md:
+            request["finish_reason_metadata"] = finish_md
+        if error or self.error:
+            request["error"] = error or self.error
+        record: Dict[str, Any] = {
+            "schema": SCHEMA,
+            "event_type": "request_end",
+            "event_time_unix_ms": int(time.time() * 1000),
+            "event_source": "dynamo",
+            "endpoint": self.endpoint,
+            "request": request,
+        }
+        if self.trace_id is not None:
+            record["trace"] = {
+                "trace_id": self.trace_id,
+                "span_id": self.span_id,
+                "parent_span_id": self.parent_span_id,
+            }
+        if self.session_id:
+            record["agent_context"] = {"session_id": self.session_id}
+        if self.sink is not None:
+            self.sink.emit(record)
+        return record

@@ -336,6 +336,9 @@ class HttpService:
         self.busy_threshold = busy_threshold
         self.inflight = 0
         self._runner: Optional[web.AppRunner] = None
+        from .request_trace import TraceConfig, TraceSink
+
+        self.trace_sink = TraceSink(TraceConfig.from_env())
         m = runtime.metrics.scoped(component="frontend")
         self._m_requests = m
         # latency surface (ref metrics.rs: the reference's frontend
@@ -502,6 +505,21 @@ class HttpService:
 
         req.session_id, req.session_final = session_affinity_from_headers(
             request.headers)
+        # per-request trace record (ref request_trace/): placement, timing,
+        # finish metadata — emitted at request end when tracing is enabled
+        from .request_trace import RequestTracker
+
+        tracker = RequestTracker.from_headers(
+            request.headers, req.request_id, model, self.trace_sink,
+            session_id=req.session_id,
+            endpoint="chat" if chat else "completions",
+            input_tokens=len(req.token_ids))
+        tp = tracker.traceparent()
+        if tp is not None and self.trace_sink.config.enabled:
+            # ride annotations so worker logs join the same trace_id —
+            # only when tracing is on, or a service mesh injecting
+            # traceparent everywhere would flood worker logs
+            req.annotations = list(req.annotations) + [f"traceparent:{tp}"]
         if req.multimodal and pipeline.encoder is not None:
             # encode here (not inside the pipeline) so usage accounting
             # and conditional disagg see the spliced placeholder tokens
@@ -509,11 +527,14 @@ class HttpService:
                 req = await pipeline.encoder.encode_and_attach(req)
             except Exception as e:
                 logger.exception("encoder hop failed")
+                tracker.finish(error=f"media encoding failed: {e}")
                 return self._error(502, f"media encoding failed: {e}",
                                    "server_error")
             if len(req.token_ids) >= pipeline.mdc.context_length:
                 # re-validate: the splice can push a prompt that passed
                 # preprocessing past the context window
+                tracker.finish(error="context length exceeded after "
+                                     "multimodal splice")
                 return self._error(
                     400, f"prompt is {len(req.token_ids)} tokens with "
                          f"image placeholders, exceeding the model's "
@@ -543,9 +564,11 @@ class HttpService:
             if body.get("stream"):
                 return await self._stream_response(
                     request, pipeline, req, token, chat, model,
-                    parser=parser, include_usage=include_usage)
+                    parser=parser, include_usage=include_usage,
+                    tracker=tracker)
             return await self._unary_response(pipeline, req, token, chat,
-                                              model, parser=parser)
+                                              model, parser=parser,
+                                              tracker=tracker)
         finally:
             self._inflight_delta(-1)
             self._m_requests.observe(
@@ -553,9 +576,22 @@ class HttpService:
                 time.monotonic() - t0, model=model)
             token.detach()
 
+    @staticmethod
+    def _kv_overlap_tokens(pipeline: ModelPipeline,
+                           request_id: str) -> Optional[int]:
+        """Best-effort cached-prefix size from the KV router's slot
+        manager (None when no KV router is attached)."""
+        route = pipeline.migration.route
+        seqs = getattr(route, "sequences", None)
+        if seqs is None:
+            seqs = getattr(getattr(route, "inner", None), "sequences", None)
+        if seqs is None:
+            return None
+        return seqs.overlap_of(request_id) * pipeline.mdc.kv_cache_block_size
+
     async def _unary_response(self, pipeline: ModelPipeline, req, token,
                               chat: bool, model: str,
-                              parser=None) -> web.Response:
+                              parser=None, tracker=None) -> web.Response:
         text_parts: list[str] = []
         reasoning_parts: list[str] = []
         tool_calls: list[dict] = []
@@ -573,14 +609,27 @@ class HttpService:
 
         probe = _LatencyProbe(self._m_requests, model)
         try:
-            async for d in pipeline.generate_deltas(req, token=token):
+            async for d in pipeline.generate_deltas(req, token=token,
+                                                    tracker=tracker):
+                if tracker is not None and ntok == 0 and d.token_count:
+                    tracker.cached_tokens = self._kv_overlap_tokens(
+                        pipeline, req.request_id)
                 feed(d.text)
                 probe.on_delta(d.token_count)
+                if tracker is not None:
+                    tracker.on_tokens(d.token_count)
                 ntok += d.token_count
                 if d.finish_reason:
                     finish = d.finish_reason
+        except asyncio.CancelledError:
+            token.kill()  # client went away; stop the engine
+            if tracker is not None:
+                tracker.finish(error="client_disconnected")
+            raise
         except Exception as e:
             logger.exception("generation failed")
+            if tracker is not None:
+                tracker.finish(error=str(e))
             return self._error(500, f"generation failed: {e}", "server_error")
         if parser is not None:
             out = parser.flush()
@@ -621,17 +670,26 @@ class HttpService:
                              "finish_reason": finish or "stop"}],
                 "usage": usage,
             }
-        return web.json_response(payload)
+        headers = {}
+        if tracker is not None:
+            tracker.add_tool_calls(tool_calls)
+            tracker.finish(finish_reason=(payload["choices"][0]
+                                          .get("finish_reason")))
+            headers["X-Request-Id"] = tracker.x_request_id
+        return web.json_response(payload, headers=headers)
 
     async def _stream_response(self, request: web.Request,
                                pipeline: ModelPipeline, req, token,
                                chat: bool, model: str, parser=None,
                                include_usage: bool = False,
-                               ) -> web.StreamResponse:
-        resp = web.StreamResponse(headers={
+                               tracker=None) -> web.StreamResponse:
+        hdrs = {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
-        })
+        }
+        if tracker is not None:
+            hdrs["X-Request-Id"] = tracker.x_request_id
+        resp = web.StreamResponse(headers=hdrs)
         await resp.prepare(request)
         rid = req.request_id
         created = int(time.time())
@@ -675,10 +733,17 @@ class HttpService:
         ntok = 0
         saw_tools = False
         disconnected = False
+        final_finish = None
         probe = _LatencyProbe(self._m_requests, model)
         try:
-            async for d in pipeline.generate_deltas(req, token=token):
+            async for d in pipeline.generate_deltas(req, token=token,
+                                                    tracker=tracker):
+                if tracker is not None and ntok == 0 and d.token_count:
+                    tracker.cached_tokens = self._kv_overlap_tokens(
+                        pipeline, req.request_id)
                 probe.on_delta(d.token_count)
+                if tracker is not None:
+                    tracker.on_tokens(d.token_count)
                 ntok += d.token_count
                 finish = d.finish_reason
                 text, reasoning, calls = d.text, "", None
@@ -695,20 +760,29 @@ class HttpService:
                     if finish is not None and saw_tools:
                         finish = "tool_calls"
                 if text or reasoning or calls or finish or first:
+                    if calls and tracker is not None:
+                        tracker.add_tool_calls(calls)
                     await resp.write(chunk(text, finish, first,
                                            reasoning=reasoning,
                                            tool_calls=calls))
                     first = False
                 if d.finish_reason:
+                    final_finish = finish or d.finish_reason
                     break
             if include_usage:
                 await resp.write(usage_chunk(ntok))
             await resp.write(b"data: [DONE]\n\n")
+            if tracker is not None:
+                tracker.finish(finish_reason=final_finish)
         except (ConnectionResetError, asyncio.CancelledError):
             token.kill()  # client went away; stop the engine
             disconnected = True
+            if tracker is not None:
+                tracker.finish(error="client_disconnected")
         except Exception as e:
             logger.exception("stream failed")
+            if tracker is not None:
+                tracker.finish(error=str(e))
             err = {"error": {"message": str(e), "type": "server_error"}}
             try:
                 await resp.write(f"data: {json.dumps(err)}\n\n".encode())
@@ -734,3 +808,4 @@ class HttpService:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        self.trace_sink.close()

@@ -75,8 +75,17 @@ class MockerWorker:
         async def generate_handler(payload, ctx):
             request = PreprocessedRequest.from_dict(payload)
             assert self.engine is not None
+            ntok = 0
             async for out in self.engine.generate(request, token=ctx.token):
+                ntok += len(out.token_ids)
                 yield out.to_dict()
+            # trace join (same contract as the JAX engine worker)
+            tp = next((a.split(":", 1)[1] for a in request.annotations
+                       if a.startswith("traceparent:")), None)
+            if tp is not None:
+                logger.info("request served", extra={
+                    "request_id": request.request_id, "traceparent": tp,
+                    "output_tokens": ntok})
 
         async def clear_handler(payload, ctx):
             n = await self.engine.clear_kv_blocks()

@@ -168,8 +168,11 @@ class Client:
         instance_id: Optional[int] = None,
         token: Optional[CancellationToken] = None,
         ctx: Optional[Dict[str, Any]] = None,
+        on_pick=None,
     ) -> AsyncIterator[Any]:
-        """Route a request and yield the response stream."""
+        """Route a request and yield the response stream.  `on_pick` is
+        told the chosen instance id (request tracing needs the placement
+        even when this client's own router decides it)."""
         if not self._instances:
             await self.wait_for_instances()
         if instance_id is not None:
@@ -178,6 +181,8 @@ class Client:
                 raise RuntimeError(f"instance {instance_id} not found for {self.endpoint.path}")
         else:
             inst = self.router.pick(self.instances)
+        if on_pick is not None:
+            on_pick(inst.instance_id)
         self.router.on_dispatch(inst.instance_id)
         try:
             async for item in self.runtime.request_client.stream(

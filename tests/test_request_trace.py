@@ -1,0 +1,154 @@
+"""Request tracing: per-request JSONL records, x-request-id echo,
+traceparent propagation (ref: lib/llm/src/request_trace/)."""
+
+import asyncio
+import json
+import uuid
+
+import aiohttp
+
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.frontend.request_trace import (
+    RequestTracker,
+    TraceConfig,
+    TraceSink,
+    parse_traceparent,
+)
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig
+
+
+def fresh_runtime() -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+# --------------------------- unit: traceparent ------------------------------
+
+
+def test_parse_traceparent():
+    tid, span = parse_traceparent(
+        "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01")
+    assert tid == "0af7651916cd43dd8448eb211c80319c"
+    assert span == "b7ad6b7169203331"
+    assert parse_traceparent(None) == (None, None)
+    assert parse_traceparent("junk") == (None, None)
+    # all-zero ids are invalid per W3C
+    assert parse_traceparent(
+        "00-00000000000000000000000000000000-b7ad6b7169203331-01"
+    ) == (None, None)
+
+
+def test_tracker_record_shape(tmp_path):
+    sink = TraceSink(TraceConfig(enabled=True,
+                                 file_path=str(tmp_path / "t.jsonl")))
+    tr = RequestTracker(request_id="r1", model="m", sink=sink,
+                        input_tokens=10, session_id="sess",
+                        trace_id="a" * 32, parent_span_id="b" * 16)
+    tr.on_dispatch(101)
+    tr.on_tokens(1)
+    tr.on_tokens(3)
+    tr.cached_tokens = 5
+    rec = tr.finish(finish_reason="stop")
+    sink.close()
+    assert rec["schema"] == "dynamo.request.trace.v1"
+    assert rec["event_type"] == "request_end"
+    r = rec["request"]
+    assert r["input_tokens"] == 10 and r["output_tokens"] == 4
+    assert r["worker"]["decode_worker_id"] == 101
+    assert r["kv_hit_rate"] == 0.5
+    assert r["finish_reason_metadata"]["finish_reason"] == "stop"
+    assert rec["trace"]["trace_id"] == "a" * 32
+    assert rec["trace"]["parent_span_id"] == "b" * 16
+    assert rec["agent_context"]["session_id"] == "sess"
+    assert "ttft_ms" in r and "avg_itl_ms" in r and "total_time_ms" in r
+    # written to the file sink
+    lines = (tmp_path / "t.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0]) == rec
+
+
+def test_tracker_migration_counting():
+    tr = RequestTracker(request_id="r", model="m")
+    tr.on_dispatch(1)
+    tr.on_dispatch(2)  # migrated
+    rec = tr.finish(error="worker died twice")
+    assert rec["request"]["migrations"] == 1
+    assert rec["request"]["worker"]["decode_worker_id"] == 2
+    assert rec["request"]["error"] == "worker died twice"
+
+
+def test_disabled_sink_emits_nothing(tmp_path):
+    path = tmp_path / "none.jsonl"
+    sink = TraceSink(TraceConfig(enabled=False, file_path=str(path)))
+    RequestTracker(request_id="r", model="m", sink=sink).finish()
+    sink.close()
+    assert not path.exists()
+
+
+# --------------------------- HTTP e2e ---------------------------------------
+
+
+async def test_http_trace_end_to_end(tmp_path, monkeypatch):
+    trace_file = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("DYN_REQUEST_TRACE", "1")
+    monkeypatch.setenv("DYN_REQUEST_TRACE_FILE_PATH", str(trace_file))
+
+    rt = await fresh_runtime().start()
+    model = "trace-model"
+    args = MockEngineArgs(model_name=model, block_size=4,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0)
+    worker = await MockerWorker(rt, args).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        if manager.get(model):
+            break
+        await asyncio.sleep(0.02)
+    try:
+        body = {"model": model,
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 6, "ignore_eos": True}
+        headers = {
+            "x-request-id": "client-chose-this",
+            "traceparent":
+                "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+            "x-session-id": "agent-7",
+        }
+        async with aiohttp.ClientSession() as s:
+            # unary
+            async with s.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                              json=body, headers=headers) as r:
+                assert r.status == 200
+                assert r.headers["X-Request-Id"] == "client-chose-this"
+            # streaming
+            async with s.post(f"http://127.0.0.1:{port}/v1/chat/completions",
+                              json={**body, "stream": True},
+                              headers=headers) as r:
+                assert r.status == 200
+                assert r.headers["X-Request-Id"] == "client-chose-this"
+                await r.read()
+        recs = [json.loads(x) for x in
+                trace_file.read_text().strip().splitlines()]
+        assert len(recs) == 2
+        for rec in recs:
+            assert rec["schema"] == "dynamo.request.trace.v1"
+            r = rec["request"]
+            assert r["x_request_id"] == "client-chose-this"
+            assert r["model"] == model
+            assert r["output_tokens"] == 6
+            assert r["worker"]["decode_worker_id"] == \
+                worker.served.instance_id
+            assert rec["trace"]["trace_id"] == \
+                "0af7651916cd43dd8448eb211c80319c"
+            assert rec["agent_context"]["session_id"] == "agent-7"
+            assert r["finish_reason_metadata"]["finish_reason"] == "length"
+            assert r["ttft_ms"] >= 0.0 and r["total_time_ms"] > 0.0
+    finally:
+        await service.close()
+        await watcher.close()
+        await worker.close()
+        await rt.shutdown()
