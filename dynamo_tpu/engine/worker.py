@@ -245,10 +245,13 @@ class JaxEngineWorker:
             await self.engine.release_parked(rid)
 
         comp = rt.namespace(self.namespace).component(self.component)
+        from ..protocols.llm import CANARY_GENERATE_PAYLOAD
+
         self.served = await comp.endpoint("generate").serve_endpoint(
             generate_handler,
             metadata={"model": self.config.served_name},
             instance_id=instance_id,
+            health_check_payload=CANARY_GENERATE_PAYLOAD,
         )
         self._aux_served = [
             await comp.endpoint("clear_kv_blocks").serve_endpoint(

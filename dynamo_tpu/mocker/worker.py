@@ -105,10 +105,13 @@ class MockerWorker:
             vec = vec / np.linalg.norm(vec)
             yield {"embedding": vec.tolist(), "dim": 32}
 
+        from ..protocols.llm import CANARY_GENERATE_PAYLOAD
+
         self.served = await gen_ep.serve_endpoint(
             generate_handler,
             metadata={"model": self.args.model_name, "role": self.args.role},
             instance_id=instance_id,
+            health_check_payload=CANARY_GENERATE_PAYLOAD,
         )
         self._aux_served = [
             await comp.endpoint("clear_kv_blocks").serve_endpoint(

@@ -75,6 +75,15 @@ class StopConditions:
         )
 
 
+# minimal liveness probe riding the real generate path (ref
+# health_check.rs canary payloads): 2-token prompt, 1 greedy token out
+CANARY_GENERATE_PAYLOAD: Dict[str, Any] = {
+    "token_ids": [1, 2],
+    "stop": {"max_tokens": 1, "ignore_eos": True},
+    "annotations": ["canary"],
+}
+
+
 @dataclass
 class PreprocessedRequest:
     """Tokenized request, ready for an engine (ref: protocols PreprocessedRequest)."""

@@ -58,6 +58,8 @@ class ServedEndpoint:
 
     async def shutdown(self) -> None:
         rt = self.endpoint.runtime
+        await rt.system_health.deregister_target(
+            self.endpoint.path, self.instance.instance_id)
         await rt.discovery.delete(self.instance.key())
         rt.request_server.deregister_handler(
             self.endpoint.path, self.instance.instance_id
@@ -82,8 +84,14 @@ class Endpoint:
         handler: Handler,
         metadata: Optional[Dict[str, Any]] = None,
         instance_id: Optional[int] = None,
+        health_check_payload: Optional[Dict[str, Any]] = None,
     ) -> ServedEndpoint:
-        """Register `handler` (async generator fn) and announce the instance."""
+        """Register `handler` (async generator fn) and announce the instance.
+
+        `health_check_payload` arms a canary for the endpoint: after
+        DYN_CANARY_WAIT_S of inactivity the payload (with a fresh
+        request_id) is run through the handler; failure marks the process
+        unhealthy and withdraws its discovery lease (health_check.py)."""
         rt = self.runtime
         address = await rt.request_server.start()
         iid = instance_id if instance_id is not None else new_instance_id()
@@ -96,6 +104,9 @@ class Endpoint:
             metadata=metadata or {},
         )
         rt.request_server.register_handler(self.path, handler, iid)
+        if health_check_payload is not None:
+            rt.system_health.register_target(self.path, iid,
+                                             health_check_payload)
         await rt.discovery.put(instance.key(), instance.to_dict())
         logger.info("serving endpoint %s as instance %d @ %s",
                     self.path, iid, address)

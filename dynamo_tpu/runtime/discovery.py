@@ -127,6 +127,39 @@ class DiscoveryBackend:
         """Drop every key registered under this backend instance's lease."""
         raise NotImplementedError
 
+    # -- health withdraw (runtime/health_check.py) ------------------------
+    # Backends populate `_owned_values` on leased puts so an unhealthy
+    # process can pull its instances out of discovery and put them back on
+    # recovery, without losing the registered values.
+    _owned_values: Dict[str, Dict[str, Any]]
+
+    def _forget_withdrawn(self, key: str) -> None:
+        """A real delete during the withdrawn window (endpoint shutdown)
+        must not be resurrected by restore_lease."""
+        getattr(self, "_withdrawn_values", {}).pop(key, None)
+
+    async def withdraw_lease(self) -> None:
+        """Temporarily remove every leased key (unhealthy process);
+        `restore_lease` re-registers them."""
+        # stash each key only after ITS delete: a concurrent legitimate
+        # delete (endpoint shutdown mid-withdraw) either empties the
+        # _owned_values slot before we process it (skipped below) or pops
+        # it from _withdrawn_values after we stashed it — never resurrected
+        self._withdrawn_values = {}
+        owned = getattr(self, "_owned_values", {})
+        for key in list(owned):
+            value = owned.get(key)
+            if value is None:
+                continue
+            await self.delete(key)
+            self._withdrawn_values[key] = value
+
+    async def restore_lease(self) -> None:
+        stash = getattr(self, "_withdrawn_values", {})
+        self._withdrawn_values = {}
+        for key, value in stash.items():
+            await self.put(key, value)
+
 
 # ---------------------------------------------------------------------------
 # In-memory backend (per-process clusters, the unit/integration test default)
@@ -152,16 +185,20 @@ class MemDiscovery(DiscoveryBackend):
         self.cluster_id = cluster_id
         self._cluster = _MEM_CLUSTERS.setdefault(cluster_id, _MemCluster())
         self._owned: set[str] = set()
+        self._owned_values: Dict[str, Dict[str, Any]] = {}
 
     async def put(self, key: str, value: Dict[str, Any], lease: bool = True) -> None:
         self._cluster.store[key] = value
         if lease:
             self._owned.add(key)
+            self._owned_values[key] = value
         self._cluster.notify(WatchEvent("put", key, value))
 
     async def delete(self, key: str) -> None:
         self._cluster.store.pop(key, None)
         self._owned.discard(key)
+        self._owned_values.pop(key, None)
+        self._forget_withdrawn(key)
         self._cluster.notify(WatchEvent("delete", key))
 
     async def get_prefix(self, prefix: str) -> Dict[str, Dict[str, Any]]:
@@ -218,6 +255,7 @@ class FileDiscovery(DiscoveryBackend):
         self.ttl_s = ttl_s
         self.poll_s = poll_s
         self._owned: set[str] = set()
+        self._owned_values: Dict[str, Dict[str, Any]] = {}
         self._hb_task: Optional[asyncio.Task] = None
         self._closed = asyncio.Event()
         os.makedirs(root, exist_ok=True)
@@ -252,9 +290,12 @@ class FileDiscovery(DiscoveryBackend):
         os.replace(tmp, p)
         if lease:
             self._owned.add(key)
+            self._owned_values[key] = value
 
     async def delete(self, key: str) -> None:
         self._owned.discard(key)
+        self._owned_values.pop(key, None)
+        self._forget_withdrawn(key)
         try:
             os.unlink(self._path(key))
         except FileNotFoundError:

@@ -54,6 +54,10 @@ class DistributedRuntime:
         )
         self.request_client = RequestPlaneClient()
         self.metrics = MetricsHierarchy(namespace=self.config.namespace)
+        from .health_check import SystemHealth
+
+        self.system_health = SystemHealth(self)
+        self.request_server.on_activity = self.system_health.notify_activity
         self._system_server = None
         self._closed = False
 
@@ -79,6 +83,7 @@ class DistributedRuntime:
             return
         self._closed = True
         self.root_token.kill()
+        await self.system_health.close()
         if self._system_server is not None:
             await self._system_server.close()
         await self.request_client.close()

@@ -64,6 +64,8 @@ class EtcdDiscovery(DiscoveryBackend):
         # leased key -> last value, so an expired lease (partition longer
         # than TTL) can re-register everything under a fresh lease
         self._owned: Dict[str, Dict[str, Any]] = {}
+        # health withdraw/restore (DiscoveryBackend base) reads this
+        self._owned_values = self._owned
 
     # -- transport --------------------------------------------------------
 
@@ -155,6 +157,7 @@ class EtcdDiscovery(DiscoveryBackend):
 
     async def delete(self, key: str) -> None:
         self._owned.pop(key, None)
+        self._forget_withdrawn(key)
         await self._call("/v3/kv/deleterange", {"key": _b64(key.encode())})
 
     async def _range(self, prefix: str):

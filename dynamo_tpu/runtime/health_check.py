@@ -1,0 +1,225 @@
+"""Canary health checks: detect alive-but-wedged workers.
+
+Ref: lib/runtime/src/health_check.rs (HealthCheckManager) — lease expiry
+catches dead processes, but a process whose engine is wedged (stuck
+compile, deadlocked loop, hung collective) keeps its lease alive forever
+while every routed request times out.  The canary closes that gap: per
+served endpoint, a timer armed by inactivity sends a real (tiny) request
+through the endpoint's own handler; success proves the full serve path,
+failure or timeout marks the endpoint NotReady.
+
+TPU-native consequence handling goes one step further than the
+reference's status flag: when the process turns unhealthy, its discovery
+lease is *withdrawn* (DYN_HEALTH_WITHDRAW, default on), so routers purge
+the instance immediately and in-flight requests migrate — no operator
+probe required.  Recovery (a later canary succeeding) restores the lease
+and the worker rejoins the fleet.
+
+Activity resets the timer: any successfully streamed response frame on
+the endpoint proves health for free (ref health_check.rs:120-130), so a
+busy worker is never canaried.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class HealthCheckConfig:
+    canary_wait_s: float = 30.0      # idle time before a canary fires
+    request_timeout_s: float = 10.0  # canary must finish within this
+    withdraw: bool = True            # unhealthy -> drop discovery lease
+
+    @staticmethod
+    def from_env() -> "HealthCheckConfig":
+        return HealthCheckConfig(
+            canary_wait_s=float(os.environ.get("DYN_CANARY_WAIT_S", 30.0)),
+            request_timeout_s=float(
+                os.environ.get("DYN_HEALTH_CHECK_TIMEOUT_S", 10.0)),
+            withdraw=os.environ.get("DYN_HEALTH_WITHDRAW", "1").lower()
+            in ("1", "true", "yes", "on"),
+        )
+
+
+@dataclass
+class _Target:
+    path: str
+    instance_id: Optional[int]
+    payload: Dict[str, Any]          # template; request_id minted per probe
+    ready: bool = True
+    last_result_t: float = 0.0
+    activity: asyncio.Event = field(default_factory=asyncio.Event)
+    task: Optional[asyncio.Task] = None
+
+    @property
+    def subject(self) -> str:
+        return f"{self.path}:{self.instance_id}"
+
+
+class SystemHealth:
+    """Per-process endpoint health registry + canary scheduler."""
+
+    def __init__(self, runtime, config: Optional[HealthCheckConfig] = None):
+        self.runtime = runtime
+        self.config = config or HealthCheckConfig.from_env()
+        self.targets: Dict[str, _Target] = {}
+        self._withdrawn = False
+        self._lease_lock: Optional[asyncio.Lock] = None
+        self._reconcile_tasks: set = set()  # strong refs (GC pitfall)
+
+    # -- registration (Endpoint.serve_endpoint) ---------------------------
+    def register_target(self, path: str, instance_id: Optional[int],
+                        payload: Dict[str, Any]) -> None:
+        t = _Target(path=path, instance_id=instance_id, payload=payload)
+        self.targets[t.subject] = t
+        t.task = asyncio.get_running_loop().create_task(
+            self._canary_loop(t))
+        logger.info("canary armed for %s (wait %.0fs)", t.subject,
+                    self.config.canary_wait_s)
+
+    async def deregister_target(self, path: str,
+                                instance_id: Optional[int]) -> None:
+        t = self.targets.pop(f"{path}:{instance_id}", None)
+        if t is not None and t.task is not None:
+            t.task.cancel()
+            try:
+                await t.task
+            except asyncio.CancelledError:
+                pass
+        # dropping a not-ready target can flip aggregate health
+        self._maybe_reconcile()
+
+    async def close(self) -> None:
+        for t in list(self.targets.values()):
+            await self.deregister_target(t.path, t.instance_id)
+        for task in list(self._reconcile_tasks):
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    # -- signals ----------------------------------------------------------
+    def notify_activity(self, path: str,
+                        instance_id: Optional[int]) -> None:
+        """A response frame streamed successfully on this endpoint: reset
+        the canary timer and count as proof of health."""
+        t = self.targets.get(f"{path}:{instance_id}")
+        if t is not None:
+            t.activity.set()
+            if not t.ready:
+                self._set_ready(t, True)
+
+    @property
+    def healthy(self) -> bool:
+        return all(t.ready for t in self.targets.values())
+
+    def statuses(self) -> Dict[str, str]:
+        return {t.subject: ("ready" if t.ready else "not_ready")
+                for t in self.targets.values()}
+
+    # -- canary machinery -------------------------------------------------
+    async def _canary_loop(self, t: _Target) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(t.activity.wait(),
+                                       timeout=self.config.canary_wait_s)
+                t.activity.clear()
+                continue  # organic traffic proved health; re-arm
+            except asyncio.TimeoutError:
+                pass
+            ok = await self._probe(t)
+            t.last_result_t = time.monotonic()
+            if ok != t.ready:
+                self._set_ready(t, ok)
+            else:
+                # retry a reconcile that failed earlier (e.g. transient
+                # discovery outage): every probe re-checks desired state
+                self._maybe_reconcile()
+            # on failure keep probing at the same cadence so recovery is
+            # detected (ref health_check.rs keeps the task alive)
+
+    async def _probe(self, t: _Target) -> bool:
+        from .cancellation import CancellationToken
+        from .request_plane import RequestContext
+
+        handler = self.runtime.request_server._resolve_handler(
+            t.path, t.instance_id)
+        if handler is None:
+            return False
+        payload = {**t.payload, "request_id": f"canary-{secrets.token_hex(6)}"}
+        token = CancellationToken()
+        ctx = RequestContext(payload["request_id"], token,
+                             {"canary": True})
+
+        async def drain() -> bool:
+            async for item in handler(payload, ctx):
+                if isinstance(item, dict) and (
+                        item.get("finish_reason") == "error"
+                        or "error" in item and item["error"]):
+                    return False
+            return True
+
+        try:
+            return await asyncio.wait_for(
+                drain(), timeout=self.config.request_timeout_s)
+        except asyncio.TimeoutError:
+            token.kill()  # free whatever the wedged canary holds
+            logger.warning("canary timed out on %s", t.subject)
+            return False
+        except Exception:
+            logger.warning("canary failed on %s", t.subject, exc_info=True)
+            return False
+        finally:
+            token.detach()
+
+    def _set_ready(self, t: _Target, ready: bool) -> None:
+        t.ready = ready
+        logger.warning("endpoint %s -> %s", t.subject,
+                       "ready" if ready else "NOT READY")
+        m = self.runtime.metrics.scoped(component="health")
+        m.inc("dynamo_health_transitions_total",
+              endpoint=t.path, to="ready" if ready else "not_ready")
+        self._maybe_reconcile()
+
+    def _maybe_reconcile(self) -> None:
+        if not self.config.withdraw or self._withdrawn == (not self.healthy):
+            return
+        task = asyncio.get_running_loop().create_task(
+            self._reconcile_lease())
+        self._reconcile_tasks.add(task)
+        task.add_done_callback(self._reconcile_tasks.discard)
+
+    async def _reconcile_lease(self) -> None:
+        """Withdraw the process's discovery lease while unhealthy; restore
+        it when every endpoint is ready again.  Serialized by a lock —
+        rapid flaps (withdraw mid-flight when health recovers) must not
+        interleave the backend's per-key awaits — and _withdrawn only
+        advances after the backend call succeeds, so a failed attempt is
+        retried by the next probe's _maybe_reconcile."""
+        if self._lease_lock is None:
+            self._lease_lock = asyncio.Lock()
+        async with self._lease_lock:
+            want_withdrawn = not self.healthy  # re-read under the lock
+            if want_withdrawn == self._withdrawn:
+                return
+            try:
+                if want_withdrawn:
+                    logger.warning("withdrawing discovery lease (unhealthy)")
+                    await self.runtime.discovery.withdraw_lease()
+                else:
+                    logger.warning("restoring discovery lease (recovered)")
+                    await self.runtime.discovery.restore_lease()
+                self._withdrawn = want_withdrawn
+            except Exception:
+                logger.exception("lease reconcile failed (will retry on "
+                                 "next canary result)")

@@ -53,6 +53,9 @@ class RequestPlaneServer:
         self.address: Optional[str] = None
         self._conn_tasks: set[asyncio.Task] = set()
         self._start_lock: Optional[asyncio.Lock] = None
+        # on_activity(path, instance_id): every successfully streamed
+        # response frame resets the endpoint's canary (health_check.py)
+        self.on_activity = None
 
     def register_handler(self, path: str, handler: Handler,
                          instance_id: Optional[int] = None) -> None:
@@ -167,6 +170,8 @@ class RequestPlaneServer:
         try:
             async for item in handler(frame.get("payload"), ctx):
                 await send({"t": "data", "id": rid, "data": item})
+                if self.on_activity is not None:
+                    self.on_activity(path, frame.get("iid"))
             await send({"t": "end", "id": rid})
         except asyncio.CancelledError:
             # always terminate the stream, even on kill — the client may be

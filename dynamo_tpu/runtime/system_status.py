@@ -23,10 +23,15 @@ class SystemStatusServer:
         self._runner = None
 
     async def _health(self, request: web.Request) -> web.Response:
-        healthy = not self.runtime.root_token.is_stopped()
+        shutting_down = self.runtime.root_token.is_stopped()
+        canaries_ok = self.runtime.system_health.healthy
+        healthy = not shutting_down and canaries_ok
+        status = ("shutting_down" if shutting_down
+                  else "healthy" if canaries_ok else "unhealthy")
         return web.json_response(
-            {"status": "healthy" if healthy else "shutting_down",
-             "worker_id": self.runtime.worker_id},
+            {"status": status,
+             "worker_id": self.runtime.worker_id,
+             "endpoints": self.runtime.system_health.statuses()},
             status=200 if healthy else 503,
         )
 

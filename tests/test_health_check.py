@@ -1,0 +1,168 @@
+"""Canary health checks: wedged-worker detection, lease withdraw/restore
+(ref: lib/runtime/src/health_check.rs)."""
+
+import asyncio
+import uuid
+
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+from dynamo_tpu.runtime.health_check import HealthCheckConfig
+
+
+def fresh_runtime(**health_kw) -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+    if health_kw:
+        rt.system_health.config = HealthCheckConfig(**health_kw)
+    return rt
+
+
+async def test_canary_passes_on_healthy_worker():
+    rt = await fresh_runtime(canary_wait_s=0.1, request_timeout_s=2.0).start()
+    try:
+        args = MockEngineArgs(model_name="m", block_size=4,
+                              base_step_s=0.0005)
+        w = await MockerWorker(rt, args).start()
+        assert rt.system_health.healthy
+        # let at least one canary fire (no organic traffic)
+        target = next(iter(rt.system_health.targets.values()))
+        for _ in range(100):
+            if target.last_result_t:
+                break
+            await asyncio.sleep(0.05)
+        assert target.last_result_t > 0, "canary never fired"
+        assert rt.system_health.healthy
+        statuses = rt.system_health.statuses()
+        assert all(v == "ready" for v in statuses.values())
+        await w.close()
+        # closing deregisters the canary
+        assert not any("generate" in s for s in rt.system_health.targets)
+    finally:
+        await rt.shutdown()
+
+
+async def test_wedged_worker_withdraws_lease_and_recovers():
+    """Fault injection: the engine hangs -> canary times out -> instance
+    vanishes from discovery; engine unwedges -> canary passes -> instance
+    returns."""
+    rt = await fresh_runtime(canary_wait_s=0.1,
+                             request_timeout_s=0.3).start()
+    try:
+        args = MockEngineArgs(model_name="m", block_size=4,
+                              base_step_s=0.0005)
+        w = await MockerWorker(rt, args).start()
+        key = w.served.instance.key()
+        assert key in await rt.discovery.get_prefix("v1/instances")
+
+        # wedge: replace the handler's engine.generate with one that
+        # never yields (simulates a stuck device loop)
+        real_generate = w.engine.generate
+        wedged = asyncio.Event()
+
+        async def hung_generate(request, token=None):
+            wedged.set()
+            await asyncio.sleep(3600)
+            yield  # pragma: no cover
+
+        w.engine.generate = hung_generate
+        for _ in range(200):
+            if not rt.system_health.healthy:
+                break
+            await asyncio.sleep(0.05)
+        assert not rt.system_health.healthy, "canary never tripped"
+        # lease withdrawn: instance gone from discovery
+        for _ in range(100):
+            if key not in await rt.discovery.get_prefix("v1/instances"):
+                break
+            await asyncio.sleep(0.05)
+        assert key not in await rt.discovery.get_prefix("v1/instances")
+
+        # recovery
+        w.engine.generate = real_generate
+        for _ in range(200):
+            if rt.system_health.healthy:
+                break
+            await asyncio.sleep(0.05)
+        assert rt.system_health.healthy, "canary never recovered"
+        for _ in range(100):
+            if key in await rt.discovery.get_prefix("v1/instances"):
+                break
+            await asyncio.sleep(0.05)
+        assert key in await rt.discovery.get_prefix("v1/instances")
+        await w.close()
+    finally:
+        await rt.shutdown()
+
+
+async def test_activity_resets_canary_timer():
+    """Organic traffic keeps the canary quiet (ref health_check.rs
+    notifier path): with steady requests, no canary fires."""
+    rt = await fresh_runtime(canary_wait_s=0.4,
+                             request_timeout_s=2.0).start()
+    try:
+        args = MockEngineArgs(model_name="m", block_size=4,
+                              base_step_s=0.0005, prefill_s_per_token=0.0,
+                              decode_s_per_seq=0.0)
+        w = await MockerWorker(rt, args).start()
+        client = await (rt.namespace("dynamo").component("mocker")
+                        .endpoint("generate").client()).start()
+        await client.wait_for_instances()
+        target = next(t for t in rt.system_health.targets.values()
+                      if t.path.endswith("generate"))
+        # steady traffic for ~1.2s (3x the canary wait)
+        for i in range(8):
+            async for _ in client.generate(
+                    {"token_ids": [1, 2, 3], "request_id": f"r{i}",
+                     "stop": {"max_tokens": 2, "ignore_eos": True}}):
+                pass
+            await asyncio.sleep(0.15)
+        assert target.last_result_t == 0.0, "canary fired despite traffic"
+        assert rt.system_health.healthy
+        await client.close()
+        await w.close()
+    finally:
+        await rt.shutdown()
+
+
+async def test_system_status_health_reflects_canaries():
+    import socket
+
+    import aiohttp
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        free_port = sock.getsockname()[1]
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc",
+                        system_port=free_port)
+    rt = DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+    rt.system_health.config = HealthCheckConfig(canary_wait_s=0.1,
+                                                request_timeout_s=0.3)
+    await rt.start()
+    try:
+        port = free_port
+        args = MockEngineArgs(model_name="m", block_size=4,
+                              base_step_s=0.0005)
+        w = await MockerWorker(rt, args).start()
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/health") as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["status"] == "healthy"
+                assert any(k.endswith(str(w.served.instance_id))
+                           for k in body["endpoints"])
+
+            async def hung(request, token=None):
+                await asyncio.sleep(3600)
+                yield  # pragma: no cover
+
+            w.engine.generate = hung
+            for _ in range(200):
+                if not rt.system_health.healthy:
+                    break
+                await asyncio.sleep(0.05)
+            async with s.get(f"http://127.0.0.1:{port}/health") as r:
+                assert r.status == 503
+                assert (await r.json())["status"] == "unhealthy"
+        await w.close()
+    finally:
+        await rt.shutdown()
