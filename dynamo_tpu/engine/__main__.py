@@ -49,6 +49,10 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--reasoning-parser", default="",
                    help="advertise a reasoning parser (e.g. deepseek_r1) "
                         "so frontends split <think> spans")
+    p.add_argument("--lora-dir", default=os.environ.get("DYN_LORA_PATH", ""),
+                   help="PEFT adapter tree (lora/source.py); empty = off")
+    p.add_argument("--lora-max-adapters", type=int, default=4)
+    p.add_argument("--lora-rank", type=int, default=16)
     return p
 
 
@@ -71,6 +75,9 @@ async def main() -> None:
         disk_cache_blocks=args.disk_cache_blocks,
         role=args.role,
         reasoning_parser=args.reasoning_parser,
+        lora_dir=args.lora_dir or None,
+        lora_max_adapters=(args.lora_max_adapters if args.lora_dir else 0),
+        lora_rank=args.lora_rank,
     )
     rt = await DistributedRuntime.detached().start()
     worker = await JaxEngineWorker(

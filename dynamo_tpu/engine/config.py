@@ -75,6 +75,14 @@ class EngineConfig:
     # (disagg/transfer.py iter_chunks)
     transfer_chunk_bytes: int = DEFAULT_CHUNK_BYTES
 
+    # LoRA serving (lora/): 0 disables.  max_adapters counts usable slots
+    # (slot 0 is reserved for "no adapter"); adapters load lazily from
+    # lora_dir (shared PEFT checkpoint tree) on first request and evict
+    # LRU.  Ranks are padded to lora_rank; larger ranks are rejected.
+    lora_max_adapters: int = 0
+    lora_rank: int = 16
+    lora_dir: Optional[str] = None
+
     # parallelism
     dp: int = 1
     tp: int = 1

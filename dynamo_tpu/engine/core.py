@@ -89,6 +89,7 @@ class _Slot:
     finished: bool = False
     cancel_requested: bool = False
     cached_tokens: int = 0   # prefix-cache reuse (for metrics)
+    lora_idx: int = 0        # adapter bank slot (0 = no adapter)
     enqueued_t: float = 0.0
     first_token_t: float = 0.0
     last_push_t: float = 0.0  # previous streamed-token time (ITL EMA)
@@ -186,6 +187,37 @@ class JaxEngine:
             config.offload_watermark_blocks or config.num_blocks // 4
         )
 
+        # LoRA: stacked adapter bank + name->slot registry (lora/bank.py).
+        # Slot 0 is the all-zeros no-adapter slot; adapters load lazily
+        # from lora_dir on first request and evict LRU among slots not
+        # referenced by active sequences.
+        self.lora_bank = None
+        self._lora_slots: Dict[str, int] = {}   # name -> bank slot (>=1)
+        self._lora_lru: List[str] = []          # LRU order, oldest first
+        self._lora_pins: Dict[int, int] = {}    # slot -> resolved-not-
+        #                                         yet-enqueued requests
+        self._lora_source = None
+        if config.lora_max_adapters > 0:
+            if "lora_bank" not in inspect.signature(
+                    self.family.prefill).parameters:
+                raise ValueError(
+                    f"model family {self.model_cfg.name!r} does not "
+                    "support LoRA serving")
+            if step_sink is not None:
+                raise ValueError(
+                    "LoRA + multihost step replay is not supported yet: "
+                    "adapter bank mutations do not ride the step stream")
+            from ..lora.bank import empty_bank
+            from ..lora.source import LocalLoraSource
+
+            mc = self.model_cfg
+            self.lora_bank = empty_bank(
+                mc.n_layers, config.lora_max_adapters + 1,
+                config.lora_rank, mc.d_model, mc.q_dim, mc.kv_dim,
+                dtype=mc.dtype)
+            if config.lora_dir:
+                self._lora_source = LocalLoraSource(config.lora_dir)
+
         with self.mesh:
             if params is None and config.model_path:
                 from ..models.loader import load_params
@@ -280,16 +312,19 @@ class JaxEngine:
     @staticmethod
     def _decode_impl(family, model_cfg, mesh, greedy, params, kv, chain,
                      use_chain, tokens, positions, block_tables, ctx_lens,
-                     seeds, steps, temps, top_ks, top_ps, valid):
+                     seeds, steps, temps, top_ks, top_ps, valid,
+                     lora_bank=None, lidx=None):
         """chain/use_chain: device-resident token chaining — lanes whose
         previous burst is still unread take their input token from the
         prior burst's on-device output instead of a host round-trip.
         `greedy` is a static specialization: an all-greedy batch skips the
         sampling machinery (sampler.py greedy_tokens)."""
         tokens = jnp.where(use_chain, chain, tokens)
+        lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
+                   if lora_bank is not None else {})
         logits, kv = family.decode(
             params, model_cfg, kv, tokens, positions, block_tables,
-            ctx_lens, valid=valid, mesh=mesh,
+            ctx_lens, valid=valid, mesh=mesh, **lora_kw,
         )
         if greedy:
             next_tokens = greedy_tokens(logits)
@@ -302,7 +337,8 @@ class JaxEngine:
     def _decode_multi_impl(family, model_cfg, mesh, greedy, num_steps,
                            params, kv, chain, use_chain, tokens, positions,
                            block_tables, ctx_lens, seeds, steps, temps,
-                           top_ks, top_ps, valid):
+                           top_ks, top_ps, valid, lora_bank=None,
+                           lidx=None):
         """num_steps fused decode steps (family decode_multi); sampling
         streams stay per-token identical to the single-step path (seed
         folded with the running step counter)."""
@@ -314,9 +350,12 @@ class JaxEngine:
                 return sample_tokens(logits, seeds, steps + step_idx,
                                      temps, top_ks, top_ps)
 
+        lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
+                   if lora_bank is not None else {})
         return family.decode_multi(
             params, model_cfg, kv, tokens, positions, block_tables,
             ctx_lens, num_steps, sample_fn, valid=valid, mesh=mesh,
+            **lora_kw,
         )
 
     @staticmethod
@@ -350,10 +389,12 @@ class JaxEngine:
     @staticmethod
     def _prefill_impl(family, model_cfg, params, kv, tokens, positions,
                       block_table, ctx_len, true_len, seed, temp, top_k,
-                      top_p):
+                      top_p, lora_bank=None, lidx=None):
+        lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
+                   if lora_bank is not None else {})
         logits, kv = family.prefill(
             params, model_cfg, kv, tokens, positions, block_table,
-            ctx_len, true_len,
+            ctx_len, true_len, **lora_kw,
         )
         tok = sample_tokens(
             logits[None], seed[None], jnp.zeros((1,), jnp.int32),
@@ -364,14 +405,17 @@ class JaxEngine:
     @staticmethod
     def _prefill_batched_impl(family, model_cfg, params, kv, toks,
                               positions, tables, ctx_lens, true_lens,
-                              seeds, temps, top_ks, top_ps):
+                              seeds, temps, top_ks, top_ps,
+                              lora_bank=None, lidx=None):
         """Multi-sequence chunked prefill (family prefill_batched):
         concurrent arrivals share one program instead of serializing B=1
         chunks.  First tokens are sampled per row; rows whose prompt is not
         finished this chunk have their sample discarded by the host."""
+        lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
+                   if lora_bank is not None else {})
         logits, kv = family.prefill_batched(
             params, model_cfg, kv, toks, positions, tables,
-            ctx_lens, true_lens,
+            ctx_lens, true_lens, **lora_kw,
         )
         tok = sample_tokens(
             logits, seeds, jnp.zeros(seeds.shape, jnp.int32), temps,
@@ -498,6 +542,27 @@ class JaxEngine:
                     logger.warning("KV pull failed for %s; local prefill "
                                    "fallback", request.request_id,
                                    exc_info=True)
+        lora_idx = 0
+        if request.lora_name:
+            if self.lora_bank is None:
+                # serving the base model labeled as the adapter would be
+                # silently wrong output; fail loud so the frontend
+                # migrates / surfaces it
+                yield LLMEngineOutput(
+                    finish_reason="error",
+                    error=f"lora adapter {request.lora_name!r} requested "
+                          "but this worker has LoRA disabled "
+                          "(lora_max_adapters=0)",
+                )
+                return
+            try:
+                lora_idx = await self._resolve_lora(request.lora_name)
+            except Exception as e:
+                yield LLMEngineOutput(
+                    finish_reason="error",
+                    error=f"lora adapter {request.lora_name!r}: {e}",
+                )
+                return
         slot = _Slot(
             index=-1,
             request=request,
@@ -515,6 +580,7 @@ class JaxEngine:
                 # so a replayed/migrated request samples the same stream
                 else zlib.crc32(request.request_id.encode()) & 0x7FFFFFFF
             ),
+            lora_idx=lora_idx,
             enqueued_t=time.monotonic(),
         )
         from ..protocols.llm import DISAGG_ANNOTATION
@@ -525,6 +591,9 @@ class JaxEngine:
             slot.preloaded_first_token = dp.get("first_token")
         with self._qlock:
             self.waiting.append(slot)
+        if lora_idx:
+            # enqueued: the waiting/_slots scan now holds the reference
+            self._lora_pins[lora_idx] -= 1
         self._wake.set()
         from ..runtime.aio import CANCELLED, next_or_cancel
 
@@ -619,6 +688,74 @@ class JaxEngine:
             r = call()
             if inspect.isawaitable(r):
                 r.close()
+
+    async def _resolve_lora(self, name: str) -> int:
+        """Map an adapter name to its bank slot, lazily loading from
+        lora_dir on first use.  Eviction is LRU among adapters not
+        referenced by any active/waiting sequence OR pinned by a resolved
+        request that hasn't enqueued yet (the pin closes the window where
+        an eviction could silently swap the adapter under a request).
+        All registry mutations run on the scheduler thread; the file load
+        runs in an executor so streams never stall on it.
+        Ref: lora/cache.rs + controller.rs, collapsed into lazy
+        load-on-first-request (routing.py explains why no load RPCs)."""
+
+        def lookup() -> Optional[int]:
+            idx = self._lora_slots.get(name)
+            if idx is not None:
+                self._lora_lru.remove(name)
+                self._lora_lru.append(name)
+                self._lora_pins[idx] = self._lora_pins.get(idx, 0) + 1
+            return idx
+
+        idx = await self._call_on_scheduler(lookup)
+        if idx is not None:
+            return idx
+        if self._lora_source is None:
+            raise ValueError("unknown adapter (engine has no lora_dir)")
+        loop = asyncio.get_running_loop()
+        adapter = await loop.run_in_executor(
+            None,
+            lambda: self._lora_source.load(
+                name, self.model_cfg.n_layers
+            ).padded_to(self.config.lora_rank))
+
+        def install() -> int:
+            existing = self._lora_slots.get(name)
+            if existing is not None:  # raced with another request
+                self._lora_pins[existing] = \
+                    self._lora_pins.get(existing, 0) + 1
+                return existing
+            in_use = {s.lora_idx for s in self._slots if s is not None}
+            with self._qlock:
+                in_use |= {s.lora_idx for s in self.waiting}
+            in_use |= {i for i, c in self._lora_pins.items() if c > 0}
+            free = (set(range(1, self.config.lora_max_adapters + 1))
+                    - set(self._lora_slots.values()))
+            if free:
+                slot = min(free)
+            else:
+                victim = next(
+                    (n for n in self._lora_lru
+                     if self._lora_slots[n] not in in_use), None)
+                if victim is None:
+                    raise RuntimeError(
+                        "all adapter slots are referenced by active "
+                        "sequences; raise lora_max_adapters")
+                slot = self._lora_slots.pop(victim)
+                self._lora_lru.remove(victim)
+            from ..lora.bank import write_adapter
+
+            self.lora_bank = write_adapter(self.lora_bank, slot,
+                                           adapter.tensors)
+            self._lora_slots[name] = slot
+            self._lora_lru.append(name)
+            self._lora_pins[slot] = self._lora_pins.get(slot, 0) + 1
+            logger.info("lora adapter %r loaded into slot %d (rank %d)",
+                        name, slot, adapter.rank)
+            return slot
+
+        return await self._call_on_scheduler(install)
 
     def _call_on_scheduler(self, fn) -> asyncio.Future:
         """Run `fn()` between scheduler steps (the allocator and KV cache are
@@ -1007,12 +1144,16 @@ class JaxEngine:
                 "true_lens": true_lens, "seeds": seeds, "temps": temps,
                 "top_ks": top_ks, "top_ps": top_ps,
             })
+        lidx = np.zeros(Bp, np.int32)
+        for i, (slot, _) in enumerate(zip(pslots, chunks)):
+            lidx[i] = slot.lora_idx
         tok, self.kv = self._jit_prefill_batched(
             self.params, self.kv,
             jnp.asarray(toks), jnp.asarray(positions), jnp.asarray(tables),
             jnp.asarray(ctx_lens), jnp.asarray(true_lens),
             jnp.asarray(seeds), jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
+            jnp.asarray(top_ps), self.lora_bank,
+            jnp.asarray(lidx) if self.lora_bank is not None else None,
         )
         firsts = np.asarray(tok)
         for i, (slot, chunk) in enumerate(zip(pslots, chunks)):
@@ -1046,7 +1187,9 @@ class JaxEngine:
             jnp.int32(pos), jnp.int32(chunk),
             jnp.int32(slot.sampling_seed),
             jnp.float32(s.temperature), jnp.int32(s.top_k),
-            jnp.float32(s.top_p),
+            jnp.float32(s.top_p), self.lora_bank,
+            jnp.int32(slot.lora_idx) if self.lora_bank is not None
+            else None,
         )
         self._finish_prefill_chunk(slot, chunk, int(tok))
 
@@ -1128,6 +1271,9 @@ class JaxEngine:
                 jnp.int32(prompt_len - 1), jnp.int32(1),
                 jnp.int32(slot.sampling_seed), jnp.float32(s.temperature),
                 jnp.int32(s.top_k), jnp.float32(s.top_p),
+                self.lora_bank,
+                jnp.int32(slot.lora_idx) if self.lora_bank is not None
+                else None,
             )
             first = int(tok)
         slot.preloaded_k = slot.preloaded_v = None
@@ -1291,6 +1437,11 @@ class JaxEngine:
             "seeds": seeds, "steps": steps, "temps": temps,
             "top_ks": top_ks, "top_ps": top_ps, "valid": valid,
         }
+        if self.lora_bank is not None:
+            lidx = np.zeros(B, np.int32)
+            for s in active:
+                lidx[s.index] = s.lora_idx
+            a["lidx"] = lidx
         if self.step_sink is not None:
             self.step_sink("decode_multi" if k > 1 else "decode", a)
         burst = self._dispatch_decode(k, a)
@@ -1326,6 +1477,8 @@ class JaxEngine:
             jnp.asarray(a["steps"]), jnp.asarray(a["temps"]),
             jnp.asarray(a["top_ks"]), jnp.asarray(a["top_ps"]),
             jnp.asarray(a["valid"]),
+            self.lora_bank,
+            jnp.asarray(a["lidx"]) if "lidx" in a else None,
         )
         if k > 1:
             burst, self.kv = self._jit_decode_multi[greedy](*args)

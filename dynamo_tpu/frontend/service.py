@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -388,9 +389,56 @@ class HttpService:
                             content_type="text/plain")
 
     async def h_models(self, request: web.Request) -> web.Response:
-        return web.json_response(
-            {"object": "list", "data": self.manager.list_models()}
-        )
+        data = self.manager.list_models()
+        for name, base in sorted(self._lora_adapters().items()):
+            data.append({"id": name, "object": "model",
+                         "owned_by": "dynamo_tpu", "created": 0,
+                         "parent": base})
+        return web.json_response({"object": "list", "data": data})
+
+    _LORA_SCAN_TTL_S = 5.0
+
+    def _lora_adapters(self) -> Dict[str, str]:
+        """adapter name -> base model, from the shared DYN_LORA_PATH tree
+        (the same tree workers lazy-load from — ref lora/source.rs).
+        Cached with a short TTL: the scan reads adapter_config.json per
+        adapter and must not run per request on the event loop."""
+        now = time.monotonic()
+        cached = getattr(self, "_lora_scan", None)
+        if cached is not None and now < cached[0]:
+            return cached[1]
+        root = os.environ.get("DYN_LORA_PATH")
+        out: Dict[str, str] = {}
+        if root:
+            from ..lora.source import LocalLoraSource
+
+            src = LocalLoraSource(root)
+            for name in src.list():
+                try:
+                    out[name] = src.config(name).get(
+                        "base_model_name_or_path") or ""
+                except (OSError, json.JSONDecodeError):
+                    continue
+        self._lora_scan = (now + self._LORA_SCAN_TTL_S, out)
+        return out
+
+    def _resolve_pipeline(self, model: str):
+        """Model name -> (pipeline, lora_name).  An adapter name resolves
+        to its base model's pipeline with lora_name set (the engine
+        applies the adapter; hashing/routing salt on it)."""
+        pipeline = self.manager.get(model)
+        if pipeline is not None:
+            return pipeline, None
+        base = self._lora_adapters().get(model)
+        if base is None:
+            return None, None
+        p = self.manager.get(base)
+        if p is None and len(self.manager.models) == 1:
+            # single-model deployment whose served name differs from the
+            # adapter's recorded base: serve it anyway (ref behavior:
+            # adapters are deployment-scoped)
+            p = next(iter(self.manager.models.values()))
+        return p, (model if p is not None else None)
 
     async def h_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_inference(request, chat=True)
@@ -488,7 +536,7 @@ class HttpService:
         except json.JSONDecodeError:
             return self._error(400, "invalid JSON body")
         model = body.get("model", "")
-        pipeline = self.manager.get(model)
+        pipeline, lora_name = self._resolve_pipeline(model)
         if pipeline is None:
             return self._error(
                 404, f"model {model!r} not found; available: "
@@ -500,6 +548,8 @@ class HttpService:
                    else pipeline.preprocessor.preprocess_completion(body))
         except Exception as e:
             return self._error(400, f"preprocessing failed: {e}")
+        if lora_name is not None:
+            req.lora_name = lora_name
         # agent session identity from headers (ref protocols/agents.rs)
         from .affinity import session_affinity_from_headers
 

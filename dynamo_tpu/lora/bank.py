@@ -1,0 +1,81 @@
+"""Stacked multi-LoRA adapter bank: batched low-rank deltas on TPU.
+
+The bank holds N adapter slots per target projection as ONE stacked array
+pair per layer — `A [L, N, d_in, r]`, `B [L, N, r, d_out]` — so a decode
+batch where every sequence uses a different adapter is a gather plus two
+batched einsums with static shapes: XLA tiles them onto the MXU and fuses
+them into the projection matmul's epilogue.  Slot 0 is all-zeros (= no
+adapter), so base-model traffic shares the same program at full speed.
+
+Ranks are padded to the bank's r: an adapter with a smaller rank is
+zero-padded (exact math, no branching).  The PEFT scaling factor
+(alpha/r) is folded into B at load time.
+
+Ref role: the punica/S-LoRA batched-LoRA kernels the reference's backend
+engines use (vllm lora execution); design here is jit-native instead of
+custom CUDA.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# target projections (HF PEFT default attention set)
+TARGETS = ("q", "k", "v", "o")
+
+
+def empty_bank(n_layers: int, n_adapters: int, rank: int, d_model: int,
+               q_dim: int, kv_dim: int, dtype=jnp.bfloat16) -> Dict[str, jax.Array]:
+    """Zeroed bank.  n_adapters includes slot 0 (the no-adapter slot)."""
+    dims = {"q": (d_model, q_dim), "k": (d_model, kv_dim),
+            "v": (d_model, kv_dim), "o": (q_dim, d_model)}
+    bank: Dict[str, jax.Array] = {}
+    for t, (d_in, d_out) in dims.items():
+        bank[f"A_{t}"] = jnp.zeros((n_layers, n_adapters, d_in, rank),
+                                   dtype)
+        bank[f"B_{t}"] = jnp.zeros((n_layers, n_adapters, rank, d_out),
+                                   dtype)
+    return bank
+
+
+def bank_layer(bank: Dict[str, jax.Array], li: int) -> Dict[str, jax.Array]:
+    return {k: v[li] for k, v in bank.items()}
+
+
+def lora_delta(x: jax.Array, A: jax.Array, B: jax.Array,
+               idx: jax.Array) -> jax.Array:
+    """Low-rank delta for a batch of (possibly distinct) adapters.
+
+    x [..., d_in]; A [N, d_in, r]; B [N, r, d_out].
+    idx: scalar int32 (whole x shares one adapter — single-sequence
+    prefill) or [B] matching x's leading dim (per-slot decode / batched
+    prefill).  Returns [..., d_out].
+    """
+    if idx.ndim == 0:
+        return (x @ A[idx]) @ B[idx]
+    Ag, Bg = A[idx], B[idx]  # [B, d_in, r], [B, r, d_out]
+    u = jnp.einsum("b...d,bdr->b...r", x, Ag)
+    return jnp.einsum("b...r,bro->b...o", u, Bg)
+
+
+def write_adapter(bank: Dict[str, jax.Array], slot: int,
+                  tensors: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+    """Write one adapter's (already rank-padded, scaling-folded) tensors
+    into bank slot `slot`.  `tensors` keys: A_q/B_q/... each
+    [L, d_in, r] / [L, r, d_out]; missing targets stay zero (adapters may
+    target a subset of projections)."""
+    out = dict(bank)
+    for key, arr in tensors.items():
+        if key not in bank:
+            raise KeyError(f"unknown bank tensor {key!r}")
+        out[key] = bank[key].at[:, slot].set(
+            jnp.asarray(arr, bank[key].dtype))
+    return out
+
+
+def clear_slot(bank: Dict[str, jax.Array], slot: int) -> Dict[str, jax.Array]:
+    return {k: v.at[:, slot].set(0) for k, v in bank.items()}

@@ -215,18 +215,52 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _qkv(layer, cfg: LlamaConfig, x: jax.Array, positions: jax.Array):
-    """x: [..., seq, d_model] -> q [..., seq, nh, hd], k/v [..., seq, nkv, hd]."""
+def _qkv(layer, cfg: LlamaConfig, x: jax.Array, positions: jax.Array,
+         lora=None):
+    """x: [..., seq, d_model] -> q [..., seq, nh, hd], k/v [..., seq, nkv, hd].
+
+    `lora`: optional (bank_layer, adapter_idx) — batched low-rank deltas
+    added to the projections (lora/bank.py); slot 0 is zeros so mixed
+    base/adapter batches share this program."""
     *lead, seq, _ = x.shape
-    q = (x @ layer["wq"]).reshape(*lead, seq, cfg.n_heads, cfg.head_dim)
-    k = (x @ layer["wk"]).reshape(*lead, seq, cfg.n_kv_heads, cfg.head_dim)
-    v = (x @ layer["wv"]).reshape(*lead, seq, cfg.n_kv_heads, cfg.head_dim)
+    zq = x @ layer["wq"]
+    zk = x @ layer["wk"]
+    zv = x @ layer["wv"]
+    if lora is not None:
+        from ..lora.bank import lora_delta
+
+        bl, idx = lora
+        zq = zq + lora_delta(x, bl["A_q"], bl["B_q"], idx)
+        zk = zk + lora_delta(x, bl["A_k"], bl["B_k"], idx)
+        zv = zv + lora_delta(x, bl["A_v"], bl["B_v"], idx)
+    q = zq.reshape(*lead, seq, cfg.n_heads, cfg.head_dim)
+    k = zk.reshape(*lead, seq, cfg.n_kv_heads, cfg.head_dim)
+    v = zv.reshape(*lead, seq, cfg.n_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
         q = rms_norm(q, layer["q_norm"]["norm"], cfg.rms_eps)
         k = rms_norm(k, layer["k_norm"]["norm"], cfg.rms_eps)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     return q, k, v
+
+
+def _attn_out(layer, attn_flat: jax.Array, lora=None) -> jax.Array:
+    o = attn_flat @ layer["wo"]
+    if lora is not None:
+        from ..lora.bank import lora_delta
+
+        bl, idx = lora
+        o = o + lora_delta(attn_flat, bl["A_o"], bl["B_o"], idx)
+    return o
+
+
+def _lora_ctx(lora_bank, adapter_idx, li):
+    """Per-layer LoRA context for _qkv/_attn_out, or None when disabled."""
+    if lora_bank is None or adapter_idx is None:
+        return None
+    from ..lora.bank import bank_layer
+
+    return bank_layer(lora_bank, li), adapter_idx
 
 
 def _mlp(layer, x: jax.Array) -> jax.Array:
@@ -367,6 +401,8 @@ def prefill(
     block_table: jax.Array,    # [max_blocks] int32, physical block ids
     ctx_len: jax.Array,        # scalar int32: tokens already cached (prefix)
     true_len: jax.Array,       # scalar int32: valid tokens in token_ids
+    lora_bank=None,            # stacked adapter bank (lora/bank.py)
+    adapter_idx=None,          # scalar int32: this sequence's bank slot
 ):
     """Run the prompt (or a prefill chunk) through the model.
 
@@ -378,15 +414,17 @@ def prefill(
     k_cache, v_cache = kv_cache
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
     for li, layer in enumerate(params["layers"]):
+        lctx = _lora_ctx(lora_bank, adapter_idx, li)
         h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
-        q, k, v = _qkv(layer, cfg, h, positions)
+        q, k, v = _qkv(layer, cfg, h, positions, lora=lctx)
         k_cache, v_cache = write_prompt_kv(
             k_cache, v_cache, li, k, v, block_table, ctx_len, true_len
         )
         attn = paged_prefill_attention(
             q, k, v, k_cache, v_cache, li, block_table, ctx_len, true_len
         )
-        x = x + attn.reshape(x.shape[0], cfg.q_dim) @ layer["wo"]
+        x = x + _attn_out(layer, attn.reshape(x.shape[0], cfg.q_dim),
+                          lora=lctx)
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
         # padding tokens past true_len must not eat MoE expert capacity
         x = x + _ffn(layer, cfg, h,
@@ -405,6 +443,8 @@ def prefill_batched(
     block_tables: jax.Array,   # [Bp, max_blocks] int32
     ctx_lens: jax.Array,       # [Bp] int32: tokens already cached per seq
     true_lens: jax.Array,      # [Bp] int32: valid tokens per row
+    lora_bank=None,            # stacked adapter bank (lora/bank.py)
+    adapter_idx=None,          # [Bp] int32: bank slot per sequence
 ):
     """Multi-sequence chunked prefill: Bp sequences' chunks in ONE program.
 
@@ -423,8 +463,9 @@ def prefill_batched(
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [Bp, T, d]
     valid = jnp.arange(T)[None, :] < true_lens[:, None]   # [Bp, T]
     for li, layer in enumerate(params["layers"]):
+        lctx = _lora_ctx(lora_bank, adapter_idx, li)
         h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
-        q, k, v = _qkv(layer, cfg, h, positions)  # [Bp, T, nh/nkv, hd]
+        q, k, v = _qkv(layer, cfg, h, positions, lora=lctx)  # [Bp,T,nh,hd]
         k_cache, v_cache = write_prompt_kv_batched(
             k_cache, v_cache, li, k, v, block_tables, ctx_lens, true_lens
         )
@@ -433,7 +474,7 @@ def prefill_batched(
                 qb, kb, vb, k_cache, v_cache, li, tb, cl, tl
             )
         )(q, k, v, block_tables, ctx_lens, true_lens)
-        x = x + attn.reshape(Bp, T, cfg.q_dim) @ layer["wo"]
+        x = x + _attn_out(layer, attn.reshape(Bp, T, cfg.q_dim), lora=lctx)
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
         if cfg.n_experts > 0:
             # per-row dispatch: each sequence keeps its OWN expert-capacity
@@ -502,6 +543,8 @@ def decode(
     ctx_lens: jax.Array,       # [B] int32, tokens in cache BEFORE this step
     valid: Optional[jax.Array] = None,  # [B] bool: active (non-padding) slots
     mesh=None,                 # required for the Pallas path under tp>1
+    lora_bank=None,            # stacked adapter bank (lora/bank.py)
+    adapter_idx=None,          # [B] int32: bank slot per slot
 ):
     """One decode step for B slots.  Writes each token's K/V, attends over
     the paged context, returns (logits [B, vocab], updated kv_cache)."""
@@ -509,8 +552,9 @@ def decode(
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [B, d]
     pos1 = positions[:, None]  # [B, 1] for rope
     for li, layer in enumerate(params["layers"]):
+        lctx = _lora_ctx(lora_bank, adapter_idx, li)
         h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
-        q, k, v = _qkv(layer, cfg, h[:, None, :], pos1)  # [B,1,nh,hd]
+        q, k, v = _qkv(layer, cfg, h[:, None, :], pos1, lora=lctx)
         k_cache, v_cache = write_token_kv(
             k_cache, v_cache, li, k[:, 0], v[:, 0], block_tables, ctx_lens
         )
@@ -518,7 +562,8 @@ def decode(
             q[:, 0], k_cache, v_cache, li, block_tables, ctx_lens + 1,
             impl=cfg.attn_impl, mesh=mesh,
         )  # [B, nh, hd]
-        x = x + attn.reshape(x.shape[0], cfg.q_dim) @ layer["wo"]
+        x = x + _attn_out(layer, attn.reshape(x.shape[0], cfg.q_dim),
+                          lora=lctx)
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
         x = x + _ffn(layer, cfg, h, valid=valid)
     logits = _logits(params, cfg, x)  # [B, vocab]
@@ -537,6 +582,8 @@ def decode_multi(
     sample_fn=None,            # (logits [B,V], step_idx) -> tokens [B]
     valid: Optional[jax.Array] = None,  # [B] bool: active slots
     mesh=None,                 # required for the Pallas path under tp>1
+    lora_bank=None,            # stacked adapter bank (lora/bank.py)
+    adapter_idx=None,          # [B] int32: bank slot per slot
 ):
     """`num_steps` fused decode steps in ONE compiled program (lax.scan).
 
@@ -555,7 +602,8 @@ def decode_multi(
     def body(carry, step_idx):
         tokens, kv, pos, cls = carry
         logits, kv = decode(params, cfg, kv, tokens, pos, block_tables, cls,
-                            valid=valid, mesh=mesh)
+                            valid=valid, mesh=mesh, lora_bank=lora_bank,
+                            adapter_idx=adapter_idx)
         nt = sample_fn(logits, step_idx).astype(jnp.int32)
         return (nt, kv, pos + 1, cls + 1), nt
 

@@ -46,6 +46,15 @@ class KvRouter:
         self.indexer = make_indexer()
         self.selector = DefaultWorkerSelector(config)
         self.sequences = ActiveSequences()
+        # LoRA replica placement (lora/routing.py): adapter-carrying
+        # requests route within the adapter's HRW replica set so bank
+        # slots and prefix caches stay warm there
+        import os
+
+        from ..lora.routing import LoraReplicaSelector
+
+        self.lora_selector = LoraReplicaSelector(
+            replica_factor=int(os.environ.get("DYN_LORA_REPLICAS", "2")))
         # multi-router slot-state convergence (replica_sync.py)
         self.sync: Optional[RouterReplicaSync] = (
             RouterReplicaSync(runtime, namespace, component, self.sequences)
@@ -217,6 +226,9 @@ class KvRouter:
         if not workers:
             await self.client.wait_for_instances()
             workers = self.client.instance_ids
+        if request.lora_name:
+            workers = self.lora_selector.filter(request.lora_name, workers,
+                                                avoid=avoid)
         hashes = compute_block_hashes_for_request(
             request.token_ids, self.block_size, lora_name=request.lora_name,
             media_hashes=request.media_hashes,

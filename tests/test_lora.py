@@ -1,0 +1,428 @@
+"""LoRA serving: batched bank math vs dense-merge oracle, PEFT loading,
+HRW routing (ref: lib/llm/src/lora/)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.lora.bank import (
+    bank_layer,
+    clear_slot,
+    empty_bank,
+    lora_delta,
+    write_adapter,
+)
+from dynamo_tpu.lora.routing import LoraReplicaSelector, rendezvous_ranking
+from dynamo_tpu.lora.source import LocalLoraSource
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+CFG = LlamaConfig(name="tiny32", vocab_size=128, d_model=32, n_layers=2,
+                  n_heads=4, n_kv_heads=2, head_dim=8, ffn_dim=64,
+                  dtype=jnp.float32)
+RANK = 4
+
+
+def random_adapter_arrays(cfg, rank, seed):
+    """Bank-layout tensors (A [L, d_in, r], B [L, r, d_out]) for all four
+    attention targets."""
+    rng = np.random.default_rng(seed)
+    dims = {"q": (cfg.d_model, cfg.q_dim), "k": (cfg.d_model, cfg.kv_dim),
+            "v": (cfg.d_model, cfg.kv_dim), "o": (cfg.q_dim, cfg.d_model)}
+    out = {}
+    for t, (d_in, d_out) in dims.items():
+        out[f"A_{t}"] = rng.normal(
+            0, 0.3, (cfg.n_layers, d_in, rank)).astype(np.float32)
+        out[f"B_{t}"] = rng.normal(
+            0, 0.3, (cfg.n_layers, rank, d_out)).astype(np.float32)
+    return out
+
+
+def merged_params(params, adapter):
+    """Dense oracle: fold each layer's A@B into the base weights."""
+    import copy
+
+    p = copy.deepcopy(jax.tree.map(np.asarray, params))
+    for li, layer in enumerate(p["layers"]):
+        for t, w in (("q", "wq"), ("k", "wk"), ("v", "wv"), ("o", "wo")):
+            layer[w] = layer[w] + adapter[f"A_{t}"][li] @ adapter[f"B_{t}"][li]
+    return jax.tree.map(jnp.asarray, p)
+
+
+def make_cache(cfg, num_blocks=16, block_size=4):
+    k_shape, v_shape = llama.kv_cache_shapes(cfg, num_blocks, block_size)
+    return (jnp.zeros(k_shape, cfg.dtype), jnp.zeros(v_shape, cfg.dtype))
+
+
+# ------------------------- bank math vs oracle ------------------------------
+
+
+def test_prefill_matches_dense_merge_oracle():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    adapter = random_adapter_arrays(CFG, RANK, seed=1)
+    bank = empty_bank(CFG.n_layers, 3, RANK, CFG.d_model, CFG.q_dim,
+                      CFG.kv_dim, dtype=jnp.float32)
+    bank = write_adapter(bank, 1, adapter)
+
+    toks = jnp.asarray(np.arange(8) % 50, jnp.int32)
+    pos = jnp.arange(8, dtype=jnp.int32)
+    table = jnp.arange(1, 3, dtype=jnp.int32)
+
+    # adapter slot 1 == dense-merged weights
+    logits_bank, _ = llama.prefill(
+        params, CFG, make_cache(CFG), toks, pos, table,
+        jnp.int32(0), jnp.int32(8), lora_bank=bank,
+        adapter_idx=jnp.int32(1))
+    logits_dense, _ = llama.prefill(
+        merged_params(params, adapter), CFG, make_cache(CFG), toks, pos,
+        table, jnp.int32(0), jnp.int32(8))
+    np.testing.assert_allclose(np.asarray(logits_bank),
+                               np.asarray(logits_dense), rtol=2e-4,
+                               atol=2e-4)
+
+    # adapter slot 0 (zeros) == base model
+    logits_zero, _ = llama.prefill(
+        params, CFG, make_cache(CFG), toks, pos, table,
+        jnp.int32(0), jnp.int32(8), lora_bank=bank,
+        adapter_idx=jnp.int32(0))
+    logits_base, _ = llama.prefill(
+        params, CFG, make_cache(CFG), toks, pos, table,
+        jnp.int32(0), jnp.int32(8))
+    np.testing.assert_allclose(np.asarray(logits_zero),
+                               np.asarray(logits_base), rtol=1e-6)
+
+
+def test_mixed_batch_decode_matches_per_adapter_runs():
+    """One decode batch, three different adapters (incl. none): each lane
+    must equal the same lane run alone with its adapter dense-merged."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    ad1 = random_adapter_arrays(CFG, RANK, seed=1)
+    ad2 = random_adapter_arrays(CFG, RANK, seed=2)
+    bank = empty_bank(CFG.n_layers, 3, RANK, CFG.d_model, CFG.q_dim,
+                      CFG.kv_dim, dtype=jnp.float32)
+    bank = write_adapter(bank, 1, ad1)
+    bank = write_adapter(bank, 2, ad2)
+
+    B, bs = 3, 4
+    toks = jnp.asarray([5, 9, 13], jnp.int32)
+    positions = jnp.zeros(B, jnp.int32)
+    tables = jnp.asarray([[1, 2], [3, 4], [5, 6]], jnp.int32)
+    ctx = jnp.zeros(B, jnp.int32)
+    idx = jnp.asarray([0, 1, 2], jnp.int32)
+
+    logits_mix, _ = llama.decode(
+        params, CFG, make_cache(CFG), toks, positions, tables, ctx,
+        lora_bank=bank, adapter_idx=idx)
+
+    for lane, adapter in ((0, None), (1, ad1), (2, ad2)):
+        p = params if adapter is None else merged_params(params, adapter)
+        lane_logits, _ = llama.decode(
+            p, CFG, make_cache(CFG), toks[lane: lane + 1],
+            positions[lane: lane + 1], tables[lane: lane + 1],
+            ctx[lane: lane + 1])
+        np.testing.assert_allclose(
+            np.asarray(logits_mix[lane]), np.asarray(lane_logits[0]),
+            rtol=2e-4, atol=2e-4)
+
+
+def test_clear_slot_restores_base():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    adapter = random_adapter_arrays(CFG, RANK, seed=3)
+    bank = empty_bank(CFG.n_layers, 2, RANK, CFG.d_model, CFG.q_dim,
+                      CFG.kv_dim, dtype=jnp.float32)
+    bank = clear_slot(write_adapter(bank, 1, adapter), 1)
+    x = jnp.ones((2, CFG.d_model), jnp.float32)
+    bl = bank_layer(bank, 0)
+    d = lora_delta(x, bl["A_q"], bl["B_q"], jnp.asarray([1, 1], jnp.int32))
+    assert float(jnp.abs(d).max()) == 0.0
+
+
+# ------------------------- PEFT source loading ------------------------------
+
+
+def write_peft_adapter(root, name, cfg, rank, alpha, seed, base="tiny32"):
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(seed)
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "adapter_config.json"), "w") as f:
+        json.dump({"r": rank, "lora_alpha": alpha,
+                   "base_model_name_or_path": base,
+                   "target_modules": ["q_proj", "k_proj", "v_proj",
+                                      "o_proj"]}, f)
+    tensors = {}
+    dims = {"q": (cfg.d_model, cfg.q_dim), "k": (cfg.d_model, cfg.kv_dim),
+            "v": (cfg.d_model, cfg.kv_dim), "o": (cfg.q_dim, cfg.d_model)}
+    for li in range(cfg.n_layers):
+        for t, (d_in, d_out) in dims.items():
+            prefix = (f"base_model.model.model.layers.{li}."
+                      f"self_attn.{t}_proj")
+            tensors[f"{prefix}.lora_A.weight"] = rng.normal(
+                0, 0.3, (rank, d_in)).astype(np.float32)
+            tensors[f"{prefix}.lora_B.weight"] = rng.normal(
+                0, 0.3, (d_out, rank)).astype(np.float32)
+    save_file(tensors, os.path.join(d, "adapter_model.safetensors"))
+    return tensors
+
+
+def test_local_source_roundtrip(tmp_path):
+    raw = write_peft_adapter(str(tmp_path), "my-adapter", CFG, rank=2,
+                             alpha=4, seed=7)
+    src = LocalLoraSource(str(tmp_path))
+    assert src.list() == ["my-adapter"]
+    ad = src.load("my-adapter", CFG.n_layers)
+    assert ad.rank == 2 and ad.scaling == 2.0
+    assert ad.base_model == "tiny32"
+    # A transposed; B transposed with scaling folded
+    a_key = "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight"
+    b_key = "base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight"
+    np.testing.assert_allclose(ad.tensors["A_q"][0], raw[a_key].T)
+    np.testing.assert_allclose(ad.tensors["B_q"][0], raw[b_key].T * 2.0,
+                               rtol=1e-6)
+    # rank padding
+    padded = ad.padded_to(8)
+    assert padded.tensors["A_q"].shape[-1] == 8
+    np.testing.assert_allclose(padded.tensors["A_q"][..., :2],
+                               ad.tensors["A_q"])
+    assert float(np.abs(padded.tensors["A_q"][..., 2:]).max()) == 0.0
+    with pytest.raises(ValueError):
+        ad.padded_to(1)
+
+
+# ------------------------- HRW routing --------------------------------------
+
+
+def test_rendezvous_minimal_disruption():
+    workers = [101, 202, 303, 404, 505]
+    sel = LoraReplicaSelector(replica_factor=2)
+    before = {f"ad{i}": sel.replica_set(f"ad{i}", workers)
+              for i in range(40)}
+    # deterministic
+    assert before == {f"ad{i}": sel.replica_set(f"ad{i}", workers)
+                      for i in range(40)}
+    # removing one worker only remaps adapters that used it
+    survivors = [w for w in workers if w != 303]
+    moved = unchanged = 0
+    for name, reps in before.items():
+        after = sel.replica_set(name, survivors)
+        if 303 in reps:
+            assert 303 not in after
+            moved += 1
+        else:
+            assert after == reps
+            unchanged += 1
+    assert moved > 0 and unchanged > 0
+
+
+def test_filter_fallbacks():
+    sel = LoraReplicaSelector(replica_factor=2)
+    workers = [1, 2, 3, 4]
+    # no lora -> whole fleet
+    assert sel.filter(None, workers) == workers
+    reps = sel.filter("ad", workers)
+    assert len(reps) == 2 and set(reps) <= set(workers)
+    # fleet smaller than replica factor -> everyone serves it
+    assert sel.filter("ad", [7]) == [7]
+    # entire replica set avoided -> fall back to the full fleet
+    assert sel.filter("ad", workers, avoid=set(reps)) == workers
+    # partial avoid -> surviving replica
+    one = sel.filter("ad", workers, avoid={reps[0]})
+    assert one == [reps[1]]
+
+
+def test_ranking_is_total_order():
+    r = rendezvous_ranking("a", [1, 2, 3])
+    assert sorted(r) == [1, 2, 3]
+
+
+# ------------------------- engine e2e ---------------------------------------
+
+
+async def test_engine_serves_mixed_lora_batch(tmp_path):
+    """Engine with a lazy-loading bank: base + two adapters concurrently,
+    each stream matching a dedicated engine whose weights were
+    dense-merged with that adapter."""
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    write_peft_adapter(str(tmp_path), "ad1", CFG, rank=2, alpha=2, seed=11)
+    write_peft_adapter(str(tmp_path), "ad2", CFG, rank=4, alpha=4, seed=22)
+    params = init_params(CFG, jax.random.PRNGKey(3))
+
+    def eng(p, **kw):
+        return JaxEngine(EngineConfig(
+            model_config=CFG, block_size=4, num_blocks=64,
+            max_blocks_per_seq=16, max_num_seqs=4,
+            prefill_buckets=(8, 16), decode_fused_steps=2,
+            **kw), params=jax.tree.map(jnp.array, p))
+
+    def req(rid, lora=None):
+        return PreprocessedRequest(
+            token_ids=[3, 14, 15, 9, 2, 6], request_id=rid,
+            lora_name=lora,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=8, ignore_eos=True))
+
+    async def collect(e, r):
+        out = []
+        async for item in e.generate(r):
+            out.extend(item.token_ids)
+        return out
+
+    served = eng(params, lora_max_adapters=4, lora_rank=4,
+                 lora_dir=str(tmp_path))
+    try:
+        base_t, ad1_t, ad2_t = await asyncio.gather(
+            collect(served, req("r-base")),
+            collect(served, req("r-ad1", "ad1")),
+            collect(served, req("r-ad2", "ad2")))
+        assert served._lora_slots.keys() == {"ad1", "ad2"}
+    finally:
+        await served.close()
+
+    src = LocalLoraSource(str(tmp_path))
+    for name, got in ((None, base_t), ("ad1", ad1_t), ("ad2", ad2_t)):
+        if name is None:
+            p = params
+        else:
+            ad = src.load(name, CFG.n_layers)
+            full = {f"{k}": v for k, v in ad.tensors.items()}
+            # source tensors may omit nothing here; merge directly
+            p = merged_params(params, full)
+        ref = eng(p)
+        try:
+            want = await collect(ref, req(f"ref-{name}"))
+        finally:
+            await ref.close()
+        assert got == want, f"adapter {name}: {got} != {want}"
+
+
+async def test_engine_rejects_unknown_adapter(tmp_path):
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+
+    e = JaxEngine(EngineConfig(
+        model_config=CFG, block_size=4, num_blocks=32,
+        max_blocks_per_seq=8, max_num_seqs=2, prefill_buckets=(8,),
+        lora_max_adapters=2, lora_rank=4, lora_dir=str(tmp_path)))
+    try:
+        outs = []
+        async for item in e.generate(PreprocessedRequest(
+                token_ids=[1, 2, 3], request_id="r",
+                lora_name="nope",
+                stop=StopConditions(max_tokens=2))):
+            outs.append(item)
+        assert outs[-1].finish_reason == "error"
+        assert "nope" in (outs[-1].error or "")
+    finally:
+        await e.close()
+
+
+# ------------------------- frontend aliasing + router filter ----------------
+
+
+async def test_frontend_adapter_alias_and_models_list(tmp_path, monkeypatch):
+    """model=<adapter> resolves to the base pipeline with lora_name set;
+    /v1/models lists adapters with their parent."""
+    import asyncio
+    import uuid
+
+    import aiohttp
+
+    from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+    from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    write_peft_adapter(str(tmp_path), "style-a", CFG, rank=2, alpha=2,
+                       seed=5, base="alias-model")
+    monkeypatch.setenv("DYN_LORA_PATH", str(tmp_path))
+
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem", event_plane="inproc"),
+        cluster_id=uuid.uuid4().hex).start()
+    args = MockEngineArgs(model_name="alias-model", block_size=4,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0)
+    worker = await MockerWorker(rt, args).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        if manager.get("alias-model"):
+            break
+        await asyncio.sleep(0.02)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/v1/models") as r:
+                ids = {m["id"]: m for m in (await r.json())["data"]}
+            assert "alias-model" in ids and "style-a" in ids
+            assert ids["style-a"]["parent"] == "alias-model"
+            body = {"model": "style-a",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4, "ignore_eos": True}
+            async with s.post(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    json=body) as r:
+                assert r.status == 200
+                out = await r.json()
+                assert out["model"] == "style-a"
+    finally:
+        await service.close()
+        await watcher.close()
+        await worker.close()
+        await rt.shutdown()
+
+
+async def test_kv_router_restricts_lora_to_replica_set():
+    import asyncio
+    import uuid
+
+    from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+    from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.router import KvRouter
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem", event_plane="inproc"),
+        cluster_id=uuid.uuid4().hex).start()
+    args = MockEngineArgs(model_name="m", block_size=4, base_step_s=0.0005)
+    workers = [await MockerWorker(rt, args).start() for _ in range(4)]
+    client = await (rt.namespace("dynamo").component("mocker")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+    while len(client.instances) < 4:
+        await asyncio.sleep(0.02)
+    router = await KvRouter(rt, "dynamo", "mocker", client,
+                            block_size=4).start()
+    try:
+        replicas = set(router.lora_selector.replica_set(
+            "my-lora", client.instance_ids))
+        assert len(replicas) == 2
+        picks = set()
+        for i in range(12):
+            req = PreprocessedRequest(
+                token_ids=list(range(8 + i)), request_id=f"r{i}",
+                lora_name="my-lora", stop=StopConditions(max_tokens=4))
+            choice = await router.pick(req)
+            picks.add(choice)
+            router.complete(req.request_id)
+        assert picks <= replicas
+    finally:
+        await router.close()
+        await client.close()
+        for w in workers:
+            await w.close()
+        await rt.shutdown()
