@@ -25,6 +25,9 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--disagg-min-isl", type=int, default=2048)
     p.add_argument("--disagg-ratio", type=float, default=0.7)
     p.add_argument("--always-disagg", action="store_true")
+    p.add_argument("--grpc-port", type=int, default=0,
+                   help="serve the KServe v2 gRPC inference protocol on "
+                        "this port (0 = disabled)")
     p.add_argument(
         "--session-affinity-ttl", type=float,
         default=float(os.environ.get("DYN_SESSION_AFFINITY_TTL", 0)) or None,
@@ -70,11 +73,20 @@ async def main() -> None:
         rt, manager, host=args.host, port=args.port,
         busy_threshold=args.busy_threshold,
     ).start()
+    grpc_service = None
+    if args.grpc_port:
+        from .kserve import KserveGrpcService
+
+        grpc_service = await KserveGrpcService(
+            rt, manager, host=args.host, port=args.grpc_port,
+            resolver=service._resolve_pipeline).start()
     print(f"ready port={args.port}", flush=True)
     try:
         await rt.root_token.wait_killed()
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
+    if grpc_service is not None:
+        await grpc_service.close()
     await service.close()
     await watcher.close()
     await rt.shutdown()

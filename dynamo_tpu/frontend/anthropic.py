@@ -1,0 +1,309 @@
+"""Anthropic Messages API over the model pipelines.
+
+Ref: lib/llm/src/http/service/anthropic.rs — /v1/messages (unary + SSE)
+and /v1/messages/count_tokens, mapped onto the same preprocessor/
+pipeline path the OpenAI routes use.  The Anthropic SSE framing differs
+structurally from OpenAI chunks: typed events
+(message_start → content_block_start → content_block_delta* →
+content_block_stop → message_delta → message_stop) with input usage
+reported up front in message_start (anthropic.rs:282 notes the same).
+
+Stop-reason mapping: length → max_tokens, stop-string → stop_sequence,
+EOS → end_turn.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import secrets
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+
+def _error(status: int, etype: str, msg: str) -> web.Response:
+    return web.json_response(
+        {"type": "error", "error": {"type": etype, "message": msg}},
+        status=status)
+
+
+def _convert_blocks(content) -> Any:
+    """Anthropic content blocks -> OpenAI chat content parts.  Text and
+    image blocks map losslessly (base64 source -> data URI); anything
+    else raises so callers get a 400 instead of a silently-ignored
+    input."""
+    if not isinstance(content, list):
+        return content
+    parts: List[Dict[str, Any]] = []
+    for b in content:
+        if not isinstance(b, dict):
+            raise ValueError("content blocks must be objects")
+        btype = b.get("type")
+        if btype == "text":
+            parts.append({"type": "text", "text": b.get("text", "")})
+        elif btype == "image":
+            src = b.get("source") or {}
+            if src.get("type") == "base64":
+                uri = (f"data:{src.get('media_type', 'image/png')};"
+                       f"base64,{src.get('data', '')}")
+            elif src.get("type") == "url":
+                uri = src.get("url", "")
+            else:
+                raise ValueError(
+                    f"unsupported image source {src.get('type')!r}")
+            parts.append({"type": "image_url", "image_url": {"url": uri}})
+        else:
+            raise ValueError(f"unsupported content block type {btype!r}")
+    return parts
+
+
+def _to_chat_body(body: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
+    """Anthropic request -> OpenAI-chat-shaped body for the preprocessor.
+    Returns (chat_body, stop_sequences)."""
+    messages: List[Dict[str, Any]] = []
+    system = body.get("system")
+    if system:
+        if isinstance(system, list):  # system content blocks
+            system = "".join(b.get("text", "") for b in system
+                             if isinstance(b, dict))
+        messages.append({"role": "system", "content": system})
+    for m in body.get("messages", []):
+        messages.append({"role": m.get("role", "user"),
+                         "content": _convert_blocks(m.get("content"))})
+    stops = list(body.get("stop_sequences") or [])
+    chat = {
+        "model": body.get("model", ""),
+        "messages": messages,
+        "max_tokens": body.get("max_tokens", 256),
+        "temperature": body.get("temperature", 1.0),
+        "stop": stops,
+    }
+    if body.get("tools"):
+        # Anthropic tool shape -> OpenAI function shape (the tools
+        # preamble/parsers consume the OpenAI form)
+        chat["tools"] = [
+            {"type": "function",
+             "function": {"name": t.get("name", ""),
+                          "description": t.get("description", ""),
+                          "parameters": t.get("input_schema", {})}}
+            for t in body["tools"]]
+    if body.get("top_p") is not None:
+        chat["top_p"] = body["top_p"]
+    if body.get("top_k") is not None:
+        chat["top_k"] = body["top_k"]
+    return chat, stops
+
+
+def _stop_reason(finish: Optional[str],
+                 trigger: Optional[str]) -> Tuple[str, Optional[str]]:
+    """(stop_reason, stop_sequence): stop_sequence only when an actual
+    stop string matched (EOS also reports finish 'stop' but must be
+    end_turn)."""
+    if finish == "length":
+        return "max_tokens", None
+    if trigger is not None:
+        return "stop_sequence", trigger
+    return "end_turn", None
+
+
+class AnthropicRoutes:
+    """Mixin-style route collection mounted on HttpService's app."""
+
+    def __init__(self, service):
+        self.service = service  # HttpService
+
+    def mount(self, app: web.Application) -> None:
+        app.router.add_post("/v1/messages", self.h_messages)
+        app.router.add_post("/v1/messages/count_tokens",
+                            self.h_count_tokens)
+
+    # -- handlers ---------------------------------------------------------
+    async def h_count_tokens(self, request: web.Request) -> web.Response:
+        svc = self.service
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid_request_error", "invalid JSON body")
+        pipeline, lora = svc._resolve_pipeline(body.get("model", ""))
+        if pipeline is None:
+            return _error(404, "not_found_error",
+                          f"model {body.get('model')!r} not found")
+        try:
+            chat, _ = _to_chat_body(body)
+            req = pipeline.preprocessor.preprocess_chat(chat)
+        except Exception as e:
+            return _error(400, "invalid_request_error", str(e))
+        return web.json_response({"input_tokens": len(req.token_ids)})
+
+    async def h_messages(self, request: web.Request) -> web.StreamResponse:
+        svc = self.service
+        if svc._busy():
+            return _error(529, "overloaded_error", "service busy")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid_request_error", "invalid JSON body")
+        model = body.get("model", "")
+        pipeline, lora_name = svc._resolve_pipeline(model)
+        if pipeline is None:
+            return _error(404, "not_found_error",
+                          f"model {model!r} not found")
+        if not isinstance(body.get("messages"), list):
+            return _error(400, "invalid_request_error",
+                          "'messages' must be a list")
+        if not isinstance(body.get("max_tokens"), int):
+            return _error(400, "invalid_request_error",
+                          "'max_tokens' is required")
+        try:
+            chat, stops = _to_chat_body(body)
+            req = pipeline.preprocessor.preprocess_chat(chat)
+        except Exception as e:
+            return _error(400, "invalid_request_error",
+                          f"preprocessing failed: {e}")
+        if lora_name is not None:
+            req.lora_name = lora_name
+        from .affinity import session_affinity_from_headers
+        from .request_trace import RequestTracker
+
+        req.session_id, req.session_final = session_affinity_from_headers(
+            request.headers)
+        tracker = RequestTracker.from_headers(
+            request.headers, req.request_id, model, svc.trace_sink,
+            session_id=req.session_id, endpoint="anthropic_messages",
+            input_tokens=len(req.token_ids))
+        tp = tracker.traceparent()
+        if tp is not None and svc.trace_sink.config.enabled:
+            req.annotations = list(req.annotations) + [f"traceparent:{tp}"]
+        token = svc.runtime.root_token.child()
+        svc._inflight_delta(+1)
+        svc._m_requests.inc("dynamo_frontend_requests_total", model=model)
+        t0 = time.monotonic()
+        try:
+            if body.get("stream"):
+                return await self._stream(request, pipeline, req, model,
+                                          stops, token, tracker)
+            return await self._unary(pipeline, req, model, stops, token,
+                                     tracker)
+        finally:
+            svc._inflight_delta(-1)
+            svc._m_requests.observe(
+                "dynamo_frontend_request_duration_seconds",
+                time.monotonic() - t0, model=model)
+            token.detach()
+
+    async def _unary(self, pipeline, req, model, stops, token,
+                     tracker) -> web.Response:
+        from .service import HttpService, _LatencyProbe
+
+        parts: List[str] = []
+        finish = trigger = None
+        ntok = 0
+        probe = _LatencyProbe(self.service._m_requests, model)
+        try:
+            async for d in pipeline.generate_deltas(req, token=token,
+                                                    tracker=tracker):
+                if ntok == 0 and d.token_count:
+                    tracker.cached_tokens = HttpService._kv_overlap_tokens(
+                        pipeline, req.request_id)
+                parts.append(d.text)
+                probe.on_delta(d.token_count)
+                tracker.on_tokens(d.token_count)
+                ntok += d.token_count
+                if d.finish_reason:
+                    finish, trigger = d.finish_reason, d.stop_trigger
+        except Exception as e:
+            logger.exception("anthropic messages failed")
+            tracker.finish(error=str(e))
+            return _error(500, "api_error", str(e))
+        stop_reason, stop_seq = _stop_reason(finish, trigger)
+        tracker.finish(finish_reason=stop_reason)
+        return web.json_response({
+            "id": f"msg_{secrets.token_hex(12)}",
+            "type": "message",
+            "role": "assistant",
+            "model": model,
+            "content": [{"type": "text", "text": "".join(parts)}],
+            "stop_reason": stop_reason,
+            "stop_sequence": stop_seq,
+            "usage": {"input_tokens": len(req.token_ids),
+                      "output_tokens": ntok},
+        }, headers={"X-Request-Id": tracker.x_request_id})
+
+    async def _stream(self, request, pipeline, req, model, stops, token,
+                      tracker) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Request-Id": tracker.x_request_id,
+        })
+        await resp.prepare(request)
+
+        async def event(name: str, data: Dict[str, Any]) -> None:
+            await resp.write(
+                f"event: {name}\ndata: {json.dumps(data)}\n\n".encode())
+
+        msg_id = f"msg_{secrets.token_hex(12)}"
+        await event("message_start", {
+            "type": "message_start",
+            "message": {"id": msg_id, "type": "message",
+                        "role": "assistant", "model": model, "content": [],
+                        "stop_reason": None, "stop_sequence": None,
+                        "usage": {"input_tokens": len(req.token_ids),
+                                  "output_tokens": 0}}})
+        await event("content_block_start", {
+            "type": "content_block_start", "index": 0,
+            "content_block": {"type": "text", "text": ""}})
+        from .service import HttpService, _LatencyProbe
+
+        ntok = 0
+        finish = trigger = None
+        probe = _LatencyProbe(self.service._m_requests, model)
+        try:
+            async for d in pipeline.generate_deltas(req, token=token,
+                                                    tracker=tracker):
+                if ntok == 0 and d.token_count:
+                    tracker.cached_tokens = HttpService._kv_overlap_tokens(
+                        pipeline, req.request_id)
+                probe.on_delta(d.token_count)
+                tracker.on_tokens(d.token_count)
+                ntok += d.token_count
+                if d.text:
+                    await event("content_block_delta", {
+                        "type": "content_block_delta", "index": 0,
+                        "delta": {"type": "text_delta", "text": d.text}})
+                if d.finish_reason:
+                    finish, trigger = d.finish_reason, d.stop_trigger
+                    break
+            stop_reason, stop_seq = _stop_reason(finish, trigger)
+            await event("content_block_stop",
+                        {"type": "content_block_stop", "index": 0})
+            await event("message_delta", {
+                "type": "message_delta",
+                "delta": {"stop_reason": stop_reason,
+                          "stop_sequence": stop_seq},
+                "usage": {"output_tokens": ntok}})
+            await event("message_stop", {"type": "message_stop"})
+            tracker.finish(finish_reason=stop_reason)
+        except (ConnectionResetError, asyncio.CancelledError):
+            token.kill()
+            tracker.finish(error="client_disconnected")
+            return resp
+        except Exception as e:
+            logger.exception("anthropic stream failed")
+            tracker.finish(error=str(e))
+            try:
+                await event("error", {"type": "error",
+                                      "error": {"type": "api_error",
+                                                "message": str(e)}})
+            except ConnectionResetError:
+                return resp
+        try:
+            await resp.write_eof()
+        except ConnectionResetError:
+            pass
+        return resp

@@ -117,6 +117,10 @@ class ChatDelta:
     text: str = ""
     finish_reason: Optional[str] = None
     token_count: int = 0
+    # the stop string that ended the stream, when finish_reason=="stop"
+    # came from a stop-sequence match rather than EOS (Anthropic's
+    # stop_reason/stop_sequence distinction needs this)
+    stop_trigger: Optional[str] = None
 
 
 class ModelPipeline:
@@ -173,10 +177,11 @@ class ModelPipeline:
             finish = out.finish_reason
             if stops:
                 pending += delta
-                cut = self._find_stop(pending, stops)
+                cut, matched = self._find_stop(pending, stops)
                 if cut is not None:
                     yield ChatDelta(text=pending[:cut], finish_reason="stop",
-                                    token_count=len(out.token_ids))
+                                    token_count=len(out.token_ids),
+                                    stop_trigger=matched)
                     return
                 if finish is not None:
                     # stream over: flush the held-back text, it wasn't a stop
@@ -194,13 +199,15 @@ class ModelPipeline:
                 return
 
     @staticmethod
-    def _find_stop(text: str, stops: list[str]) -> Optional[int]:
-        best = None
+    def _find_stop(text: str, stops: list[str]):
+        """Earliest stop-string match: (cut_index, matched_stop) or
+        (None, None)."""
+        best, which = None, None
         for s in stops:
             i = text.find(s)
             if i >= 0 and (best is None or i < best):
-                best = i
-        return best
+                best, which = i, s
+        return best, which
 
     @staticmethod
     def _max_partial_suffix(text: str, stops: list[str]) -> int:

@@ -360,6 +360,11 @@ class HttpService:
         self.app.router.add_post("/v1/embeddings", self.h_embeddings)
         self.app.router.add_get("/health", self.h_health)
         self.app.router.add_get("/metrics", self.h_metrics)
+        # Anthropic Messages API (ref anthropic.rs): same pipelines,
+        # Anthropic request/SSE shapes
+        from .anthropic import AnthropicRoutes
+
+        AnthropicRoutes(self).mount(self.app)
 
     # -- helpers ----------------------------------------------------------
     def _inflight_delta(self, d: int) -> None:

@@ -1,0 +1,283 @@
+"""Anthropic Messages API + KServe v2 gRPC over mocker workers
+(ref: lib/llm/src/http/service/anthropic.rs, grpc/service/kserve.rs)."""
+
+import asyncio
+import json
+import uuid
+
+import aiohttp
+
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+MODEL = "proto-model"
+
+
+async def start_stack():
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem", event_plane="inproc"),
+        cluster_id=uuid.uuid4().hex).start()
+    args = MockEngineArgs(model_name=MODEL, block_size=4,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0)
+    worker = await MockerWorker(rt, args).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        if manager.get(MODEL):
+            break
+        await asyncio.sleep(0.02)
+    return rt, worker, watcher, service, manager, port
+
+
+async def stop_stack(rt, worker, watcher, service):
+    await service.close()
+    await watcher.close()
+    await worker.close()
+    await rt.shutdown()
+
+
+# ----------------------------- Anthropic ------------------------------------
+
+
+async def test_anthropic_messages_unary():
+    rt, worker, watcher, service, manager, port = await start_stack()
+    try:
+        body = {"model": MODEL, "max_tokens": 6,
+                "system": "be brief",
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "hello"}]}],
+                "ignore_eos": True}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{port}/v1/messages",
+                              json=body) as r:
+                assert r.status == 200
+                out = await r.json()
+        assert out["type"] == "message" and out["role"] == "assistant"
+        assert out["id"].startswith("msg_")
+        assert out["content"][0]["type"] == "text"
+        assert out["content"][0]["text"]
+        assert out["stop_reason"] == "max_tokens"
+        assert out["usage"]["output_tokens"] == 6
+        assert out["usage"]["input_tokens"] > 0
+    finally:
+        await stop_stack(rt, worker, watcher, service)
+
+
+async def test_anthropic_messages_stream_framing():
+    rt, worker, watcher, service, manager, port = await start_stack()
+    try:
+        body = {"model": MODEL, "max_tokens": 4, "stream": True,
+                "messages": [{"role": "user", "content": "hi"}],
+                "ignore_eos": True}
+        events = []
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{port}/v1/messages",
+                              json=body) as r:
+                assert r.status == 200
+                raw = (await r.read()).decode()
+        for block in raw.strip().split("\n\n"):
+            lines = dict(ln.split(": ", 1) for ln in block.splitlines()
+                         if ": " in ln)
+            if "event" in lines:
+                events.append((lines["event"], json.loads(lines["data"])))
+        names = [e[0] for e in events]
+        assert names[0] == "message_start"
+        assert names[1] == "content_block_start"
+        assert "content_block_delta" in names
+        assert names[-3:] == ["content_block_stop", "message_delta",
+                              "message_stop"]
+        start = events[0][1]
+        assert start["message"]["usage"]["input_tokens"] > 0
+        md = next(d for n, d in events if n == "message_delta")
+        assert md["delta"]["stop_reason"] == "max_tokens"
+        assert md["usage"]["output_tokens"] == 4
+        text = "".join(d["delta"]["text"] for n, d in events
+                       if n == "content_block_delta")
+        assert text
+    finally:
+        await stop_stack(rt, worker, watcher, service)
+
+
+async def test_anthropic_count_tokens_and_errors():
+    rt, worker, watcher, service, manager, port = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://127.0.0.1:{port}/v1/messages/count_tokens",
+                    json={"model": MODEL,
+                          "messages": [{"role": "user",
+                                        "content": "hello world"}]}) as r:
+                assert r.status == 200
+                assert (await r.json())["input_tokens"] > 0
+            # max_tokens required
+            async with s.post(f"http://127.0.0.1:{port}/v1/messages",
+                              json={"model": MODEL,
+                                    "messages": []}) as r:
+                assert r.status == 400
+                err = await r.json()
+                assert err["type"] == "error"
+            # unknown model -> anthropic-shaped 404
+            async with s.post(f"http://127.0.0.1:{port}/v1/messages",
+                              json={"model": "nope", "max_tokens": 4,
+                                    "messages": []}) as r:
+                assert r.status == 404
+                assert (await r.json())["error"]["type"] == \
+                    "not_found_error"
+    finally:
+        await stop_stack(rt, worker, watcher, service)
+
+
+# ----------------------------- KServe gRPC ----------------------------------
+
+
+def _infer_request(pb, prompt: str, stream=False, max_tokens=5):
+    req = pb.ModelInferRequest(model_name=MODEL, id="req-1")
+    t = req.inputs.add()
+    t.name, t.datatype = "text_input", "BYTES"
+    t.shape.append(1)
+    t.contents.bytes_contents.append(prompt.encode())
+    req.parameters["max_tokens"].int64_param = max_tokens
+    req.parameters["ignore_eos"].bool_param = True
+    return req
+
+
+async def test_kserve_grpc_end_to_end():
+    import grpc
+
+    from dynamo_tpu.frontend import kserve_pb2 as pb
+    from dynamo_tpu.frontend.kserve import SERVICE, KserveGrpcService
+
+    rt, worker, watcher, service, manager, port = await start_stack()
+    ks = await KserveGrpcService(rt, manager, host="127.0.0.1",
+                                 port=0).start()
+    try:
+        async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{ks.bound_port}") as ch:
+            live = ch.unary_unary(
+                f"/{SERVICE}/ServerLive",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ServerLiveResponse.FromString)
+            assert (await live(pb.ServerLiveRequest())).live
+
+            ready = ch.unary_unary(
+                f"/{SERVICE}/ModelReady",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ModelReadyResponse.FromString)
+            assert (await ready(pb.ModelReadyRequest(name=MODEL))).ready
+            assert not (await ready(pb.ModelReadyRequest(name="nope"))).ready
+
+            meta = ch.unary_unary(
+                f"/{SERVICE}/ModelMetadata",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ModelMetadataResponse.FromString)
+            md = await meta(pb.ModelMetadataRequest(name=MODEL))
+            assert md.platform == "dynamo_tpu"
+            assert md.inputs[0].name == "text_input"
+            assert md.outputs[0].name == "text_output"
+
+            infer = ch.unary_unary(
+                f"/{SERVICE}/ModelInfer",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ModelInferResponse.FromString)
+            resp = await infer(_infer_request(pb, "hello grpc"))
+            assert resp.model_name == MODEL and resp.id == "req-1"
+            out = resp.outputs[0]
+            assert out.name == "text_output"
+            text = out.contents.bytes_contents[0].decode()
+            assert text.strip()
+            assert resp.parameters["finish_reason"].string_param == "length"
+
+            stream = ch.stream_stream(
+                f"/{SERVICE}/ModelStreamInfer",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=(
+                    pb.ModelStreamInferResponse.FromString))
+            call = stream()
+            await call.write(_infer_request(pb, "stream me",
+                                            max_tokens=4))
+            await call.done_writing()
+            chunks = []
+            final = 0
+            async for item in call:
+                assert not item.error_message
+                ir = item.infer_response
+                chunks.append(
+                    ir.outputs[0].contents.bytes_contents[0].decode())
+                if ir.parameters["triton_final_response"].bool_param:
+                    final += 1
+            assert final == 1 and len(chunks) >= 2
+            assert "".join(chunks).strip()
+    finally:
+        await ks.close()
+        await stop_stack(rt, worker, watcher, service)
+
+
+async def test_kserve_unknown_model_aborts():
+    import grpc
+
+    from dynamo_tpu.frontend import kserve_pb2 as pb
+    from dynamo_tpu.frontend.kserve import SERVICE, KserveGrpcService
+
+    rt, worker, watcher, service, manager, port = await start_stack()
+    ks = await KserveGrpcService(rt, manager, host="127.0.0.1",
+                                 port=0).start()
+    try:
+        async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{ks.bound_port}") as ch:
+            infer = ch.unary_unary(
+                f"/{SERVICE}/ModelInfer",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ModelInferResponse.FromString)
+            req = _infer_request(pb, "x")
+            req.model_name = "missing"
+            try:
+                await infer(req)
+                raise AssertionError("expected NOT_FOUND")
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await ks.close()
+        await stop_stack(rt, worker, watcher, service)
+
+
+def test_stop_reason_mapping():
+    from dynamo_tpu.frontend.anthropic import _stop_reason
+    from dynamo_tpu.frontend.pipeline import ModelPipeline
+
+    assert _stop_reason("length", None) == ("max_tokens", None)
+    assert _stop_reason("stop", "###") == ("stop_sequence", "###")
+    # EOS also reports finish "stop" but with no matched trigger
+    assert _stop_reason("stop", None) == ("end_turn", None)
+    cut, which = ModelPipeline._find_stop("abc###def", ["def", "###"])
+    assert (cut, which) == (3, "###")
+    assert ModelPipeline._find_stop("abc", ["x"]) == (None, None)
+
+
+def test_anthropic_block_conversion():
+    import pytest as _pytest
+
+    from dynamo_tpu.frontend.anthropic import _convert_blocks, _to_chat_body
+
+    parts = _convert_blocks([
+        {"type": "text", "text": "hi"},
+        {"type": "image", "source": {"type": "base64",
+                                     "media_type": "image/png",
+                                     "data": "QUJD"}}])
+    assert parts[0] == {"type": "text", "text": "hi"}
+    assert parts[1]["image_url"]["url"].startswith("data:image/png;base64,")
+    with _pytest.raises(ValueError):
+        _convert_blocks([{"type": "tool_result"}])
+    chat, stops = _to_chat_body({
+        "model": "m", "max_tokens": 5, "stop_sequences": ["##"],
+        "system": [{"type": "text", "text": "sys"}],
+        "messages": [{"role": "user", "content": "q"}],
+        "tools": [{"name": "f", "description": "d",
+                   "input_schema": {"type": "object"}}]})
+    assert chat["messages"][0] == {"role": "system", "content": "sys"}
+    assert chat["tools"][0]["function"]["name"] == "f"
+    assert stops == ["##"]
