@@ -43,6 +43,10 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--disk-cache-dir", default="",
                    help="G3 disk KV cache directory")
     p.add_argument("--disk-cache-blocks", type=int, default=0)
+    p.add_argument("--object-store-dir", default="",
+                   help="G4 cluster-shared object store (shared FS path)")
+    p.add_argument("--no-kvbm-remote", action="store_true",
+                   help="disable cross-worker G2 pull")
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--role", default="both",
                    choices=["both", "prefill", "decode"])
@@ -73,6 +77,8 @@ async def main() -> None:
         host_cache_blocks=args.host_cache_blocks,
         disk_cache_dir=args.disk_cache_dir or None,
         disk_cache_blocks=args.disk_cache_blocks,
+        object_store_dir=args.object_store_dir or None,
+        kvbm_remote=not args.no_kvbm_remote,
         role=args.role,
         reasoning_parser=args.reasoning_parser,
         lora_dir=args.lora_dir or None,

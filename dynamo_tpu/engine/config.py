@@ -68,6 +68,14 @@ class EngineConfig:
     host_cache_blocks: int = 0
     disk_cache_dir: Optional[str] = None   # G3; needs disk_cache_blocks > 0
     disk_cache_blocks: int = 0
+    # G4 cluster-shared object store (kvbm/object_store.py): demotions
+    # that would otherwise drop spill here; any worker onboards them
+    object_store_dir: Optional[str] = None
+    object_store_ttl_s: Optional[float] = None
+    # cross-worker G2 pull (kvbm/remote.py): prefetch missing prefix
+    # blocks from a peer's host cache at admission time
+    kvbm_remote: bool = True
+    kvbm_remote_max_blocks: int = 64
     offload_watermark_blocks: int = 0      # 0 = num_blocks // 4
     offload_batch: int = 16                # max blocks gathered per step
 

@@ -177,12 +177,21 @@ class JaxEngine:
             raise ValueError(
                 "disk_cache_dir (G3) requires disk_cache_blocks > 0"
             )
+        if config.object_store_dir and config.host_cache_blocks <= 0:
+            raise ValueError(
+                "object_store_dir (G4) requires host_cache_blocks > 0: the "
+                "object tier is fed by demotion down the tier ladder")
         if config.host_cache_blocks > 0:
             self.kvbm = TieredKvManager(
                 config.host_cache_blocks,
                 disk_dir=config.disk_cache_dir,
                 disk_blocks=config.disk_cache_blocks,
+                object_dir=config.object_store_dir,
+                object_ttl_s=config.object_store_ttl_s,
             )
+        # cross-worker G2 pull (kvbm/remote.py): installed by the worker;
+        # async callable(hashes) -> [(h, k, v), ...]
+        self.remote_kvbm_fetch = None
         self._offload_watermark = (
             config.offload_watermark_blocks or config.num_blocks // 4
         )
@@ -542,6 +551,14 @@ class JaxEngine:
                     logger.warning("KV pull failed for %s; local prefill "
                                    "fallback", request.request_id,
                                    exc_info=True)
+        if self.kvbm is not None and self.remote_kvbm_fetch is not None:
+            try:
+                await self._remote_prefetch(request)
+            except Exception:
+                # remote warm-up is an optimization; local prefill is the
+                # always-correct fallback
+                logger.warning("remote KVBM prefetch failed for %s",
+                               request.request_id, exc_info=True)
         lora_idx = 0
         if request.lora_name:
             if self.lora_bank is None:
@@ -941,6 +958,63 @@ class JaxEngine:
                 # no dispatchable decode work: flush the pipeline tail so
                 # trailing tokens/finishes are delivered promptly
                 self._drain_inflight()
+
+    # -- distributed KVBM (kvbm/remote.py) ---------------------------------
+    async def _remote_prefetch(self, request: PreprocessedRequest) -> None:
+        """Pull this prompt's missing leading blocks from a peer's host
+        cache and stage them into the LOCAL G2, so admission's existing
+        G2 onboarding path finds them — no scheduler-thread changes.
+        Racy local-presence checks are safe: the worst case is pulling a
+        block that arrived locally meanwhile (the stage skips it)."""
+        from ..tokens import compute_block_hashes_for_request
+
+        hashes = compute_block_hashes_for_request(
+            request.token_ids, self.config.block_size,
+            lora_name=request.lora_name,
+            media_hashes=request.media_hashes,
+        )
+        start = 0
+        while start < len(hashes) and hashes[start] in self.kvbm:
+            start += 1
+        if start >= len(hashes):
+            return
+        blocks = await self.remote_kvbm_fetch(hashes[start:])
+        if not blocks:
+            return
+
+        def stage() -> int:
+            n = 0
+            for h, k, v in blocks:
+                if h in self.kvbm:
+                    continue
+                self._emit_tier_events(self.kvbm.offload(h, k, v))
+                n += 1
+            return n
+
+        staged = await self._call_on_scheduler(stage)
+        if staged:
+            self.metrics["remote_onboarded"] = (
+                self.metrics.get("remote_onboarded", 0) + staged)
+            logger.info("staged %d remote KV blocks for %s", staged,
+                        request.request_id)
+
+    def read_host_blocks(self, hashes: List[int]):
+        """Serve a peer's pull: fetch each block from the local tiers
+        (promoting to G2 — a peer pulling it marks the prefix hot) until
+        the first miss.  Runs between scheduler steps."""
+
+        def read():
+            out = []
+            for h in hashes:
+                blk, events = self.kvbm.fetch(h) if self.kvbm is not None \
+                    else (None, [])
+                self._emit_tier_events(events)
+                if blk is None:
+                    break
+                out.append((h, blk[0], blk[1]))
+            return out
+
+        return self._call_on_scheduler(read)
 
     # -- KVBM offload/onboard ----------------------------------------------
     def _maybe_offload(self) -> None:

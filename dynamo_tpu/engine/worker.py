@@ -228,6 +228,19 @@ class JaxEngineWorker:
             n = await self.engine.clear_kv_blocks()
             yield {"cleared_blocks": n}
 
+        async def kvbm_pull_handler(payload, ctx):
+            """Cross-worker G2 pull (kvbm/remote.py): stream this worker's
+            host-tier copies of the requested block run; a None hash marks
+            where the run broke (peer eviction)."""
+            from ..kvbm.remote import encode_block
+
+            hashes = list(payload.get("hashes", []))[:128]
+            blocks = await self.engine.read_host_blocks(hashes)
+            for h, k, v in blocks:
+                yield encode_block(h, k, v)
+            if len(blocks) < len(hashes):
+                yield {"h": None}
+
         async def kv_pull_handler(payload, ctx):
             """Stream a parked prefill's KV: a layout header, then
             byte-bounded (layer, block-range) slabs, then release the
@@ -261,6 +274,20 @@ class JaxEngineWorker:
             await comp.endpoint("kv_pull").serve_endpoint(
                 kv_pull_handler, instance_id=instance_id),
         ]
+        if self.engine.kvbm is not None and self.config.kvbm_remote:
+            from ..kvbm.remote import RemoteBlockIndex, RemoteKvbmPuller
+
+            self._aux_served.append(
+                await comp.endpoint("kvbm_pull").serve_endpoint(
+                    kvbm_pull_handler, instance_id=instance_id))
+            self._kvbm_index = await RemoteBlockIndex(
+                rt, self.namespace, self.component, instance_id).start()
+            self._kvbm_pull_client = await (
+                comp.endpoint("kvbm_pull").client().start())
+            self.engine.remote_kvbm_fetch = RemoteKvbmPuller(
+                self._kvbm_index, self._kvbm_pull_client,
+                max_blocks=self.config.kvbm_remote_max_blocks,
+            ).fetch_run
         if self.engine.supports_embedding and self.mh.world == 1:
             # multi-host slices serve generate only: embed does not ride
             # the step broadcast, so a leader-only dispatch would hang the
@@ -426,6 +453,10 @@ class JaxEngineWorker:
             m.set("dynamo_engine_itl_ema_seconds", self.engine.itl_ema_s)
 
     async def close(self) -> None:
+        if getattr(self, "_kvbm_index", None) is not None:
+            await self._kvbm_index.close()
+        if getattr(self, "_kvbm_pull_client", None) is not None:
+            await self._kvbm_pull_client.close()
         if self._follower is not None:
             self._follower.stop()
         if self._follower_task is not None:

@@ -49,10 +49,17 @@ class _OffloadSkip:
 
 class TieredKvManager:
     def __init__(self, host_blocks: int, disk_dir: Optional[str] = None,
-                 disk_blocks: int = 0):
+                 disk_blocks: int = 0, object_dir: Optional[str] = None,
+                 object_ttl_s: Optional[float] = None):
+        from .object_store import ObjectStorePool
+
         self.g2 = HostBlockPool(host_blocks)
         self.g3 = (DiskBlockPool(disk_dir, disk_blocks)
                    if disk_dir and disk_blocks > 0 else None)
+        # G4: cluster-shared content-addressed store; receives what the
+        # local tier ladder would otherwise drop (object_store.py)
+        self.g4 = (ObjectStorePool(object_dir, ttl_s=object_ttl_s)
+                   if object_dir else None)
         self.stats = {"offloaded": 0, "onboarded": 0, "demoted": 0,
                       "dropped": 0, "disk_hits": 0}
         # cooldown FIFO of capacity-dropped hashes; bounded so entries age
@@ -74,7 +81,8 @@ class TieredKvManager:
             self._dropped.popitem(last=False)
 
     def __contains__(self, h: int) -> bool:
-        return h in self.g2 or (self.g3 is not None and h in self.g3)
+        return (h in self.g2 or (self.g3 is not None and h in self.g3)
+                or (self.g4 is not None and h in self.g4))
 
     def offload(self, h: int, k: np.ndarray, v: np.ndarray) -> TierEvents:
         """Place one block into G2; returns tier events."""
@@ -85,19 +93,35 @@ class TieredKvManager:
             events.extend(self._demote(victim_h, blk))
         return events
 
+    def _spill_to_g4(self, h: int, blk: Optional[Block]) -> TierEvents:
+        """Last stop before dropping: park the block in the shared object
+        store.  G4 events are still published per-worker — the
+        consolidator nets them, and the router keeps seeing the prefix as
+        onboardable somewhere."""
+        if self.g4 is not None and blk is not None:
+            if self.g4.put(h, *blk):
+                self.stats["g4_spilled"] = self.stats.get("g4_spilled", 0) + 1
+                return [([h], [], "g4")]
+            return []  # already in G4 (same content by construction)
+        self.stats["dropped"] += 1
+        self._mark_dropped(h)
+        return []
+
     def _demote(self, h: int, blk: Block) -> TierEvents:
         if self.g3 is None:
-            self.stats["dropped"] += 1
-            self._mark_dropped(h)
-            return [([], [h], "g2")]
+            events = self._spill_to_g4(h, blk)
+            events.append(([], [h], "g2"))
+            return events
         self.stats["demoted"] += 1
-        dropped = self.g3.put(h, *blk)
+        if self.g4 is not None:
+            dropped = self.g3.put_with_victims(h, *blk)
+        else:
+            dropped = [(old, None) for old in self.g3.put(h, *blk)]
         # one batch carries one tier: g3 store first, then the g2 removal,
         # so the consolidator never sees the block tierless in between
         events: TierEvents = [([h], [], "g3"), ([], [h], "g2")]
-        for old in dropped:
-            self.stats["dropped"] += 1
-            self._mark_dropped(old)
+        for old, old_blk in dropped:
+            events.extend(self._spill_to_g4(old, old_blk))
             events.append(([], [old], "g3"))
         return events
 
@@ -129,6 +153,14 @@ class TieredKvManager:
                     events.extend(self._demote(victim_h, victim))
             elif was_held:
                 events.append(([], [h], "g3"))
+        if blk is None and self.g4 is not None:
+            blk = self.g4.get(h)
+            if blk is not None:
+                # promote into G2 (the blob stays in G4 — it's shared)
+                self.stats["g4_hits"] = self.stats.get("g4_hits", 0) + 1
+                events.append(([h], [], "g2"))
+                for victim_h, victim in self.g2.put(h, *blk):
+                    events.extend(self._demote(victim_h, victim))
         if blk is None:
             return None, events
         self.stats["onboarded"] += 1

@@ -1,0 +1,115 @@
+"""G4 object-store KV tier: cluster-shared, content-addressed block blobs.
+
+Ref: lib/kvbm-engine's G4 object tier (kvbm-design.md tier ladder
+G1 HBM → G2 host → G3 disk → G4 object store).  Unlike G2/G3, which are
+instance-owned caches with capacity eviction, G4 is a shared namespace:
+blocks are immutable blobs keyed by content (PLH ⇒ the key commits to
+the full token prefix, so two engines writing the same hash wrote the
+same bytes — last-write-wins is a no-op).  Any worker may onboard any
+worker's demotions, which is what makes the tier "distributed": a
+restarted or new replica warms from the fleet's history without talking
+to the engine that produced the blocks.
+
+Backend: a filesystem directory (shared FS / FUSE-mounted bucket — the
+same deployment seam the reference's object client fills with S3).  Puts
+are atomic (tmp + rename), reads tolerate concurrent GC, and GC is
+TTL-by-mtime so any number of clients can run it without coordination.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import secrets
+import time
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+Block = Tuple[np.ndarray, np.ndarray]
+
+
+class ObjectStorePool:
+    """Content-addressed blob directory; no instance ownership."""
+
+    def __init__(self, directory: str, ttl_s: Optional[float] = None):
+        self.dir = directory
+        self.ttl_s = ttl_s
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, h: int) -> str:
+        hx = f"{h & 0xFFFFFFFFFFFFFFFF:016x}"
+        # two-level fanout: shared directories degrade with flat millions
+        return os.path.join(self.dir, hx[:2], hx)
+
+    def __contains__(self, h: int) -> bool:
+        return os.path.isfile(self._path(h))
+
+    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> bool:
+        """Atomic write; returns False if the blob already existed (same
+        content by construction — PLH keys commit to the payload)."""
+        p = self._path(h)
+        if os.path.isfile(p):
+            return False
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = f"{p}.tmp{secrets.token_hex(4)}"
+        try:
+            with open(tmp, "wb") as f:
+                # npz round-trips ml_dtypes (bfloat16) as raw void; persist
+                # byte views + dtype names (same trick as DiskBlockPool)
+                np.savez(f, k=np.ascontiguousarray(k).view(np.uint8),
+                         v=np.ascontiguousarray(v).view(np.uint8),
+                         kd=str(k.dtype), vd=str(v.dtype))
+            os.replace(tmp, p)
+        except OSError:
+            logger.warning("G4 put failed for %016x", h, exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        return True
+
+    def get(self, h: int) -> Optional[Block]:
+        from .pools import _np_dtype
+
+        try:
+            with np.load(self._path(h)) as z:
+                return (z["k"].view(_np_dtype(z["kd"].item())),
+                        z["v"].view(_np_dtype(z["vd"].item())))
+        except (OSError, KeyError, ValueError, TypeError, AttributeError):
+            return None  # concurrent GC / torn write: treat as miss
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """TTL GC by mtime; safe to run from any client concurrently."""
+        if self.ttl_s is None:
+            return 0
+        now = now if now is not None else time.time()
+        removed = 0
+        for sub in os.listdir(self.dir):
+            d = os.path.join(self.dir, sub)
+            if not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                p = os.path.join(d, name)
+                try:
+                    if now - os.path.getmtime(p) > self.ttl_s:
+                        os.unlink(p)
+                        removed += 1
+                except OSError:
+                    continue
+        return removed
+
+    def keys(self) -> Iterable[int]:
+        for sub in os.listdir(self.dir):
+            d = os.path.join(self.dir, sub)
+            if not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                if len(name) == 16 and not name.endswith(".tmp"):
+                    try:
+                        yield int(name, 16)
+                    except ValueError:
+                        continue

@@ -147,6 +147,28 @@ class DiskBlockPool:
             evicted.append(old)
         return evicted
 
+    def put_with_victims(self, h: int, k: np.ndarray,
+                         v: np.ndarray) -> List[Tuple[int, Optional[Block]]]:
+        """Like put(), but each victim's payload is read back before its
+        file is deleted — the G4 spill path needs the bytes (one extra
+        disk read per eviction, paid only when G4 is configured)."""
+        if h in self._order:
+            self._order.move_to_end(h)
+            return []
+        np.savez(self._path(h),
+                 k=np.ascontiguousarray(k).view(np.uint8),
+                 v=np.ascontiguousarray(v).view(np.uint8),
+                 kd=str(k.dtype), vd=str(v.dtype))
+        self._order[h] = None
+        evicted: List[Tuple[int, Optional[Block]]] = []
+        while len(self._order) > self.capacity:
+            old = next(iter(self._order))
+            blk = self.get(old)  # may drop `old` itself if unreadable
+            if self._order.pop(old, None) is not None:
+                self._unlink(old)
+            evicted.append((old, blk))
+        return evicted
+
     def get(self, h: int) -> Optional[Block]:
         """Returns the block, or None.  An unreadable file is dropped from
         the pool — callers that saw `h in pool` beforehand must treat a None

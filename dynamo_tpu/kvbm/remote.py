@@ -1,0 +1,188 @@
+"""Cross-worker G2 pull: onboard KV blocks from a peer's host cache.
+
+Ref: lib/kvbm-engine/src/leader/ — the reference's distributed KVBM has
+a leader that knows which worker holds which block and brokers
+onboarding between them.  The TPU-native redesign is leaderless: every
+worker already publishes tiered KV events (router/events.py), so a
+`RemoteBlockIndex` built from the SAME event stream the router consumes
+tells any worker which peers hold a block's G2/G3 copy.  The pull itself
+rides the request plane (`kvbm_pull` endpoint, host-staged like
+disagg/transfer.py), and the pulled payloads are staged into the LOCAL
+G2 — admission's existing `_try_onboard` then finds them without any
+scheduler-thread changes.
+
+Flow (engine/core.py generate()):
+  request arrives → leading block hashes missing locally → index names
+  the peer with the longest run → pull over TCP → stage into local G2 →
+  admission onboards from G2 into HBM instead of recomputing prefill.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..router.events import KvCacheEvent, kv_event_subject
+
+logger = logging.getLogger(__name__)
+
+# tiers a peer can serve from host memory/disk without device work
+PULLABLE_TIERS = ("g2", "g3")
+
+
+class RemoteBlockIndex:
+    """hash -> set(worker ids) for host-resident (G2/G3) blocks, built by
+    following the component's KV event stream."""
+
+    def __init__(self, runtime, namespace: str, component: str,
+                 self_worker_id: int):
+        self.runtime = runtime
+        self.subject = kv_event_subject(namespace, component)
+        self.self_id = self_worker_id
+        # hash -> worker -> tiers holding it.  Per-tier tracking matters:
+        # a G2→G3 demotion is (g3 stored, g2 removed) on the SAME worker,
+        # which must not erase the holder.
+        self.holders: Dict[int, Dict[int, Set[str]]] = {}
+        self._cancel = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "RemoteBlockIndex":
+        self._task = asyncio.get_running_loop().create_task(self._follow())
+        return self
+
+    async def _follow(self) -> None:
+        try:
+            async for _subj, payload in self.runtime.event_plane.subscribe(
+                    self.subject, self._cancel):
+                try:
+                    ev = KvCacheEvent.from_wire(payload)
+                except Exception:
+                    continue
+                if ev.worker_id == self.self_id:
+                    continue  # local blocks are found via the local kvbm
+                if ev.op == "cleared":
+                    self.drop_worker(ev.worker_id)
+                    continue
+                if ev.tier not in PULLABLE_TIERS:
+                    continue
+                if ev.op == "stored":
+                    for h in ev.block_hashes:
+                        self.holders.setdefault(h, {}).setdefault(
+                            ev.worker_id, set()).add(ev.tier)
+                elif ev.op == "removed":
+                    for h in ev.block_hashes:
+                        by_worker = self.holders.get(h)
+                        if by_worker is None:
+                            continue
+                        tiers = by_worker.get(ev.worker_id)
+                        if tiers is not None:
+                            tiers.discard(ev.tier)
+                            if not tiers:
+                                del by_worker[ev.worker_id]
+                        if not by_worker:
+                            del self.holders[h]
+        except asyncio.CancelledError:
+            pass
+
+    def drop_worker(self, worker_id: int) -> None:
+        for h in list(self.holders):
+            by_worker = self.holders[h]
+            by_worker.pop(worker_id, None)
+            if not by_worker:
+                del self.holders[h]
+
+    def best_run(self, hashes: Sequence[int]) -> Tuple[Optional[int], int]:
+        """(worker, run_length): the peer holding the longest leading run
+        of `hashes`."""
+        first = self.holders.get(hashes[0]) if hashes else None
+        if not first:
+            return None, 0
+        best_w, best_n = None, 0
+        for w in first:
+            n = 0
+            for h in hashes:
+                if w not in self.holders.get(h, {}):
+                    break
+                n += 1
+            if n > best_n:
+                best_w, best_n = w, n
+        return best_w, best_n
+
+    async def close(self) -> None:
+        self._cancel.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+def encode_block(h: int, k: np.ndarray, v: np.ndarray) -> Dict:
+    return {"h": h,
+            "k": np.ascontiguousarray(k).view(np.uint8).tobytes(),
+            "v": np.ascontiguousarray(v).view(np.uint8).tobytes(),
+            "kd": str(k.dtype), "vd": str(v.dtype),
+            "kshape": list(k.shape), "vshape": list(v.shape)}
+
+
+def decode_block(d: Dict) -> Tuple[int, np.ndarray, np.ndarray]:
+    from .pools import _np_dtype
+
+    k = np.frombuffer(d["k"], np.uint8).view(
+        _np_dtype(d["kd"])).reshape(d["kshape"])
+    v = np.frombuffer(d["v"], np.uint8).view(
+        _np_dtype(d["vd"])).reshape(d["vshape"])
+    return d["h"], k, v
+
+
+class RemoteKvbmPuller:
+    """Client side: pull a run of blocks from the best-placed peer."""
+
+    def __init__(self, index: RemoteBlockIndex, client,
+                 max_blocks: int = 64, timeout_s: float = 10.0):
+        self.index = index
+        self.client = client  # kvbm_pull endpoint client
+        self.max_blocks = max_blocks
+        self.timeout_s = timeout_s
+
+    async def fetch_run(
+        self, hashes: Sequence[int]
+    ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
+        """Blocks for the longest leading run a single peer holds (may
+        return fewer than advertised — peers evict concurrently)."""
+        hashes = list(hashes)[: self.max_blocks]
+        worker, run = self.index.best_run(hashes)
+        if worker is None or run == 0:
+            return []
+        want = hashes[:run]
+        out: List[Tuple[int, np.ndarray, np.ndarray]] = []
+
+        async def pull() -> None:
+            async for frame in self.client.generate(
+                    {"hashes": want}, instance_id=worker):
+                if frame.get("h") is None:
+                    break  # peer signals end-of-run (evicted mid-walk)
+                out.append(decode_block(frame))
+
+        try:
+            await asyncio.wait_for(pull(), timeout=self.timeout_s)
+        except asyncio.TimeoutError:
+            logger.warning("kvbm pull from %d timed out after %d blocks",
+                           worker, len(out))
+        except Exception:
+            # peer died / evicted: whatever arrived is still usable, and
+            # the leading-run contract keeps partial results consistent
+            logger.warning("kvbm pull from %d failed after %d blocks",
+                           worker, len(out), exc_info=True)
+            self.index.drop_worker(worker)
+        # enforce the leading-run contract: a gap invalidates the tail
+        usable: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        for (h, k, v), expect in zip(out, want):
+            if h != expect:
+                break
+            usable.append((h, k, v))
+        return usable
