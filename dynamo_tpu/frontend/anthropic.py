@@ -62,6 +62,62 @@ def _convert_blocks(content) -> Any:
     return parts
 
 
+def _split_tool_blocks(m: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One Anthropic message -> one or more OpenAI-shaped messages,
+    peeling tool_use (assistant) and tool_result (user) blocks out of the
+    content list.  Prior assistant tool calls re-render as hermes
+    <tool_call> spans — the textual form the model emitted them in, so
+    any chat template reproduces the turn faithfully — and tool results
+    become role "tool" messages."""
+    role = m.get("role", "user")
+    content = m.get("content")
+    if not isinstance(content, list):
+        return [{"role": role, "content": _convert_blocks(content)}]
+    plain: List[Dict[str, Any]] = []
+    out: List[Dict[str, Any]] = []
+    for b in content:
+        btype = b.get("type") if isinstance(b, dict) else None
+        if btype == "tool_use":
+            plain.append({
+                "type": "text",
+                "text": "<tool_call>" + json.dumps(
+                    {"name": b.get("name", ""),
+                     "arguments": b.get("input", {})}) + "</tool_call>"})
+        elif btype == "thinking":
+            continue  # prior-turn reasoning is not replayed into context
+        elif btype == "tool_result":
+            inner = b.get("content")
+            if inner is None:
+                inner = ""  # tools may legally return nothing
+            if isinstance(inner, list):
+                texts = []
+                for x in inner:
+                    if isinstance(x, dict) and x.get("type") == "text":
+                        texts.append(x.get("text", ""))
+                    else:
+                        raise ValueError(
+                            "unsupported tool_result content block "
+                            f"{x.get('type') if isinstance(x, dict) else x!r}")
+                inner = "".join(texts)
+            if not isinstance(inner, str):
+                inner = json.dumps(inner)
+            if b.get("is_error"):
+                # OpenAI tool messages carry no error field; mark the
+                # failure in-band so the model sees it failed
+                inner = f"[tool execution failed] {inner}"
+            out.append({"role": "tool",
+                        "tool_call_id": b.get("tool_use_id", ""),
+                        "content": inner})
+        else:
+            plain.append(b)
+    # tool messages come first (directly after the assistant tool-call
+    # turn — Anthropic requires tool_result blocks lead the message, and
+    # chat templates validate that adjacency); trailing user text follows
+    if plain or not out:
+        out.append({"role": role, "content": _convert_blocks(plain)})
+    return out
+
+
 def _to_chat_body(body: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
     """Anthropic request -> OpenAI-chat-shaped body for the preprocessor.
     Returns (chat_body, stop_sequences)."""
@@ -73,8 +129,7 @@ def _to_chat_body(body: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
                              if isinstance(b, dict))
         messages.append({"role": "system", "content": system})
     for m in body.get("messages", []):
-        messages.append({"role": m.get("role", "user"),
-                         "content": _convert_blocks(m.get("content"))})
+        messages.extend(_split_tool_blocks(m))
     stops = list(body.get("stop_sequences") or [])
     chat = {
         "model": body.get("model", ""),
@@ -96,7 +151,25 @@ def _to_chat_body(body: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
         chat["top_p"] = body["top_p"]
     if body.get("top_k") is not None:
         chat["top_k"] = body["top_k"]
+    if body.get("ignore_eos"):  # benchmarking extension, same as OpenAI
+        chat["ignore_eos"] = True
     return chat, stops
+
+
+def _tool_use_block(call: Dict[str, Any]) -> Dict[str, Any]:
+    """OpenAI tool_call dict (parsers.py wire shape) -> Anthropic
+    tool_use content block; arguments re-parse from the JSON string the
+    parser validated."""
+    fn = call.get("function", {})
+    try:
+        args = json.loads(fn.get("arguments") or "{}")
+    except ValueError:
+        args = {}
+    return {"type": "tool_use",
+            "id": call.get("id", "").replace("call_", "toolu_", 1)
+            or f"toolu_{secrets.token_hex(8)}",
+            "name": fn.get("name", ""),
+            "input": args}
 
 
 def _stop_reason(finish: Optional[str],
@@ -179,6 +252,12 @@ class AnthropicRoutes:
         tp = tracker.traceparent()
         if tp is not None and svc.trace_sink.config.enabled:
             req.annotations = list(req.annotations) + [f"traceparent:{tp}"]
+        # Same output-parser composition the OpenAI routes run:
+        # Anthropic clients must see tool_use blocks / stop_reason
+        # "tool_use", never raw <tool_call> text.
+        from .parsers import OutputParser
+
+        parser = OutputParser.for_request(pipeline, body)
         token = svc.runtime.root_token.child()
         svc._inflight_delta(+1)
         svc._m_requests.inc("dynamo_frontend_requests_total", model=model)
@@ -186,9 +265,9 @@ class AnthropicRoutes:
         try:
             if body.get("stream"):
                 return await self._stream(request, pipeline, req, model,
-                                          stops, token, tracker)
+                                          stops, token, tracker, parser)
             return await self._unary(pipeline, req, model, stops, token,
-                                     tracker)
+                                     tracker, parser)
         finally:
             svc._inflight_delta(-1)
             svc._m_requests.observe(
@@ -197,10 +276,22 @@ class AnthropicRoutes:
             token.detach()
 
     async def _unary(self, pipeline, req, model, stops, token,
-                     tracker) -> web.Response:
+                     tracker, parser=None) -> web.Response:
         from .service import HttpService, _LatencyProbe
 
         parts: List[str] = []
+        thinking: List[str] = []
+        tool_calls: List[Dict[str, Any]] = []
+
+        def feed(text: str) -> None:
+            if parser is None:
+                parts.append(text)
+                return
+            out = parser.push(text)
+            parts.append(out.content)
+            thinking.append(out.reasoning)
+            tool_calls.extend(out.tool_calls)
+
         finish = trigger = None
         ntok = 0
         probe = _LatencyProbe(self.service._m_requests, model)
@@ -210,24 +301,50 @@ class AnthropicRoutes:
                 if ntok == 0 and d.token_count:
                     tracker.cached_tokens = HttpService._kv_overlap_tokens(
                         pipeline, req.request_id)
-                parts.append(d.text)
+                feed(d.text)
                 probe.on_delta(d.token_count)
                 tracker.on_tokens(d.token_count)
                 ntok += d.token_count
                 if d.finish_reason:
                     finish, trigger = d.finish_reason, d.stop_trigger
+        except asyncio.CancelledError:
+            token.kill()  # client went away; stop the engine
+            tracker.finish(error="client_disconnected")
+            raise
         except Exception as e:
             logger.exception("anthropic messages failed")
             tracker.finish(error=str(e))
             return _error(500, "api_error", str(e))
-        stop_reason, stop_seq = _stop_reason(finish, trigger)
+        if parser is not None:
+            out = parser.flush()
+            parts.append(out.content)
+            thinking.append(out.reasoning)
+            tool_calls.extend(out.tool_calls)
+        content: List[Dict[str, Any]] = []
+        think_text = "".join(thinking)
+        if think_text:
+            # signature is required by Anthropic SDK response models; we
+            # have no signing scheme, so an empty signature satisfies the
+            # schema (clients never verify locally)
+            content.append({"type": "thinking", "thinking": think_text,
+                            "signature": ""})
+        text = "".join(parts)
+        if text or not (think_text or tool_calls):
+            content.append({"type": "text", "text": text})
+        for call in tool_calls:
+            content.append(_tool_use_block(call))
+        if tool_calls:
+            stop_reason, stop_seq = "tool_use", None
+        else:
+            stop_reason, stop_seq = _stop_reason(finish, trigger)
+        tracker.add_tool_calls(tool_calls)
         tracker.finish(finish_reason=stop_reason)
         return web.json_response({
             "id": f"msg_{secrets.token_hex(12)}",
             "type": "message",
             "role": "assistant",
             "model": model,
-            "content": [{"type": "text", "text": "".join(parts)}],
+            "content": content,
             "stop_reason": stop_reason,
             "stop_sequence": stop_seq,
             "usage": {"input_tokens": len(req.token_ids),
@@ -235,7 +352,7 @@ class AnthropicRoutes:
         }, headers={"X-Request-Id": tracker.x_request_id})
 
     async def _stream(self, request, pipeline, req, model, stops, token,
-                      tracker) -> web.StreamResponse:
+                      tracker, parser=None) -> web.StreamResponse:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -255,14 +372,84 @@ class AnthropicRoutes:
                         "stop_reason": None, "stop_sequence": None,
                         "usage": {"input_tokens": len(req.token_ids),
                                   "output_tokens": 0}}})
-        await event("content_block_start", {
-            "type": "content_block_start", "index": 0,
-            "content_block": {"type": "text", "text": ""}})
         from .service import HttpService, _LatencyProbe
+
+        # Typed content blocks open lazily as the parsed stream flips
+        # between thinking / text / tool_use, so block indices follow the
+        # Anthropic framing (one start/stop pair per block, in order).
+        blk = {"index": -1, "open": None}
+
+        async def close_block() -> None:
+            if blk["open"] is not None:
+                if blk["open"] == "thinking":
+                    # SDK ThinkingBlock requires a signature; emit an
+                    # empty one before the stop (no signing scheme here)
+                    await event("content_block_delta", {
+                        "type": "content_block_delta",
+                        "index": blk["index"],
+                        "delta": {"type": "signature_delta",
+                                  "signature": ""}})
+                await event("content_block_stop",
+                            {"type": "content_block_stop",
+                             "index": blk["index"]})
+                blk["open"] = None
+
+        async def open_block(kind: str, block: Dict[str, Any]) -> None:
+            await close_block()
+            blk["index"] += 1
+            blk["open"] = kind
+            await event("content_block_start", {
+                "type": "content_block_start", "index": blk["index"],
+                "content_block": block})
+
+        async def emit_text(text: str) -> None:
+            if blk["open"] != "text":
+                await open_block("text", {"type": "text", "text": ""})
+            await event("content_block_delta", {
+                "type": "content_block_delta", "index": blk["index"],
+                "delta": {"type": "text_delta", "text": text}})
+
+        async def emit_thinking(text: str) -> None:
+            if blk["open"] != "thinking":
+                await open_block("thinking", {"type": "thinking",
+                                              "thinking": "",
+                                              "signature": ""})
+            await event("content_block_delta", {
+                "type": "content_block_delta", "index": blk["index"],
+                "delta": {"type": "thinking_delta", "thinking": text}})
+
+        async def emit_tool(call: Dict[str, Any]) -> None:
+            # a parsed call is complete by construction (the parser only
+            # yields on the close tag), so the block emits as start →
+            # one input_json_delta carrying the full arguments → stop
+            block = _tool_use_block(call)
+            await open_block("tool_use", {"type": "tool_use",
+                                          "id": block["id"],
+                                          "name": block["name"],
+                                          "input": {}})
+            await event("content_block_delta", {
+                "type": "content_block_delta", "index": blk["index"],
+                "delta": {"type": "input_json_delta",
+                          "partial_json": json.dumps(block["input"])}})
+            await close_block()
 
         ntok = 0
         finish = trigger = None
+        saw_tools = False
+        flushed = False
         probe = _LatencyProbe(self.service._m_requests, model)
+
+        async def emit_parsed(text, thinking, calls) -> None:
+            nonlocal saw_tools
+            if thinking:
+                await emit_thinking(thinking)
+            if text:
+                await emit_text(text)
+            for call in calls:
+                saw_tools = True
+                tracker.add_tool_calls([call])
+                await emit_tool(call)
+
         try:
             async for d in pipeline.generate_deltas(req, token=token,
                                                     tracker=tracker):
@@ -272,16 +459,34 @@ class AnthropicRoutes:
                 probe.on_delta(d.token_count)
                 tracker.on_tokens(d.token_count)
                 ntok += d.token_count
-                if d.text:
-                    await event("content_block_delta", {
-                        "type": "content_block_delta", "index": 0,
-                        "delta": {"type": "text_delta", "text": d.text}})
+                text, thinking, calls = d.text, "", []
+                if parser is not None:
+                    out = parser.push(d.text)
+                    if d.finish_reason is not None:
+                        fl = parser.flush()
+                        flushed = True
+                        out.content += fl.content
+                        out.reasoning += fl.reasoning
+                        out.tool_calls.extend(fl.tool_calls)
+                    text, thinking, calls = (out.content, out.reasoning,
+                                             out.tool_calls)
+                await emit_parsed(text, thinking, calls)
                 if d.finish_reason:
                     finish, trigger = d.finish_reason, d.stop_trigger
                     break
-            stop_reason, stop_seq = _stop_reason(finish, trigger)
-            await event("content_block_stop",
-                        {"type": "content_block_stop", "index": 0})
+            if parser is not None and not flushed:
+                # stream ended without a finish_reason delta: recover
+                # whatever the parser still holds (unclosed spans)
+                fl = parser.flush()
+                await emit_parsed(fl.content, fl.reasoning, fl.tool_calls)
+            if saw_tools:
+                stop_reason, stop_seq = "tool_use", None
+            else:
+                stop_reason, stop_seq = _stop_reason(finish, trigger)
+            if blk["index"] < 0:
+                # zero-content stream: still frame one (empty) text block
+                await open_block("text", {"type": "text", "text": ""})
+            await close_block()
             await event("message_delta", {
                 "type": "message_delta",
                 "delta": {"stop_reason": stop_reason,

@@ -181,6 +181,18 @@ class OutputParser:
         self.tools = ToolCallParser() if tools else None
         self.saw_tool_call = False
 
+    @classmethod
+    def for_request(cls, pipeline, body: Dict[str, Any]):
+        """The one composition rule every HTTP route family shares
+        (OpenAI chat + Anthropic messages): tool-call extraction when the
+        request advertises tools, reasoning spans when the model card
+        declares a parser.  None when neither applies."""
+        reasoning = pipeline.mdc.runtime_config.get("reasoning_parser")
+        if not (body.get("tools") or reasoning):
+            return None
+        return cls(reasoning=reasoning or False,
+                   tools=bool(body.get("tools")))
+
     def push(self, delta: str) -> OutputDelta:
         out = OutputDelta()
         if self.reasoning is not None:

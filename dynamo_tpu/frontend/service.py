@@ -600,14 +600,7 @@ class HttpService:
         # when the model card declares a reasoning parser
         from .parsers import OutputParser
 
-        parser = None
-        if chat and (body.get("tools")
-                     or pipeline.mdc.runtime_config.get("reasoning_parser")):
-            parser = OutputParser(
-                reasoning=pipeline.mdc.runtime_config.get(
-                    "reasoning_parser") or False,
-                tools=bool(body.get("tools")),
-            )
+        parser = OutputParser.for_request(pipeline, body) if chat else None
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage"))
 

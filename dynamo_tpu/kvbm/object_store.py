@@ -40,7 +40,10 @@ class ObjectStorePool:
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, h: int) -> str:
-        hx = f"{h & 0xFFFFFFFFFFFFFFFF:016x}"
+        # full 128-bit PLH in the blob name: the key must commit to the
+        # whole token prefix (a truncated key could alias two lineages
+        # and serve another prefix's KV bytes)
+        hx = f"{h:032x}"
         # two-level fanout: shared directories degrade with flat millions
         return os.path.join(self.dir, hx[:2], hx)
 
@@ -64,7 +67,7 @@ class ObjectStorePool:
                          kd=str(k.dtype), vd=str(v.dtype))
             os.replace(tmp, p)
         except OSError:
-            logger.warning("G4 put failed for %016x", h, exc_info=True)
+            logger.warning("G4 put failed for %032x", h, exc_info=True)
             try:
                 os.unlink(tmp)
             except OSError:
@@ -108,7 +111,7 @@ class ObjectStorePool:
             if not os.path.isdir(d):
                 continue
             for name in os.listdir(d):
-                if len(name) == 16 and not name.endswith(".tmp"):
+                if len(name) == 32 and ".tmp" not in name:
                     try:
                         yield int(name, 16)
                     except ValueError:

@@ -94,8 +94,15 @@ def request_salt(lora_name: Optional[str] = None,
     parts = [lora_name or ""]
     if media_hashes:
         parts.extend(media_hashes)
-    salt = "|".join(parts)
-    return salt.encode() if salt != "" else b""
+    if len(parts) == 1 and not parts[0]:
+        return b""
+    # length-prefix each component so the salt is injective in its
+    # inputs: adapter "a|b" must never alias adapter "a" + media "b"
+    out = bytearray()
+    for p in parts:
+        enc = p.encode()
+        out += len(enc).to_bytes(4, "little") + enc
+    return bytes(out)
 
 
 def compute_block_hashes_for_request(

@@ -276,3 +276,151 @@ async def test_jax_engine_embed_pooled_unit_vector():
         assert v4.shape == (32,)
     finally:
         await eng.close()
+
+
+# --------------------- anthropic /v1/messages parsers ----------------------
+
+
+async def test_anthropic_messages_tools_unary():
+    stack = await start_stack(canned=CANNED, reasoning="deepseek_r1")
+    rt, worker, watcher, service, url = stack
+    try:
+        body = {
+            "model": "api-model",
+            "messages": [{"role": "user", "content": "weather?"}],
+            "max_tokens": 300,
+            "tools": [{"name": "f", "description": "",
+                       "input_schema": {"type": "object"}}],
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/messages", json=body) as r:
+                assert r.status == 200, await r.text()
+                data = await r.json()
+        kinds = [b["type"] for b in data["content"]]
+        assert kinds == ["thinking", "text", "tool_use"]
+        assert data["content"][0]["thinking"] == "I should call f"
+        assert "signature" in data["content"][0]
+        assert data["content"][1]["text"].strip() == "hello"
+        tu = data["content"][2]
+        assert tu["name"] == "f" and tu["input"] == {"x": 2}
+        assert tu["id"].startswith("toolu_")
+        assert data["stop_reason"] == "tool_use"
+        # no raw tags anywhere in the text block
+        assert "<tool_call>" not in data["content"][1]["text"]
+        assert "<think>" not in data["content"][1]["text"]
+    finally:
+        await stop_stack(*stack[:4])
+
+
+async def test_anthropic_messages_tools_stream():
+    stack = await start_stack(canned=CANNED, reasoning="deepseek_r1")
+    rt, worker, watcher, service, url = stack
+    try:
+        body = {
+            "model": "api-model",
+            "messages": [{"role": "user", "content": "go"}],
+            "max_tokens": 300,
+            "stream": True,
+            "tools": [{"name": "f", "description": "",
+                       "input_schema": {"type": "object"}}],
+        }
+        events = []
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/messages", json=body) as r:
+                assert r.status == 200
+                raw = (await r.read()).decode()
+        for block in raw.strip().split("\n\n"):
+            lines = dict(ln.split(": ", 1) for ln in block.splitlines()
+                         if ": " in ln)
+            if "event" in lines:
+                events.append((lines["event"], json.loads(lines["data"])))
+        starts = [d for n, d in events if n == "content_block_start"]
+        stops = [d for n, d in events if n == "content_block_stop"]
+        kinds = [d["content_block"]["type"] for d in starts]
+        assert kinds == ["thinking", "text", "tool_use"]
+        # indices strictly increase and every start has a stop
+        assert [d["index"] for d in starts] == [0, 1, 2]
+        assert sorted(d["index"] for d in stops) == [0, 1, 2]
+        thinking = "".join(
+            d["delta"]["thinking"] for n, d in events
+            if n == "content_block_delta"
+            and d["delta"]["type"] == "thinking_delta")
+        text = "".join(
+            d["delta"]["text"] for n, d in events
+            if n == "content_block_delta"
+            and d["delta"]["type"] == "text_delta")
+        tool_json = "".join(
+            d["delta"]["partial_json"] for n, d in events
+            if n == "content_block_delta"
+            and d["delta"]["type"] == "input_json_delta")
+        assert thinking == "I should call f"
+        # thinking block closes with a signature_delta (SDK schema)
+        assert any(n == "content_block_delta"
+                   and d["delta"]["type"] == "signature_delta"
+                   for n, d in events)
+        assert text.strip() == "hello" and "<tool_call>" not in text
+        assert json.loads(tool_json) == {"x": 2}
+        tu = next(d["content_block"] for d in starts
+                  if d["content_block"]["type"] == "tool_use")
+        assert tu["name"] == "f"
+        md = next(d for n, d in events if n == "message_delta")
+        assert md["delta"]["stop_reason"] == "tool_use"
+    finally:
+        await stop_stack(*stack[:4])
+
+
+def test_anthropic_tool_round_trip_messages():
+    from dynamo_tpu.frontend.anthropic import _to_chat_body
+
+    body = {
+        "model": "m", "max_tokens": 5,
+        "messages": [
+            {"role": "user", "content": "weather?"},
+            {"role": "assistant", "content": [
+                {"type": "thinking", "thinking": "hmm"},
+                {"type": "text", "text": "checking"},
+                {"type": "tool_use", "id": "toolu_1", "name": "f",
+                 "input": {"x": 2}}]},
+            {"role": "user", "content": [
+                {"type": "tool_result", "tool_use_id": "toolu_1",
+                 "content": [{"type": "text", "text": "sunny"}]}]},
+        ],
+    }
+    chat, _ = _to_chat_body(body)
+    msgs = chat["messages"]
+    roles = [m["role"] for m in msgs]
+    assert roles == ["user", "assistant", "tool"]
+    # assistant turn re-renders the call as the hermes span the model
+    # originally emitted; prior thinking is dropped from context
+    atext = "".join(p["text"] for p in msgs[1]["content"])
+    assert '<tool_call>{"name": "f", "arguments": {"x": 2}}</tool_call>' \
+        in atext
+    assert "hmm" not in atext
+    assert msgs[2]["tool_call_id"] == "toolu_1"
+    assert msgs[2]["content"] == "sunny"
+
+
+def test_anthropic_tool_result_precedes_trailing_text():
+    # Anthropic requires tool_result blocks to lead a user message; the
+    # peeled role-"tool" message must stay adjacent to the assistant
+    # tool-call turn, with the user's follow-up text AFTER it
+    from dynamo_tpu.frontend.anthropic import _split_tool_blocks
+
+    msgs = _split_tool_blocks({
+        "role": "user",
+        "content": [
+            {"type": "tool_result", "tool_use_id": "toolu_1",
+             "content": "sunny"},
+            {"type": "text", "text": "now summarize"}]})
+    assert [m["role"] for m in msgs] == ["tool", "user"]
+    assert msgs[0]["content"] == "sunny"
+
+    # non-text blocks inside tool_result raise (never silently dropped)
+    import pytest
+    with pytest.raises(ValueError):
+        _split_tool_blocks({
+            "role": "user",
+            "content": [{"type": "tool_result", "tool_use_id": "t",
+                         "content": [{"type": "image",
+                                      "source": {"type": "base64",
+                                                 "data": ""}}]}]})

@@ -284,3 +284,24 @@ async def test_disk_tier_survives_host_pressure(tmp_path):
     assert eng.kvbm.stats["disk_hits"] + eng.metrics.get(
         "onboarded_tokens", 0) > 0
     await eng.close()
+
+
+def test_object_store_keys_full_128_bits(tmp_path):
+    # G4 blob names must commit to the full 128-bit PLH: two hashes that
+    # collide in their low 64 bits must land in distinct blobs
+    import numpy as np
+    from dynamo_tpu.kvbm.object_store import ObjectStorePool
+
+    pool = ObjectStorePool(str(tmp_path))
+    low = 0xDEADBEEF_CAFEF00D
+    h1 = (1 << 64) | low
+    h2 = (2 << 64) | low
+    k1 = np.full((2, 2), 1, dtype=np.float32)
+    k2 = np.full((2, 2), 2, dtype=np.float32)
+    assert pool.put(h1, k1, k1)
+    assert pool.put(h2, k2, k2)
+    g1, g2 = pool.get(h1), pool.get(h2)
+    assert g1 is not None and g2 is not None
+    assert float(g1[0].view(np.float32).ravel()[0]) == 1.0
+    assert float(g2[0].view(np.float32).ravel()[0]) == 2.0
+    assert sorted(pool.keys()) == sorted([h1, h2])

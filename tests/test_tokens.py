@@ -68,3 +68,15 @@ def test_prefix_overlap():
     # hole in the middle stops the walk
     assert prefix_overlap_blocks(hs, {hs[0], hs[2], hs[3]}) == 1
     assert prefix_overlap_blocks(hs, set()) == 0
+
+
+def test_request_salt_injective():
+    # adapter "a|b" must not alias adapter "a" + media "b" (delimiter
+    # injection), and media ordering must matter
+    from dynamo_tpu.tokens.hashing import request_salt
+
+    assert request_salt("a|b") != request_salt("a", ["b"])
+    assert request_salt("a", ["b|c"]) != request_salt("a", ["b", "c"])
+    assert request_salt("ab") != request_salt("a", ["b"])
+    assert request_salt() == b""
+    assert request_salt("x") == request_salt("x")
