@@ -1,11 +1,22 @@
 from .prefill_router import ConditionalDisaggConfig, PrefillOrchestrator
-from .transfer import ChunkAssembler, KvBlockPayload, KvLayout, iter_chunks
+from .transfer import (
+    KvLayout,
+    PullSource,
+    RequestPlanePullSource,
+    decode_chunk_frame,
+    encode_chunk_frame,
+    make_header,
+    make_transfer_params,
+)
 
 __all__ = [
-    "ChunkAssembler",
     "ConditionalDisaggConfig",
-    "KvBlockPayload",
     "KvLayout",
     "PrefillOrchestrator",
-    "iter_chunks",
+    "PullSource",
+    "RequestPlanePullSource",
+    "decode_chunk_frame",
+    "encode_chunk_frame",
+    "make_header",
+    "make_transfer_params",
 ]

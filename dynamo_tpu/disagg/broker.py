@@ -1,0 +1,70 @@
+"""In-process engine broker: tier-1 device-to-device KV pulls.
+
+When the prefill and decode engines live in ONE JAX process (split
+sub-meshes of a slice, or two engines time-sharing a chip), the transfer
+needs no transport at all: the receiver `jax.device_put`s the sender's
+gathered chunk onto its own mesh sharding and XLA moves the bytes
+device-to-device (ICI on real hardware) — the host never touches the
+payload.  This is the TPU analogue of NIXL's NVLink path
+(docs/design-docs/disagg-serving.md:17-21) for co-located engines.
+
+The broker is a process-global registry: workers register their engine
+under their instance_id at startup; a decode worker's pull first checks
+the registry and only falls back to the network tiers on a miss.
+
+Multi-host caveat: followers replay inject steps with the payload riding
+the step stream as host bytes (parallel/multihost.py), so device-resident
+chunks would force a host gather anyway — workers therefore only take
+this tier when the slice is single-host (worker.py gates on world == 1).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_ENGINES: Dict[int, Any] = {}
+
+
+def register_engine(instance_id: int, engine) -> None:
+    _ENGINES[int(instance_id)] = engine
+
+
+def deregister_engine(instance_id: int) -> None:
+    _ENGINES.pop(int(instance_id), None)
+
+
+def lookup_engine(instance_id: int):
+    return _ENGINES.get(int(instance_id))
+
+
+class LocalEnginePullSource:
+    """Tier 1: chunks stay device-resident end to end.
+
+    chunk() returns the sender's gathered device arrays; the receiving
+    engine device_puts them onto its own sharding (the actual ICI move)
+    inside its inject op.  Each gather is one scheduler op on the SENDER,
+    so its decode keeps stepping during the extraction."""
+
+    def __init__(self, src_engine, request_id: str):
+        self.src = src_engine
+        self.request_id = request_id
+
+    async def open(self) -> Dict[str, Any]:
+        from .transfer import KvLayout, make_header
+
+        n_blocks, prompt_len = await self.src.parked_info(self.request_id)
+        lo = self.src.kv_wire_layout(n_blocks)
+        return make_header(prompt_len, lo)
+
+    async def chunk(self, b0: int, n: int) -> Tuple[Any, Any]:
+        return await self.src.extract_parked_chunk(
+            self.request_id, b0, n, to_host=False)
+
+    async def close(self) -> None:
+        try:
+            await self.src.release_parked(self.request_id)
+        except Exception:
+            pass

@@ -3,33 +3,53 @@
 Reference model (docs/design-docs/kvbm-design.md:171-230, disagg-serving.md:
 17-21): prefill and decode exchange *serialized layout metadata* plus the
 block payload; the decode side owns the pull.  On GPU the payload moves
-VRAM→VRAM over UCX/NVLink/IB.  Here the transfer rides the request plane as
-a host-staged stream (device→host→TCP→host→device) with an explicit layout
-header — correct on any topology.  On multi-slice TPU deployments the same
-protocol carries only metadata and the payload path is swapped for ICI/DCN
-device-to-device transfer (jax transfer server / collective_permute); the
-host-staged path remains the DCN fallback.
+VRAM->VRAM over UCX/NVLink/IB.  Here the pull is RECEIVER-PACED and tiered
+by deployment shape (the receiver picks the best available path):
 
-Wire protocol (one kv_pull stream):
-  1. header frame — prompt_len + KvLayout (logical geometry + the sender's
-     mesh shape).  The receiver validates *logical* compatibility
-     (layers/heads/head_dim/block_size/dtype must match) and ignores the
-     sender's parallelism: payloads are logical blocks
-     [layers, n_blocks, block_size, kv_heads, head_dim] gathered to host
-     from whatever tp-sharding the prefill engine used, and re-sharded on
-     inject by the decode engine's own GSPMD layout.  prefill TP ≠ decode
-     TP therefore needs no special case (the reference calls this out as a
-     headline feature) — and is covered by tests/test_disagg.py.
-  2. N chunk frames — (layer, block-range) slabs, each bounded by
-     max_chunk_bytes so a long prompt's KV never approaches the request
-     plane's frame cap, and the receiver can overlap deserialization with
-     the network.
+  tier 1 — same process (engines sharing one JAX runtime, e.g. split
+           sub-meshes of one slice): block chunks stay DEVICE-RESIDENT;
+           the receiver `jax.device_put`s the sender's gathered chunk onto
+           its own mesh sharding, so the bytes move over ICI without a
+           host round-trip (disagg/broker.py).
+  tier 2 — separate processes with the JAX transfer server available
+           (jax.experimental.transfer, DCN cross-slice transfer): the
+           request plane carries per-chunk METADATA (a uuid); the payload
+           moves device-to-device through the transfer server
+           (disagg/device_transfer.py).
+  tier 3 — host-staged fallback, correct on any topology: chunks gather
+           to host and ride the request plane as msgpack byte frames
+           (RequestPlanePullSource below).
+
+All tiers speak the same receiver-paced op protocol against the sender's
+`kv_pull` endpoint:
+
+  {"op": "open",  "request_id"}                  -> header frame
+      header = {prompt_len, layout: KvLayout}    (+ "transfer_addr" when
+      the sender runs a transfer server — tier-2 capability advertisement)
+  {"op": "chunk", "request_id", "start", "count"[, "via": "transfer"]}
+      -> one chunk frame: {"block_start", "block_count", "k", "v"} bytes
+      (tier 3) or {"uuid": int} (tier 2 — pull the payload from the
+      transfer server under that uuid)
+  {"op": "close", "request_id"}                  -> {} (release parked KV)
+
+Receiver pacing is what makes the pull STREAMING: each chunk is one
+scheduler op on each engine, so decode bursts interleave with both the
+sender's gathers and the receiver's injects, and neither side ever holds
+more than one chunk of payload in host memory (the round-3 review called
+out the whole-prompt triple materialization this replaces).
+
+The logical layout contract is unchanged: payloads are logical blocks
+[layers, n_blocks, block_size, kv_heads, head_dim] in the universal
+transfer layout, gathered from whatever tp-sharding the prefill engine
+used and re-sharded on inject by the decode engine's own GSPMD layout —
+prefill TP != decode TP needs no special case (the reference calls this
+out as a headline feature).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -41,7 +61,7 @@ except ImportError:  # pragma: no cover
 
 _DTYPES = {"float32": np.float32, "float16": np.float16}
 
-# Default slab bound.  Well under the request plane's 256MB frame cap even
+# Default chunk bound.  Well under the request plane's 256MB frame cap even
 # after msgpack framing, large enough to amortize per-frame overhead.
 DEFAULT_CHUNK_BYTES = 16 * 1024 * 1024
 
@@ -59,9 +79,9 @@ class KvLayout:
     """Logical geometry of a KV payload + the sender's parallel layout.
 
     The logical fields are contract: a mismatch is a model mismatch and the
-    pull must fail.  The mesh fields are advisory (telemetry / future
-    device-to-device path negotiation) — resharding is the receiver's
-    GSPMD's job, not the protocol's."""
+    pull must fail.  The mesh fields are advisory (telemetry / transfer
+    path negotiation) — resharding is the receiver's GSPMD's job, not the
+    protocol's."""
 
     num_layers: int
     num_blocks: int
@@ -95,12 +115,12 @@ class KvLayout:
             head_dim_v=d.get("head_dim_v", 0))
 
     @classmethod
-    def of(cls, k: np.ndarray, tp: int = 1, dp: int = 1,
-           v: Optional[np.ndarray] = None) -> "KvLayout":
+    def of(cls, k, tp: int = 1, dp: int = 1, v=None) -> "KvLayout":
+        """From a universal-layout K (and optionally V) array."""
         L, nb, bs, nkv, hd = k.shape
         hd_v = v.shape[4] if v is not None and v.shape[4] != hd else 0
         return cls(num_layers=L, num_blocks=nb, block_size=bs, kv_heads=nkv,
-                   head_dim=hd, dtype=k.dtype.name, tp=tp, dp=dp,
+                   head_dim=hd, dtype=np.dtype(k.dtype).name, tp=tp, dp=dp,
                    head_dim_v=hd_v)
 
     def check_compatible(self, other: "KvLayout") -> None:
@@ -119,104 +139,123 @@ class KvLayout:
                 f"sender but {other.hd_v} on the receiver"
             )
 
+    # -- chunk sizing -----------------------------------------------------
+    def block_bytes(self) -> int:
+        """Payload bytes of ONE block across all layers (k + v)."""
+        dt = _np_dtype(self.dtype)
+        per_tok = self.kv_heads * (self.head_dim + self.hd_v)
+        return self.num_layers * self.block_size * per_tok * dt.itemsize
 
-@dataclass
-class KvBlockPayload:
-    """A fully reassembled KV payload."""
-
-    k: np.ndarray  # [layers, n_blocks, block_size, kv_heads, head_dim]
-    v: np.ndarray
-
-    @property
-    def n_blocks(self) -> int:
-        return self.k.shape[1]
-
-
-def make_header(prompt_len: int, layout: KvLayout) -> Dict[str, Any]:
-    return {"prompt_len": prompt_len, "layout": layout.to_dict()}
+    def blocks_per_chunk(self, max_bytes: int = DEFAULT_CHUNK_BYTES) -> int:
+        """Whole blocks per chunk under the byte bound (always >= 1: the
+        bound is a target; the request plane's frame cap is the hard
+        limit)."""
+        return max(1, max_bytes // max(1, self.block_bytes()))
 
 
-def iter_chunks(
-    k: np.ndarray, v: np.ndarray, max_bytes: int = DEFAULT_CHUNK_BYTES
-) -> Iterator[Dict[str, Any]]:
-    """Split [L, nb, bs, nkv, hd] K/V into wire frames of bounded size.
-
-    Slabs never span layers (keeps indexing trivial); within a layer the
-    block axis is split so that k-bytes + v-bytes <= max_bytes (a single
-    block larger than max_bytes still goes out whole — the bound is a
-    target, the frame cap is the hard limit).  k and v may differ in their
-    last (head_dim) axis — the MLA latent/rope-key pair."""
-    assert k.shape[:4] == v.shape[:4] and k.dtype == v.dtype
-    L, nb = k.shape[0], k.shape[1]
-    pair_bytes = (int(k[0, :1].nbytes) + int(v[0, :1].nbytes)) if nb else 0
-    per = max(1, max_bytes // max(1, pair_bytes))
-    for layer in range(L):
-        for b0 in range(0, nb, per):
-            b1 = min(nb, b0 + per)
-            yield {
-                "layer": layer,
-                "block_start": b0,
-                "block_count": b1 - b0,
-                "k": np.ascontiguousarray(k[layer, b0:b1]).tobytes(),
-                "v": np.ascontiguousarray(v[layer, b0:b1]).tobytes(),
-            }
+def make_header(prompt_len: int, layout: KvLayout,
+                transfer_addr: Optional[str] = None) -> Dict[str, Any]:
+    h: Dict[str, Any] = {"prompt_len": prompt_len,
+                         "layout": layout.to_dict()}
+    if transfer_addr:
+        h["transfer_addr"] = transfer_addr
+    return h
 
 
-class ChunkAssembler:
-    """Receiver side: header + chunk frames → KvBlockPayload.
+def encode_chunk_frame(b0: int, kb: np.ndarray,
+                       vb: np.ndarray) -> Dict[str, Any]:
+    """Host-staged chunk -> wire frame.  kb/vb are universal-layout
+    [L, n, bs, nkv, hd] for the block range [b0, b0+n)."""
+    return {
+        "block_start": int(b0),
+        "block_count": int(kb.shape[1]),
+        "k": np.ascontiguousarray(kb).tobytes(),
+        "v": np.ascontiguousarray(vb).tobytes(),
+    }
 
-    Allocates the destination once from the header layout and writes each
-    slab in place — no per-chunk concatenation garbage."""
 
-    def __init__(self, header: Dict[str, Any],
-                 expect: Optional[KvLayout] = None,
-                 max_blocks: Optional[int] = None):
-        self.prompt_len = int(header["prompt_len"])
+def decode_chunk_frame(
+    frame: Dict[str, Any], layout: KvLayout
+) -> Tuple[int, int, np.ndarray, np.ndarray]:
+    """Wire frame -> (b0, n, kb, vb) with bounds checked against the
+    header layout (a corrupt frame must not write outside the payload)."""
+    b0 = int(frame["block_start"])
+    n = int(frame["block_count"])
+    if not (0 <= b0 and n >= 1 and b0 + n <= layout.num_blocks):
+        raise ValueError(f"chunk out of bounds: blocks=[{b0},{b0 + n}) of "
+                         f"{layout.num_blocks}")
+    dt = _np_dtype(layout.dtype)
+    lo = layout
+    kb = np.frombuffer(frame["k"], dtype=dt).reshape(
+        (lo.num_layers, n, lo.block_size, lo.kv_heads, lo.head_dim))
+    vb = np.frombuffer(frame["v"], dtype=dt).reshape(
+        (lo.num_layers, n, lo.block_size, lo.kv_heads, lo.hd_v))
+    return b0, n, kb, vb
+
+
+class PullSource:
+    """Receiver-side pull driver interface (the engine paces it).
+
+    open()  -> header dict ({"prompt_len", "layout", ...})
+    chunk(b0, n) -> (kb, vb) for blocks [b0, b0+n) — numpy arrays
+        (tier 3) or device arrays (tiers 1-2; the engine device_puts them
+        onto its own sharding before injecting)
+    close() -> release the sender's parked KV.  Idempotent; called on
+        success AND failure."""
+
+    async def open(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    async def chunk(self, b0: int, n: int) -> Tuple[Any, Any]:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        raise NotImplementedError
+
+
+class RequestPlanePullSource(PullSource):
+    """Tier 3: host-staged chunks over the request plane (the universal
+    fallback).  One RPC per op; the sender gathers each chunk as its own
+    scheduler op, so its decode interleaves with the extraction."""
+
+    def __init__(self, client, params: Dict[str, Any]):
+        self.client = client
+        self.params = params
+        self.layout: Optional[KvLayout] = None
+
+    async def _call(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        out = None
+        async for item in self.client.generate(
+            body, instance_id=self.params["instance_id"]
+        ):
+            out = item
+        if out is None:
+            raise RuntimeError("empty kv_pull response")
+        return out
+
+    async def open(self) -> Dict[str, Any]:
+        header = await self._call({"op": "open",
+                                   "request_id": self.params["request_id"]})
         self.layout = KvLayout.from_dict(header["layout"])
-        if expect is not None:
-            self.layout.check_compatible(expect)
-        if max_blocks is not None and self.layout.num_blocks > max_blocks:
-            # the allocation below is sized entirely by the sender's header;
-            # without this cap a corrupt header OOMs the receiver before a
-            # single payload byte arrives
-            raise ValueError(
-                f"KV transfer of {self.layout.num_blocks} blocks exceeds "
-                f"the receiver's limit of {max_blocks}"
-            )
-        lo = self.layout
-        dt = _np_dtype(lo.dtype)
-        self.k = np.zeros((lo.num_layers, lo.num_blocks, lo.block_size,
-                           lo.kv_heads, lo.head_dim), dt)
-        self.v = np.zeros((lo.num_layers, lo.num_blocks, lo.block_size,
-                           lo.kv_heads, lo.hd_v), dt)
-        self._filled = np.zeros((lo.num_layers, lo.num_blocks), bool)
+        return header
 
-    def add(self, frame: Dict[str, Any]) -> None:
-        lo = self.layout
-        layer = int(frame["layer"])
-        b0 = int(frame["block_start"])
-        n = int(frame["block_count"])
-        if not (0 <= layer < lo.num_layers and 0 <= b0 and
-                b0 + n <= lo.num_blocks):
-            raise ValueError(f"chunk out of bounds: layer={layer} "
-                             f"blocks=[{b0},{b0 + n})")
-        dt = _np_dtype(lo.dtype)
-        self.k[layer, b0:b0 + n] = np.frombuffer(
-            frame["k"], dtype=dt).reshape(
-                (n, lo.block_size, lo.kv_heads, lo.head_dim))
-        self.v[layer, b0:b0 + n] = np.frombuffer(
-            frame["v"], dtype=dt).reshape(
-                (n, lo.block_size, lo.kv_heads, lo.hd_v))
-        self._filled[layer, b0:b0 + n] = True
+    async def chunk(self, b0: int, n: int):
+        frame = await self._call({
+            "op": "chunk", "request_id": self.params["request_id"],
+            "start": int(b0), "count": int(n),
+        })
+        fb0, fn, kb, vb = decode_chunk_frame(frame, self.layout)
+        if fb0 != b0 or fn != n:
+            raise ValueError(f"sender returned blocks [{fb0},{fb0 + fn}) "
+                             f"for a request of [{b0},{b0 + n})")
+        return kb, vb
 
-    def finish(self) -> KvBlockPayload:
-        if not self._filled.all():
-            missing = int((~self._filled).sum())
-            raise ValueError(
-                f"incomplete KV transfer: {missing} (layer, block) slabs "
-                "never arrived"
-            )
-        return KvBlockPayload(k=self.k, v=self.v)
+    async def close(self) -> None:
+        try:
+            await self._call({"op": "close",
+                              "request_id": self.params["request_id"]})
+        except Exception:
+            pass  # sender-side TTL reaps unreleased parks
 
 
 def make_transfer_params(

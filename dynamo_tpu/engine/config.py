@@ -80,7 +80,7 @@ class EngineConfig:
     offload_batch: int = 16                # max blocks gathered per step
 
     # disagg KV transfer: bound on one wire frame's K+V payload bytes
-    # (disagg/transfer.py iter_chunks)
+    # (disagg/transfer.py chunk sizing)
     transfer_chunk_bytes: int = DEFAULT_CHUNK_BYTES
 
     # LoRA serving (lora/): 0 disables.  max_adapters counts usable slots

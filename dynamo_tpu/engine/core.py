@@ -99,9 +99,11 @@ class _Slot:
         return self.prefill_pos < self.prompt_len
     # disaggregation
     disagg_prefill: bool = False       # prefill-only; park KV for pulling
-    preloaded_k: Optional[np.ndarray] = None  # [L, nblk, bs, nkv, hd]
-    preloaded_v: Optional[np.ndarray] = None
-    preloaded_first_token: Optional[int] = None
+    # decode side of a disagg pull: the slot sits admitted-but-idle while
+    # the pull task streams chunk injects into its blocks (prefill and
+    # decode skip it until the pull finalizes or falls back)
+    pulling: bool = False
+    admitted: Optional[asyncio.Event] = None  # set (loop thread) on admit
     # decode pipelining (decode_pipeline_depth): tokens the device has
     # already decoded for this slot but the host has not yet read back
     inflight: int = 0
@@ -538,19 +540,12 @@ class JaxEngine:
         # not inflate the SLA planner's arrival rate / mean ISL
         self.metrics["requests"] += 1
         self.metrics["prompt_tokens"] += len(request.token_ids)
-        preloaded = None
         dp = request.disaggregated_params
-        if dp is not None and dp.get("engine") == "jax":
-            if self.kv_pull_fn is None:
-                logger.warning("disaggregated_params but no kv_pull_fn; "
-                               "falling back to local prefill")
-            else:
-                try:
-                    preloaded = await self.kv_pull_fn(dp)
-                except Exception:
-                    logger.warning("KV pull failed for %s; local prefill "
-                                   "fallback", request.request_id,
-                                   exc_info=True)
+        want_pull = dp is not None and dp.get("engine") == "jax"
+        if want_pull and self.kv_pull_fn is None:
+            logger.warning("disaggregated_params but no kv_pull_fn; "
+                           "falling back to local prefill")
+            want_pull = False
         if self.kvbm is not None and self.remote_kvbm_fetch is not None:
             try:
                 await self._remote_prefetch(request)
@@ -603,15 +598,20 @@ class JaxEngine:
         from ..protocols.llm import DISAGG_ANNOTATION
 
         slot.disagg_prefill = DISAGG_ANNOTATION in (request.annotations or [])
-        if preloaded is not None:
-            slot.preloaded_k, slot.preloaded_v, _plen = preloaded
-            slot.preloaded_first_token = dp.get("first_token")
+        pull_task = None
+        if want_pull:
+            slot.pulling = True
+            slot.admitted = asyncio.Event()
         with self._qlock:
             self.waiting.append(slot)
         if lora_idx:
             # enqueued: the waiting/_slots scan now holds the reference
             self._lora_pins[lora_idx] -= 1
         self._wake.set()
+        if want_pull:
+            # streaming pull: chunk injects interleave with decode steps;
+            # on any failure the slot falls back to local prefill
+            pull_task = asyncio.create_task(self._stream_pull(slot, dp))
         from ..runtime.aio import CANCELLED, next_or_cancel
 
         try:
@@ -629,6 +629,8 @@ class JaxEngine:
                 if item.finish_reason is not None:
                     return
         finally:
+            if pull_task is not None and not pull_task.done():
+                pull_task.cancel()
             if not slot.finished:
                 # actual teardown happens on the scheduler thread
                 slot.cancel_requested = True
@@ -856,24 +858,77 @@ class JaxEngine:
         return len(removed)
 
     # -- disaggregation: parked prefills + KV extraction -------------------
-    async def extract_parked_kv(self, request_id: str):
-        """Gather a parked prefill's KV blocks to host (decode side pulls).
+    def kv_wire_layout(self, n_blocks: int = 0):
+        """This engine's KvLayout for wire headers/validation, derived from
+        its OWN cache arrays (family-agnostic: GQA k==v shapes, MLA
+        latent/rope-key pair with different head dims)."""
+        from ..disagg.transfer import KvLayout
 
-        Returns (k, v, prompt_len): numpy [L, n_blocks, bs, nkv, hd]."""
+        k_cache, v_cache = self.kv
+        return KvLayout(
+            num_layers=k_cache.shape[0], num_blocks=n_blocks,
+            block_size=self.config.block_size,
+            kv_heads=k_cache.shape[1], head_dim=k_cache.shape[3],
+            dtype=np.dtype(self.model_cfg.dtype).name,
+            tp=self.config.tp, dp=self.config.dp,
+            head_dim_v=(v_cache.shape[3]
+                        if v_cache.shape[3] != k_cache.shape[3] else 0),
+        )
+
+    def universal_shardings(self):
+        """(k, v) NamedShardings for universal-layout [L, nb, bs, nkv, hd]
+        chunks on this engine's mesh: the cache's head-axis sharding moved
+        to the universal head axis.  Device-resident pulls land chunks
+        here so inject consumes them without a host bounce."""
+        k_spec, v_spec = self.family.kv_cache_specs()
+        # cache layout [L, H, NB, HD, BS] -> universal [L, NB, BS, H, HD];
+        # MLA families use an empty spec (replicated latent cache)
+        kh = k_spec[1] if len(k_spec) > 1 else None
+        vh = v_spec[1] if len(v_spec) > 1 else None
+        uk = P(None, None, None, kh, None)
+        uv = P(None, None, None, vh, None)
+        return (NamedSharding(self.mesh, uk), NamedSharding(self.mesh, uv))
+
+    async def parked_info(self, request_id: str):
+        """(n_blocks, prompt_len) of a parked prefill (pull 'open' op)."""
+
+        def info():
+            parked = self._parked.get(request_id)
+            if parked is None:
+                raise KeyError(f"no parked KV for request {request_id!r}")
+            return len(parked.block_ids), parked.prompt_len
+
+        return await self._call_on_scheduler(info)
+
+    async def extract_parked_chunk(self, request_id: str, start: int,
+                                   count: int, *, to_host: bool = True):
+        """Gather blocks [start, start+count) of a parked prefill in the
+        universal transfer layout — ONE scheduler op per chunk, so decode
+        bursts interleave with a long extraction instead of stalling
+        behind a whole-prompt gather (the round-3 ITL-spike finding).
+
+        to_host=False keeps the gathered chunk device-resident for the
+        device-to-device tiers (broker / transfer server)."""
 
         def gather():
             parked = self._parked.get(request_id)
             if parked is None:
                 raise KeyError(f"no parked KV for request {request_id!r}")
-            n = len(parked.block_ids)
-            ids = _pow2_ids(parked.block_ids)
+            chunk_ids = parked.block_ids[start:start + count]
+            if len(chunk_ids) != count:
+                raise ValueError(
+                    f"chunk [{start},{start + count}) out of range for "
+                    f"{len(parked.block_ids)} parked blocks")
+            ids = _pow2_ids(chunk_ids)
             if self.step_sink is not None:
                 # reads are collective programs too: every process of the
                 # slice must execute the same gather or it hangs
                 self.step_sink("gather", {"ids": ids})
             kb, vb = self._jit_gather(self.kv, jnp.asarray(ids))
-            return (np.asarray(kb[:, :n]), np.asarray(vb[:, :n]),
-                    parked.prompt_len)
+            kb, vb = kb[:, :count], vb[:, :count]
+            if to_host:
+                return np.asarray(kb), np.asarray(vb)
+            return kb, vb
 
         return await self._call_on_scheduler(gather)
 
@@ -902,7 +957,11 @@ class JaxEngine:
                     # scheduler step is in flight while we await this
                     await asyncio.to_thread(self._drain_sched_calls)
                 self._reap_parked()
-                busy = (any(s is not None for s in self._slots)
+                # a slot mid-pull has no step work of its own (its chunk
+                # injects arrive as sched_calls, which set _wake): don't
+                # hot-spin the step loop on its behalf
+                busy = (any(s is not None and not s.pulling
+                            for s in self._slots)
                         or bool(self._inflight))
                 if not busy and not self.waiting:
                     self._wake.clear()
@@ -1143,9 +1202,12 @@ class JaxEngine:
             slot.prompt_len = prompt_len
             slot.prefill_pos = cached_tokens
 
-            # disagg decode: scatter the pulled KV instead of prefilling
-            if slot.preloaded_k is not None and self._try_inject(slot):
-                continue
+            # disagg decode: wake the pull task now that blocks exist; the
+            # slot idles (prefill/decode skip it) while chunk injects
+            # stream in between steps
+            if slot.pulling and slot.admitted is not None \
+                    and self._loop_ref is not None:
+                self._loop_ref.call_soon_threadsafe(slot.admitted.set)
 
     def _prefill_step(self) -> None:
         """Run prefill chunks for up to max_prefill_seqs prefilling slots
@@ -1156,7 +1218,8 @@ class JaxEngine:
         the budget together instead of serializing (TTFT under queue
         depth)."""
         pslots = sorted(
-            (s for s in self._slots if s is not None and s.prefilling),
+            (s for s in self._slots
+             if s is not None and s.prefilling and not s.pulling),
             key=lambda s: s.enqueued_t,
         )[: self.config.max_prefill_seqs]
         if not pslots:
@@ -1285,41 +1348,128 @@ class JaxEngine:
             return
         self._push_token(slot, first)
 
-    def _try_inject(self, slot: _Slot) -> bool:
-        """Scatter pulled KV blocks; returns False to fall back to local
-        prefill on layout mismatch."""
-        seq_id = self._seq_id(slot)
-        block_ids = self.allocator.seq_block_ids(seq_id)
-        kb, vb = slot.preloaded_k, slot.preloaded_v
-        if kb.shape[0] != self.model_cfg.n_layers or \
-                kb.shape[1] != len(block_ids) or \
-                kb.shape[2] != self.config.block_size:
-            logger.warning("pulled KV layout %s mismatches engine "
-                           "(layers=%d blocks=%d bs=%d); local prefill",
-                           kb.shape, self.model_cfg.n_layers, len(block_ids),
-                           self.config.block_size)
-            return False
-        n = len(block_ids)
+    async def _stream_pull(self, slot: _Slot, dp: Dict[str, Any]) -> None:
+        """Decode-side streaming pull: inject the prefill's KV chunk by
+        chunk, each chunk one scheduler op, so decode bursts for OTHER
+        slots run in between (no whole-prompt stall, host memory bounded
+        by one chunk).  Any failure falls back to local prefill — the
+        slot's blocks are already allocated and prefill_pos still points
+        at the cached prefix."""
+        src = None
+        t0 = time.monotonic()
+        try:
+            await slot.admitted.wait()
+            if slot.finished or slot.cancel_requested:
+                return
+            src = await self.kv_pull_fn(dp)
+            header = await src.open()
+            from ..disagg.transfer import KvLayout
+
+            layout = KvLayout.from_dict(header["layout"])
+            layout.check_compatible(self.kv_wire_layout())
+            prompt_len = slot.prompt_len
+            if int(header["prompt_len"]) != prompt_len:
+                raise ValueError(
+                    f"prefill parked {header['prompt_len']} tokens but the "
+                    f"decode request has {prompt_len}")
+            bs = self.config.block_size
+            n_blocks = (prompt_len + bs - 1) // bs
+            if layout.num_blocks != n_blocks:
+                raise ValueError(
+                    f"prefill parked {layout.num_blocks} blocks; decode "
+                    f"needs {n_blocks}")
+            # skip blocks the local prefix cache / KVBM already
+            # materialized at admission — pull only the missing tail
+            start = slot.cached_tokens // bs
+            per = layout.blocks_per_chunk(self.config.transfer_chunk_bytes)
+            pulled = 0
+            for b0 in range(start, n_blocks, per):
+                if slot.finished or slot.cancel_requested:
+                    return
+                n = min(per, n_blocks - b0)
+                kb, vb = await src.chunk(b0, n)
+                await self._call_on_scheduler(
+                    partial(self._inject_pulled_chunk, slot, b0, n, kb, vb))
+                if isinstance(kb, np.ndarray):
+                    nbytes = kb.nbytes + vb.nbytes
+                    self.metrics["pull_host_chunk_bytes_max"] = max(
+                        self.metrics.get("pull_host_chunk_bytes_max", 0),
+                        nbytes)
+                pulled += n
+            self.metrics["pull_blocks"] = (
+                self.metrics.get("pull_blocks", 0) + pulled)
+            self.metrics["pull_seconds"] = (
+                self.metrics.get("pull_seconds", 0.0)
+                + (time.monotonic() - t0))
+            await self._call_on_scheduler(
+                partial(self._finish_pull, slot, dp.get("first_token")))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.warning("KV pull failed for %s; local prefill fallback",
+                           slot.request.request_id, exc_info=True)
+
+            def fallback():
+                slot.pulling = False  # prefill path picks the slot up
+
+            try:
+                await self._call_on_scheduler(fallback)
+            except Exception:
+                pass
+            self._wake.set()
+        finally:
+            if src is not None:
+                try:
+                    await src.close()
+                except Exception:
+                    pass
+
+    def _inject_pulled_chunk(self, slot: _Slot, b0: int, n: int,
+                             kb, vb) -> None:
+        """Scheduler op: scatter one pulled chunk into the slot's blocks.
+
+        kb/vb are numpy (host-staged tier) or device arrays (broker /
+        transfer-server tiers).  Device chunks are re-laid onto this
+        engine's own universal sharding first — with a different source
+        mesh that device_put IS the ICI device-to-device move."""
+        if slot.finished or slot.cancel_requested:
+            return  # blocks may already be freed; drop the chunk
+        block_ids = self.allocator.seq_block_ids(
+            self._seq_id(slot))[b0:b0 + n]
+        if len(block_ids) != n:
+            raise ValueError(f"slot lost blocks [{b0},{b0 + n}) mid-pull")
         ids = _pow2_ids(block_ids)
         bucket = len(ids)
-        pad = ((0, 0), (0, bucket - n)) + ((0, 0),) * (kb.ndim - 2)
-        kb_p = np.pad(kb, pad)
-        vb_p = np.pad(vb, pad)
+        if isinstance(kb, np.ndarray):
+            pad = ((0, 0), (0, bucket - n)) + ((0, 0),) * (kb.ndim - 2)
+            kb_p, vb_p = np.pad(kb, pad), np.pad(vb, pad)
+        else:
+            sk, sv = self.universal_shardings()
+            kb, vb = jax.device_put(kb, sk), jax.device_put(vb, sv)
+            pad = ((0, 0), (0, bucket - n)) + ((0, 0),) * (kb.ndim - 2)
+            kb_p, vb_p = jnp.pad(kb, pad), jnp.pad(vb, pad)
         if self.step_sink is not None:
             # the pulled KV rides the step stream to the slice's followers
-            # (host-staged transfer delivers full block bytes anyway; each
-            # process scatters its own shard under GSPMD)
-            self.step_sink("inject", {"kb": kb_p, "vb": vb_p, "ids": ids})
+            # (device-resident tiers are gated off for multi-host slices,
+            # so kb_p/vb_p are host bytes here)
+            self.step_sink("inject", {"kb": np.asarray(kb_p),
+                                      "vb": np.asarray(vb_p), "ids": ids})
         self.kv = self._jit_inject(
             self.kv, jnp.asarray(kb_p), jnp.asarray(vb_p), jnp.asarray(ids)
         )
-        prompt_len = len(slot.seq)
+
+    def _finish_pull(self, slot: _Slot, first: Optional[int]) -> None:
+        """Scheduler op: all chunks landed — commit the blocks and emit the
+        first token (recomputing it if the transfer metadata lacked it)."""
+        if slot.finished or slot.cancel_requested:
+            return
+        prompt_len = slot.prompt_len
         slot.ctx_len = prompt_len
         slot.prefill_pos = prompt_len
         slot.cached_tokens = prompt_len  # skipped compute entirely
+        slot.pulling = False
         self._commit_full_blocks(slot)
         slot.first_token_t = time.monotonic()
-        first = slot.preloaded_first_token
         if first is None:
             # transfer metadata lacked the first token: recompute from the
             # last prompt position (cache already holds prompt[:-1])
@@ -1350,10 +1500,8 @@ class JaxEngine:
                 else None,
             )
             first = int(tok)
-        slot.preloaded_k = slot.preloaded_v = None
         self.metrics["cache_hit_tokens"] += prompt_len
         self._push_token(slot, int(first))
-        return True
 
     def _park_prefilled(self, slot: _Slot, first_token: int) -> None:
         """Disagg prefill done: keep the KV, hand back transfer metadata."""

@@ -14,6 +14,7 @@ import logging
 import os
 from typing import Optional
 
+import jax
 import numpy as np
 
 from ..protocols import LLMEngineOutput, ModelDeploymentCard, PreprocessedRequest
@@ -208,6 +209,10 @@ class JaxEngineWorker:
             "component": self.component,
         }
         self._pull_clients = {}
+        from ..disagg.device_transfer import SenderChunkRegistry
+
+        self._chunk_refs = SenderChunkRegistry()
+        self._broker_id: Optional[int] = None
 
         async def generate_handler(payload, ctx):
             request = PreprocessedRequest.from_dict(payload)
@@ -242,20 +247,54 @@ class JaxEngineWorker:
                 yield {"h": None}
 
         async def kv_pull_handler(payload, ctx):
-            """Stream a parked prefill's KV: a layout header, then
-            byte-bounded (layer, block-range) slabs, then release the
-            blocks (disagg/transfer.py wire protocol)."""
-            from ..disagg.transfer import KvLayout, iter_chunks, make_header
+            """Receiver-paced pull ops (disagg/transfer.py wire protocol):
+            open -> header, chunk -> one gathered slab (host bytes, or a
+            transfer-server uuid when the receiver asks via=transfer),
+            close -> release.  Each chunk is ONE scheduler op on this
+            engine, so prefill/decode for other requests interleave with
+            the extraction instead of stalling behind a whole-prompt
+            gather."""
+            from ..disagg.transfer import encode_chunk_frame, make_header
 
+            op = payload.get("op")
             rid = payload["request_id"]
-            k, v, prompt_len = await self.engine.extract_parked_kv(rid)
-            layout = KvLayout.of(k, tp=self.config.tp, dp=self.config.dp,
-                                 v=v)
-            yield make_header(prompt_len, layout)
-            for frame in iter_chunks(k, v,
-                                     self.config.transfer_chunk_bytes):
-                yield frame
-            await self.engine.release_parked(rid)
+            if op == "open":
+                n_blocks, prompt_len = await self.engine.parked_info(rid)
+                layout = self.engine.kv_wire_layout(n_blocks)
+                yield make_header(prompt_len, layout,
+                                  transfer_addr=self._transfer_addr())
+            elif op == "chunk":
+                b0 = int(payload["start"])
+                n = int(payload["count"])
+                if payload.get("via") == "transfer" \
+                        and self._transfer_addr() is not None:
+                    from ..disagg import device_transfer
+
+                    kb, vb = await self.engine.extract_parked_chunk(
+                        rid, b0, n, to_host=False)
+                    # canonical single-shard wire form (the server needs
+                    # identical shard structure on both ends); the
+                    # tp-gather onto one device rides ICI
+                    dev = self.engine.mesh.devices.flat[0]
+                    kb = jax.device_put(kb, dev)
+                    vb = jax.device_put(vb, dev)
+                    uid = device_transfer.next_uuid()
+                    device_transfer.get_transfer_server().await_pull(
+                        uid, [kb, vb])
+                    # ref held until the next chunk/close (receiver pacing
+                    # proves consumption) so the arrays outlive the pull
+                    self._chunk_refs.park(rid, uid, (kb, vb))
+                    yield {"uuid": uid}
+                else:
+                    kb, vb = await self.engine.extract_parked_chunk(
+                        rid, b0, n)
+                    yield encode_chunk_frame(b0, kb, vb)
+            elif op == "close":
+                self._chunk_refs.release(rid)
+                await self.engine.release_parked(rid)
+                yield {}
+            else:
+                raise ValueError(f"unknown kv_pull op {op!r}")
 
         comp = rt.namespace(self.namespace).component(self.component)
         from ..protocols.llm import CANARY_GENERATE_PAYLOAD
@@ -299,6 +338,15 @@ class JaxEngineWorker:
             self._aux_served.append(
                 await comp.endpoint("embed").serve_endpoint(
                     embed_handler, instance_id=instance_id))
+        # tier-1 d2d: co-resident engines pull device-to-device through
+        # the process broker (single-host slices only — followers need the
+        # payload on the step stream as host bytes).  Registered only once
+        # every endpoint is up, so a failed start never leaks a
+        # half-initialized engine into the process-global registry.
+        from ..disagg import broker
+
+        broker.register_engine(instance_id, self.engine)
+        self._broker_id = instance_id
         await register_model(rt, self.card, instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
         logger.info("jax engine worker %d serving %s (tp=%d)",
@@ -375,16 +423,41 @@ class JaxEngineWorker:
                     self.component, self.slice_id)
         return self
 
+    def _transfer_addr(self) -> Optional[str]:
+        """Advertise the tier-2 transfer server: single-host slices only
+        (a multi-host slice's gathered chunk is distributed across
+        processes; one process cannot serve it) and only when the backend
+        supports it."""
+        if self.mh.world > 1:
+            return None
+        from ..disagg.device_transfer import get_transfer_server
+
+        srv = get_transfer_server()
+        return srv.address() if srv is not None else None
+
     async def _kv_pull(self, params: dict):
-        """Decode-side pull: fetch a parked prefill's KV from its worker.
+        """Decode-side pull source, best tier first (disagg/transfer.py):
 
-        The transport is the request plane (host-staged); on multi-slice
-        topologies this is where the ICI/DCN device-to-device path plugs in
-        (disagg/transfer.py docstring).  The sender's header layout is
-        validated against this worker's own model geometry — its tp/dp may
-        differ freely (inject reshards via GSPMD)."""
-        from ..disagg.transfer import ChunkAssembler, KvLayout
+        1. same process  -> broker source: chunks stay device-resident
+           (device_put across meshes = the ICI move)
+        2. cross process -> negotiated request-plane source: payload via
+           the jax transfer server when both ends have one (DCN
+           device-to-device), else host-staged byte frames
+        3. host-staged frames — the always-correct fallback.
 
+        Multi-host slices always take host-staged frames: followers
+        replay inject steps with the payload riding the step stream.
+        The sender's header layout is validated by the engine against its
+        own geometry — tp/dp may differ freely (inject reshards via
+        GSPMD)."""
+        single_host = self.mh.world == 1
+        if single_host:
+            from ..disagg import broker
+
+            src_engine = broker.lookup_engine(params["instance_id"])
+            if src_engine is not None and src_engine is not self.engine:
+                return broker.LocalEnginePullSource(
+                    src_engine, params["request_id"])
         ns = params.get("namespace", self.namespace)
         comp = params.get("component", self.component)
         key = (ns, comp)
@@ -395,35 +468,13 @@ class JaxEngineWorker:
             client = await ep.client().start()
             await client.wait_for_instances()
             self._pull_clients[key] = client
-        m = self.config.resolve_model()
-        # geometry from this engine's OWN cache arrays ([L, nkv, nb, hd,
-        # bs] head-major layout) — family-agnostic: GQA k==v shapes, MLA
-        # latent/rope-key pair with different head dims
-        k_cache, v_cache = self.engine.kv
-        expect = KvLayout(
-            num_layers=m.n_layers, num_blocks=0,
-            block_size=self.config.block_size,
-            kv_heads=k_cache.shape[1],
-            head_dim=k_cache.shape[3], dtype=np.dtype(m.dtype).name,
-            head_dim_v=(v_cache.shape[3]
-                        if v_cache.shape[3] != k_cache.shape[3] else 0),
+        from ..disagg.device_transfer import NegotiatedPullSource
+
+        return NegotiatedPullSource(
+            client, params,
+            device=self.engine.mesh.devices.flat[0],
+            allow_transfer=single_host,
         )
-        asm = None
-        async for item in client.generate(
-            {"request_id": params["request_id"]},
-            instance_id=params["instance_id"],
-        ):
-            if asm is None:
-                asm = ChunkAssembler(
-                    item, expect=expect,
-                    max_blocks=self.config.max_blocks_per_seq,
-                )
-                continue
-            asm.add(item)
-        if asm is None:
-            raise RuntimeError("empty KV pull stream")
-        payload = asm.finish()
-        return payload.k, payload.v, asm.prompt_len
 
     async def _load_loop(self) -> None:
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"
@@ -434,6 +485,9 @@ class JaxEngineWorker:
             await asyncio.sleep(0.5)
             if self.engine is None or self.served is None:
                 continue
+            # tier-2 sender refs whose receiver died mid-pull (mirrors the
+            # engine's parked-KV TTL)
+            self._chunk_refs.sweep(self.engine.parked_ttl_s)
             await self.runtime.event_plane.publish(subject, {
                 "worker_id": self.served.instance_id,
                 "active_seqs": self.engine.num_active_seqs,
@@ -453,6 +507,10 @@ class JaxEngineWorker:
             m.set("dynamo_engine_itl_ema_seconds", self.engine.itl_ema_s)
 
     async def close(self) -> None:
+        if getattr(self, "_broker_id", None) is not None:
+            from ..disagg import broker
+
+            broker.deregister_engine(self._broker_id)
         if getattr(self, "_kvbm_index", None) is not None:
             await self._kvbm_index.close()
         if getattr(self, "_kvbm_pull_client", None) is not None:

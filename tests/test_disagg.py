@@ -109,54 +109,56 @@ async def test_mocker_disagg_e2e():
     await rt.shutdown()
 
 
-def test_chunked_transfer_protocol_roundtrip():
-    """Header + bounded slabs reassemble to the exact payload; incomplete
-    streams and incompatible layouts fail loudly."""
+def test_chunk_frame_protocol():
+    """Chunk frames round-trip exactly; corrupt frames and incompatible
+    layouts fail loudly; chunk sizing respects the byte bound."""
     import numpy as np
     import pytest
 
     from dynamo_tpu.disagg.transfer import (
-        ChunkAssembler, KvLayout, iter_chunks, make_header,
+        KvLayout, decode_chunk_frame, encode_chunk_frame, make_header,
     )
 
     rng = np.random.default_rng(3)
     k = rng.normal(size=(2, 6, 4, 2, 8)).astype(np.float32)
     v = rng.normal(size=(2, 6, 4, 2, 8)).astype(np.float32)
-    block_bytes = k[0, :1].nbytes
-    frames = list(iter_chunks(k, v, max_bytes=2 * 2 * block_bytes))
-    # 6 blocks / 2-per-slab * 2 layers = 6 frames, each within the bound
-    assert len(frames) == 6
-    assert all(len(f["k"]) + len(f["v"]) <= 4 * block_bytes for f in frames)
-
     layout = KvLayout.of(k, tp=1)
-    asm = ChunkAssembler(make_header(24, layout))
-    for f in frames:
-        asm.add(f)
-    out = asm.finish()
-    np.testing.assert_array_equal(out.k, k)
-    np.testing.assert_array_equal(out.v, v)
-    assert asm.prompt_len == 24
 
-    # a dropped slab is an error, not silent zeros
-    asm2 = ChunkAssembler(make_header(24, layout))
-    for f in frames[:-1]:
-        asm2.add(f)
-    with pytest.raises(ValueError, match="incomplete"):
-        asm2.finish()
+    # whole-payload roundtrip through bounded chunks
+    per = layout.blocks_per_chunk(2 * layout.block_bytes())
+    assert per == 2
+    out_k = np.zeros_like(k)
+    out_v = np.zeros_like(v)
+    for b0 in range(0, 6, per):
+        n = min(per, 6 - b0)
+        frame = encode_chunk_frame(b0, k[:, b0:b0 + n], v[:, b0:b0 + n])
+        fb0, fn, kb, vb = decode_chunk_frame(frame, layout)
+        assert (fb0, fn) == (b0, n)
+        out_k[:, fb0:fb0 + fn] = kb
+        out_v[:, fb0:fb0 + fn] = vb
+    np.testing.assert_array_equal(out_k, k)
+    np.testing.assert_array_equal(out_v, v)
 
-    # logical-geometry mismatch rejected at the header; tp may differ
+    # a single block never chunks to zero even under a tiny bound
+    assert layout.blocks_per_chunk(1) == 1
+
+    # out-of-bounds frames rejected (a corrupt sender must not scatter
+    # outside the expected payload)
+    bad = encode_chunk_frame(5, k[:, 5:6], v[:, 5:6])
+    bad["block_count"] = 4
+    with pytest.raises(ValueError, match="out of bounds"):
+        decode_chunk_frame(bad, layout)
+
+    # logical-geometry mismatch rejected; tp may differ freely
     other = KvLayout.of(k, tp=4)
     other.kv_heads = 8
     with pytest.raises(ValueError, match="kv_heads"):
-        ChunkAssembler(make_header(24, layout), expect=other)
-    ok = KvLayout.of(k, tp=4)  # same geometry, different parallelism
-    ChunkAssembler(make_header(24, layout), expect=ok)
+        layout.check_compatible(other)
+    layout.check_compatible(KvLayout.of(k, tp=4))
 
-    # a corrupt header must not size the receiver's allocation unbounded
-    huge = KvLayout.of(k)
-    huge.num_blocks = 2**30
-    with pytest.raises(ValueError, match="exceeds"):
-        ChunkAssembler(make_header(24, huge), max_blocks=64)
+    # header carries the tier-2 capability advertisement
+    assert "transfer_addr" not in make_header(8, layout)
+    assert make_header(8, layout, "host:1")["transfer_addr"] == "host:1"
 
 
 async def test_disagg_resharding_prefill_tp1_decode_tp2():
@@ -287,3 +289,168 @@ async def _engine_disagg_roundtrip(model_config):
     await prefill_worker.close()
     await decode_worker.close()
     await rt.shutdown()
+
+
+# ------------------- transfer tiers + streaming behavior -------------------
+
+
+async def _forced_tier_roundtrip(patch):
+    """Run the engine-to-engine roundtrip with the broker (tier 1)
+    disabled so the pull takes the patched-in network tier."""
+    from dynamo_tpu.disagg import broker, device_transfer
+
+    orig_lookup = broker.lookup_engine
+    broker.lookup_engine = lambda _id: None
+    try:
+        with patch:
+            await _engine_disagg_roundtrip(FP32)
+    finally:
+        broker.lookup_engine = orig_lookup
+
+
+class _NoTransferServer:
+    """Context: force get_transfer_server() to 'unavailable'."""
+
+    def __enter__(self):
+        from dynamo_tpu.disagg import device_transfer
+
+        self._orig = device_transfer.get_transfer_server
+        device_transfer.get_transfer_server = lambda: None
+
+    def __exit__(self, *exc):
+        from dynamo_tpu.disagg import device_transfer
+
+        device_transfer.get_transfer_server = self._orig
+
+
+class _Nop:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+async def test_disagg_roundtrip_host_staged_tier():
+    """Tier 3 forced: no broker, no transfer server — byte frames over
+    the request plane must still reproduce the aggregated continuation."""
+    await _forced_tier_roundtrip(_NoTransferServer())
+
+
+async def test_disagg_roundtrip_transfer_server_tier():
+    """Tier 2: payload through the jax transfer server (device-to-device
+    across processes; loopback here).  Skips where the backend lacks
+    transfer-server support."""
+    import os
+
+    import pytest
+
+    from dynamo_tpu.disagg import device_transfer
+
+    os.environ["DYN_KV_TRANSFER_SERVER"] = "1"  # opt-in (see get_transfer_server)
+    try:
+        if device_transfer.get_transfer_server() is None:
+            pytest.skip("jax transfer server unavailable on this backend")
+        await _forced_tier_roundtrip(_Nop())
+    finally:
+        os.environ.pop("DYN_KV_TRANSFER_SERVER", None)
+
+
+async def test_streaming_pull_overlaps_decode_and_bounds_host_memory():
+    """The round-3 review findings: a pull must not stall decode for the
+    whole prompt, and must never stage the whole payload in host RAM.
+    A deliberately slow multi-chunk pull streams into engine B while B
+    decodes another request; B keeps emitting tokens DURING the pull,
+    and the recorded peak host chunk stays one chunk, not the payload."""
+    import time as _time
+
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.llm import DISAGG_ANNOTATION
+
+    ecfg = dict(model_config=FP32, block_size=4, num_blocks=64,
+                max_blocks_per_seq=16, max_num_seqs=2,
+                prefill_buckets=(8, 16, 32), seed=7)
+    src = JaxEngine(EngineConfig(role="prefill", **ecfg))
+    dst = JaxEngine(EngineConfig(**ecfg))
+    agg = JaxEngine(EngineConfig(**ecfg))
+
+    prompt = list(range(30, 52))  # 22 tokens -> 6 blocks
+    expect = []
+    async for out in agg.generate(greedy_req(prompt, 4, "agg")):
+        expect.extend(out.token_ids)
+
+    # park a prefill on src
+    pref = greedy_req(prompt, 4, "d1")
+    pref.annotations = [DISAGG_ANNOTATION]
+    park_out = None
+    async for out in src.generate(pref):
+        park_out = out
+    params = park_out.kv_transfer_params
+    assert params is not None and params["first_token"] == expect[0]
+
+    # slow host-staged source: one block per chunk, 30ms apart
+    class SlowHostSource:
+        def __init__(self, engine, rid):
+            self.engine, self.rid = engine, rid
+
+        async def open(self):
+            from dynamo_tpu.disagg.transfer import make_header
+
+            n_blocks, plen = await self.engine.parked_info(self.rid)
+            return make_header(plen, self.engine.kv_wire_layout(n_blocks))
+
+        async def chunk(self, b0, n):
+            await asyncio.sleep(0.03)
+            return await self.engine.extract_parked_chunk(self.rid, b0, n)
+
+        async def close(self):
+            await self.engine.release_parked(self.rid)
+
+    async def pull_fn(dp):
+        return SlowHostSource(src, dp["request_id"])
+
+    dst.kv_pull_fn = pull_fn
+    # one block per chunk
+    dst.config.transfer_chunk_bytes = 1
+
+    # background decode on dst, tokens timestamped
+    bg_times = []
+
+    async def run_bg():
+        async for out in dst.generate(
+                greedy_req(list(range(8)), 60, "bg")):
+            bg_times.append(_time.monotonic())
+
+    bg = asyncio.create_task(run_bg())
+    while not bg_times:  # bg is decoding before the pull starts
+        await asyncio.sleep(0.005)
+
+    t_start = _time.monotonic()
+    dis = greedy_req(prompt, 4, "d1")
+    dis.disaggregated_params = params
+    tokens = []
+    t_first = None
+    async for out in dst.generate(dis):
+        if t_first is None and out.token_ids:
+            t_first = _time.monotonic()
+        tokens.extend(out.token_ids)
+    await bg
+
+    assert tokens == expect, "streamed-pull continuation diverged"
+    # decode engine never prefilled the disagg prompt
+    assert dst.metrics["prefill_tokens"] <= 8  # only bg's own prompt
+    # ITL overlap: bg emitted tokens while the pull was in flight
+    during = [t for t in bg_times if t_start < t < t_first]
+    assert len(during) >= 3, (
+        f"decode stalled during pull: {len(during)} tokens in "
+        f"{t_first - t_start:.3f}s pull window")
+    # host memory bound: peak staged chunk = one block, not the payload
+    lo = dst.kv_wire_layout(0)
+    assert dst.metrics["pull_host_chunk_bytes_max"] <= lo.block_bytes()
+    assert dst.metrics["pull_blocks"] == 6
+
+    await src.close()
+    await dst.close()
+    await agg.close()
