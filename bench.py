@@ -1,19 +1,26 @@
-"""Headline benchmark: SERVED decode throughput of the native JAX engine.
+"""Headline benchmark: north-star-shaped serving numbers on one chip.
 
-Unlike a hand-rolled decode loop, this drives the full serving path —
+Measures the largest public-architecture model that fits a single v5e
+chip (llama-3b geometry, randomly initialized — perf is weight-value
+independent) through the FULL serving path (`JaxEngine.generate`:
 admission, batched chunked prefill, block allocation/commit, KV events,
-fused-burst decode with per-burst host sync, stream emission — through
-`JaxEngine.generate`, so the number is what a worker actually serves
-(round-2 verdict weak #2 called out the raw-loop bench as an upper bound).
+fused continuation-burst decode, stream emission) under trace-shaped
+staggered arrivals, and reports latency percentiles the way the
+reference's benchmark recipes do (docs/benchmarks/llama-3-70b-topology.mdx:
+output TPS, TPS/chip, TTFT, ITL):
+
+  value                 served decode tokens/s/chip
+  vs_baseline           fraction of the HBM-bandwidth roofline for these
+                        shapes (decode is bandwidth-bound; BASELINE.md
+                        publishes no absolute numbers)
+  extras.p50/p95_ttft_s TTFT percentiles under staggered arrivals
+  extras.p50/p95_itl_ms smoothed inter-token latency percentiles
+  extras.raw_loop_*     hand decode loop upper bound + scheduler overhead
+  extras.pull_*         disagg KV pull: bandwidth + decode ITL during an
+                        in-flight pull vs baseline (streaming transfer)
 
 Runs on whatever accelerator JAX finds (one v5e chip under the driver).
-vs_baseline is the fraction of the HBM-bandwidth roofline for these shapes
-(decode is bandwidth-bound; BASELINE.md publishes no absolute numbers, so
-roofline fraction tracks tokens/sec/chip parity hardware-independently).
-
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "tokens/s/chip", "vs_baseline": f,
-   "extras": {raw-loop throughput, prefill tok/s, mean TTFT}}
+Prints exactly one JSON line.
 """
 
 import asyncio
@@ -26,21 +33,21 @@ import numpy as np
 
 from dynamo_tpu.models import llama
 
+MODEL = "llama-3b"       # largest public geometry fitting 16G HBM + KV
 BATCH = 8
-CTX = 512            # prompt tokens per sequence
-OUT = 512            # decoded tokens per sequence
-BLOCK = 128          # lane-aligned paged blocks (Pallas decode kernel)
-FUSED_K = 8          # decode steps fused per dispatch (engine default)
+CTX = 2048               # prompt tokens per sequence (recipe-shaped ISL)
+OUT = 256                # decoded tokens per sequence
+BLOCK = 128              # lane-aligned paged blocks (Pallas decode kernel)
+FUSED_K = 8              # decode steps fused per dispatch
 
-# v5e: ~819 GB/s HBM BW; CPU fallback number is irrelevant (vs_baseline only
-# meaningful on TPU)
+# v5e: ~819 GB/s HBM BW; CPU fallback number is irrelevant (vs_baseline
+# only meaningful on TPU)
 HBM_GBPS = 819.0
 
 
-def roofline_tps(cfg, params, mean_ctx: float) -> float:
+def roofline_tps(cfg, n_params: int, mean_ctx: float) -> float:
     """Bandwidth roofline (per decoded token): params read once per step
     amortized over the batch + this seq's mean KV context."""
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     param_bytes = n_params * 2
     kv_bytes = cfg.n_layers * mean_ctx * cfg.n_kv_heads * cfg.head_dim * 2 * 2
     bytes_per_token = param_bytes / BATCH + kv_bytes
@@ -48,10 +55,9 @@ def roofline_tps(cfg, params, mean_ctx: float) -> float:
 
 
 def bench_raw_loop(cfg, params):
-    """The pre-round-3 measurement: decode_multi driven directly, tokens
-    chained on device, one host fetch at the end.  Upper bound the served
-    path is compared against.  Returns (tokens/s, mean decode context)."""
-    steps, warmup = 32, 8
+    """Hand-rolled decode_multi loop, tokens chained on device: the upper
+    bound the served path is compared against."""
+    steps, warmup = 16, 4
     total_positions = CTX + (warmup + steps) * FUSED_K
     max_blocks = total_positions // BLOCK + 2
     num_blocks = BATCH * max_blocks + 1
@@ -85,91 +91,230 @@ def bench_raw_loop(cfg, params):
         tokens, kv = step(params, kv, tokens, pos, tables, pos)
     np.asarray(tokens)
     tps = BATCH * steps * FUSED_K / (time.perf_counter() - t0)
+    del kv
     return tps, CTX + (warmup + steps / 2) * FUSED_K
 
 
-async def bench_engine(cfg):
-    """Served throughput: BATCH concurrent requests through the scheduler."""
+def make_engine(cfg, role="both", num_seqs=BATCH, warm=True):
     from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    max_blocks = (CTX + OUT) // BLOCK + 2
+    eng = JaxEngine(EngineConfig(
+        model_config=cfg, block_size=BLOCK,
+        num_blocks=num_seqs * max_blocks + 1, max_blocks_per_seq=max_blocks,
+        max_num_seqs=num_seqs, decode_fused_steps=FUSED_K, seed=3,
+        role=role,
+    ))
+    if warm:
+        eng.warmup_decode()
+    return eng
+
+
+def mk_req(rng, cfg, i, tag, ctx=CTX, out=OUT):
     from dynamo_tpu.protocols import (
         PreprocessedRequest,
         SamplingOptions,
         StopConditions,
     )
 
-    max_blocks = (CTX + OUT) // BLOCK + 2
-    eng = JaxEngine(EngineConfig(
-        model_config=cfg, block_size=BLOCK,
-        num_blocks=BATCH * max_blocks + 1, max_blocks_per_seq=max_blocks,
-        max_num_seqs=BATCH, decode_fused_steps=FUSED_K, seed=3,
-    ))
+    return PreprocessedRequest(
+        token_ids=[int(t) for t in rng.integers(3, cfg.vocab_size, ctx)],
+        request_id=f"bench-{tag}-{i}",
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=out, ignore_eos=True),
+    )
+
+
+async def bench_served(cfg):
+    """Served throughput + latency percentiles under staggered arrivals
+    (trace-shaped: fixed-seed exponential inter-arrival, mean 150ms)."""
+    eng = make_engine(cfg)
     rng = np.random.default_rng(1)
+    arr_rng = np.random.default_rng(7)
 
-    def req(i, tag="m"):
-        return PreprocessedRequest(
-            token_ids=[int(t) for t in
-                       rng.integers(3, cfg.vocab_size, CTX)],
-            request_id=f"bench-{tag}-{i}",
-            sampling=SamplingOptions(temperature=0.0),
-            stop=StopConditions(max_tokens=OUT, ignore_eos=True),
-        )
+    stats = {}
 
-    stats = {"first": {}, "done": {}, "t0": 0.0}
+    async def run(i, tag, delay=0.0):
+        if delay:
+            await asyncio.sleep(delay)
+        t0 = time.perf_counter()
+        times = []
+        async for out in eng.generate(mk_req(rng, cfg, i, tag)):
+            now = time.perf_counter()
+            times.extend([now] * len(out.token_ids))
+        stats[i] = (t0, times)
+        return len(times)
 
-    async def run(i, tag="m"):
-        n = 0
-        async for out in eng.generate(req(i, tag)):
-            n += len(out.token_ids)
-            if i not in stats["first"] and n > 0:
-                stats["first"][i] = time.perf_counter()
-        stats["done"][i] = time.perf_counter()
-        return n
-
-    # cold pass compiles every shape this workload reaches (prefill
-    # buckets x batch rows, decode burst variants); the measurement is the
-    # warm steady state a serving deployment runs in
-    await asyncio.gather(*[run(i, "w") for i in range(BATCH)])
+    # cold pass compiles every shape this workload reaches — INCLUDING
+    # the arrival pattern: staggered arrivals produce different
+    # (rows, bucket) prefill batch shapes than a simultaneous burst, and
+    # a 3B-scale prefill compile landing mid-measure dwarfs everything
+    # else.  Same seed -> same delays -> same shapes.
+    delays = np.cumsum(arr_rng.exponential(0.15, BATCH))
+    await asyncio.gather(
+        *[run(i, "w", float(delays[i])) for i in range(BATCH)])
     await eng.clear_kv_blocks()
-    stats["first"].clear()
-    stats["done"].clear()
-    eng.metrics["prefill_tokens"] = 0
+    stats.clear()
 
-    stats["t0"] = time.perf_counter()
-    counts = await asyncio.gather(*[run(i) for i in range(BATCH)])
+    counts = await asyncio.gather(
+        *[run(i, "m", float(delays[i])) for i in range(BATCH)])
     total = sum(counts)
-    first_t = min(stats["first"].values())
-    end_t = max(stats["done"].values())
-    prefill_window = first_t - stats["t0"]
-    ttfts = [stats["first"][i] - stats["t0"] for i in range(BATCH)]
-    decode_tokens = total - BATCH  # first tokens come from prefill
-    served_tps = decode_tokens / (end_t - first_t)
-    prefill_tps = eng.metrics["prefill_tokens"] / max(prefill_window, 1e-9)
+
+    ttfts, itls = [], []
+    first_t, last_t = [], []
+    for i, (t0, times) in stats.items():
+        ttfts.append(times[0] - t0)
+        first_t.append(times[0])
+        last_t.append(times[-1])
+        # smoothed ITL: burst arrival gaps averaged over the burst size
+        gaps = np.diff(times)
+        nz = gaps[gaps > 1e-5]
+        if len(nz):
+            itls.extend((np.asarray(nz) / FUSED_K).tolist())
+    decode_tokens = total - BATCH
+    served_tps = decode_tokens / (max(last_t) - min(first_t))
+    # decode-only steady state: after the LAST prefill finished, every
+    # slot is decoding — this window isolates scheduler overhead from the
+    # (legitimate) prefill/decode FLOP mix of the full serve window
+    t_all_decoding = max(first_t)
+    tail_tokens = sum(
+        sum(1 for t in times if t > t_all_decoding)
+        for _t0, times in stats.values())
+    tail_window = max(max(last_t) - t_all_decoding, 1e-9)
+    out = {
+        "served_tps": served_tps,
+        "decode_only_tps": tail_tokens / tail_window,
+        "p50_ttft_s": float(np.percentile(ttfts, 50)),
+        "p95_ttft_s": float(np.percentile(ttfts, 95)),
+        "p50_itl_ms": float(np.percentile(itls, 50)) * 1e3,
+        "p95_itl_ms": float(np.percentile(itls, 95)) * 1e3,
+        "cont_burst_frac": (
+            eng.metrics.get("cont_bursts", 0)
+            / max(1, eng.metrics.get("steps", 1))),
+    }
     await eng.close()
-    return served_tps, prefill_tps, float(np.mean(ttfts))
+    return out
+
+
+async def bench_disagg_pull(cfg):
+    """Streaming disagg pull on one chip: a prefill engine parks a
+    CTX-token prompt's KV; a decode engine pulls it through the broker
+    tier while decoding another request.  Reports pull bandwidth and the
+    decode ITL during the pull vs undisturbed baseline.  Runs on the
+    1B model: TWO engines must coexist in HBM, and the pull metrics are
+    about the transfer machinery, not model scale."""
+    from dynamo_tpu.disagg.broker import LocalEnginePullSource
+    from dynamo_tpu.protocols.llm import DISAGG_ANNOTATION
+
+    rng = np.random.default_rng(5)
+    src = make_engine(cfg, role="prefill", num_seqs=2, warm=False)
+    dst = make_engine(cfg, num_seqs=2)
+
+    async def pull_fn(dp):
+        return LocalEnginePullSource(src, dp["request_id"])
+
+    dst.kv_pull_fn = pull_fn
+
+    async def park_one(tag):
+        pref = mk_req(rng, cfg, 0, tag, out=4)
+        pref.annotations = [DISAGG_ANNOTATION]
+        park = None
+        async for o in src.generate(pref):
+            park = o
+        return park.kv_transfer_params
+
+    # warm the full pull machinery (gather/inject/prefill compiles),
+    # then park the measured prefill
+    wparams = await park_one("pw")
+    warm = mk_req(rng, cfg, 0, "pw", out=4)
+    warm.disaggregated_params = wparams
+    async for _ in dst.generate(warm):
+        pass
+    await dst.clear_kv_blocks()
+    params = await park_one("pf")
+
+    # baseline ITL of a lone decode stream on dst
+    times = []
+
+    async def bg(tag, n):
+        async for o in dst.generate(mk_req(rng, cfg, 1, tag, ctx=512,
+                                           out=n)):
+            times.extend([time.perf_counter()] * len(o.token_ids))
+
+    await bg("warm", 64)
+    times.clear()
+    await bg("base", 96)
+    base_gaps = np.diff(times)
+    base_itl = float(np.mean(base_gaps[base_gaps > 1e-5])) / FUSED_K
+
+    # decode again with the pull in flight
+    times.clear()
+    bg_task = asyncio.create_task(bg("load", 192))
+    while not times:
+        await asyncio.sleep(0.005)
+    dis = mk_req(rng, cfg, 0, "pf", out=4)
+    dis.disaggregated_params = params
+    t0 = time.perf_counter()
+    toks = []
+    async for o in dst.generate(dis):
+        toks.extend(o.token_ids)
+    pull_s = time.perf_counter() - t0
+    await bg_task
+    assert toks[0] == params["first_token"]
+    lo = dst.kv_wire_layout(0)
+    n_blocks = (CTX + BLOCK - 1) // BLOCK
+    payload = n_blocks * lo.block_bytes()
+    load_gaps = np.diff(times)
+    load_itl = float(np.mean(load_gaps[load_gaps > 1e-5])) / FUSED_K
+    out = {
+        "pull_gbytes_per_s": payload / pull_s / 1e9,
+        "pull_seconds": pull_s,
+        "itl_during_pull_ms": load_itl * 1e3,
+        "itl_baseline_ms": base_itl * 1e3,
+    }
+    await src.close()
+    await dst.close()
+    return out
 
 
 def main() -> None:
-    cfg = llama.PRESETS["llama-1b"]
+    # stage order bounds peak HBM: the served engine alone, then two
+    # small disagg engines, then the raw loop with fresh params — the 3B
+    # weights exist in at most one copy at any moment
+    cfg = llama.PRESETS[MODEL]
+    served = asyncio.run(bench_served(cfg))
+    pull = asyncio.run(bench_disagg_pull(llama.PRESETS["llama-1b"]))
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     raw_tps, raw_mean_ctx = bench_raw_loop(cfg, params)
-    # per-workload rooflines (mean decode context differs between the two)
-    roof = roofline_tps(cfg, params, CTX + OUT / 2)
-    roof_raw = roofline_tps(cfg, params, raw_mean_ctx)
+    roof = roofline_tps(cfg, n_params, CTX + OUT / 2)
+    roof_raw = roofline_tps(cfg, n_params, raw_mean_ctx)
     del params
-    served_tps, prefill_tps, ttft = asyncio.run(bench_engine(cfg))
 
+    tps = served["served_tps"]
     print(json.dumps({
-        "metric": "llama-1b SERVED decode throughput "
-                  f"(engine scheduler path, B={BATCH}, ctx={CTX}, bf16)",
-        "value": round(served_tps, 2),
+        "metric": f"{MODEL} SERVED decode throughput (full engine path, "
+                  f"staggered arrivals, B={BATCH}, ctx={CTX}, bf16)",
+        "value": round(tps, 2),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(served_tps / roof, 4),
+        "vs_baseline": round(tps / roof, 4),
         "extras": {
+            "p50_ttft_s": round(served["p50_ttft_s"], 3),
+            "p95_ttft_s": round(served["p95_ttft_s"], 3),
+            "p50_itl_ms": round(served["p50_itl_ms"], 2),
+            "p95_itl_ms": round(served["p95_itl_ms"], 2),
+            "cont_burst_frac": round(served["cont_burst_frac"], 3),
+            "decode_only_tps": round(served["decode_only_tps"], 2),
             "raw_loop_tokens_per_s": round(raw_tps, 2),
             "raw_loop_vs_roofline": round(raw_tps / roof_raw, 4),
-            "prefill_tokens_per_s": round(prefill_tps, 2),
-            "mean_ttft_s": round(ttft, 3),
-            "sched_overhead_vs_raw": round(1 - served_tps / raw_tps, 4),
+            # overhead measured decode-vs-decode (the full serve window
+            # also pays prefill FLOPs, which are not scheduler overhead)
+            "sched_overhead_vs_raw": round(
+                1 - served["decode_only_tps"] / raw_tps, 4),
+            "pull_gbytes_per_s": round(pull["pull_gbytes_per_s"], 3),
+            "pull_seconds": round(pull["pull_seconds"], 3),
+            "itl_during_pull_ms": round(pull["itl_during_pull_ms"], 2),
+            "itl_baseline_ms": round(pull["itl_baseline_ms"], 2),
         },
     }))
 

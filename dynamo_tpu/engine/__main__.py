@@ -48,6 +48,8 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--no-kvbm-remote", action="store_true",
                    help="disable cross-worker G2 pull")
     p.add_argument("--migration-limit", type=int, default=3)
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip decode-variant precompilation at startup")
     p.add_argument("--role", default="both",
                    choices=["both", "prefill", "decode"])
     p.add_argument("--reasoning-parser", default="",
@@ -80,6 +82,7 @@ async def main() -> None:
         object_store_dir=args.object_store_dir or None,
         kvbm_remote=not args.no_kvbm_remote,
         role=args.role,
+        warmup=not args.no_warmup,
         reasoning_parser=args.reasoning_parser,
         lora_dir=args.lora_dir or None,
         lora_max_adapters=(args.lora_max_adapters if args.lora_dir else 0),

@@ -91,13 +91,22 @@ class EngineConfig:
     lora_rank: int = 16
     lora_dir: Optional[str] = None
 
-    # parallelism
+    # parallelism.  sp > 1 enables sequence-parallel ring-attention
+    # prefill for prompts beyond the largest prefill bucket (the
+    # long-context path; ops/ring_attention.py) — dp*tp*sp must divide
+    # the device count
     dp: int = 1
     tp: int = 1
+    sp: int = 1
 
     # disaggregation role: "both" serves agg traffic; "prefill" workers run
     # prefill-only hops and park KV; "decode" workers pull and decode
     role: str = "both"
+
+    # compile every decode-program variant before serving traffic
+    # (core.py warmup_decode) — on by the CLI worker/bench; default off so
+    # short-lived test engines skip the extra compiles
+    warmup: bool = False
 
     # None = resolve from the checkpoint's config.json (model_path) or 2
     eos_token_id: Optional[int] = None

@@ -109,6 +109,11 @@ class _Slot:
     inflight: int = 0
     # bumped on preemption so stale in-flight bursts are discarded
     epoch: int = 0
+    # guided decoding (guided/json_prefix.py): constrained slots step
+    # one token at a time through the top-M candidate path instead of
+    # joining fused batch bursts
+    guide: Optional[Any] = None
+    guided_out: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -138,7 +143,7 @@ class JaxEngine:
         self.model_cfg = config.resolve_model()
         self.family = get_family(self.model_cfg)
         self.mesh = mesh if mesh is not None else make_mesh(
-            MeshConfig(dp=config.dp, tp=config.tp)
+            MeshConfig(dp=config.dp, tp=config.tp, sp=config.sp)
         )
         self.kv_event_sink = kv_event_sink
         self._sink_takes_tier = False
@@ -214,10 +219,6 @@ class JaxEngine:
                 raise ValueError(
                     f"model family {self.model_cfg.name!r} does not "
                     "support LoRA serving")
-            if step_sink is not None:
-                raise ValueError(
-                    "LoRA + multihost step replay is not supported yet: "
-                    "adapter bank mutations do not ride the step stream")
             from ..lora.bank import empty_bank
             from ..lora.source import LocalLoraSource
 
@@ -248,11 +249,13 @@ class JaxEngine:
         # decode variants: {greedy: jitted} — an all-greedy batch takes the
         # argmax specialization (sampling machinery measurably costs on
         # large vocabs even top-k-capped)
+        # donate kv + the advancing descriptor arrays (positions/ctx/steps
+        # are returned advanced for the next burst's continuation)
         self._jit_decode = {
             g: jax.jit(
                 partial(self._decode_impl, self.family, self.model_cfg,
                         self.mesh, g),
-                donate_argnums=(1,),
+                donate_argnums=(1, 5, 7, 9),
             )
             for g in (False, True)
         }
@@ -264,6 +267,15 @@ class JaxEngine:
             partial(self._prefill_batched_impl, self.family, self.model_cfg),
             donate_argnums=(1,),
         )
+        # sequence-parallel ring prefill: long-context path for prompts
+        # beyond the largest bucket when the mesh has an sp axis
+        self._jit_prefill_ring = None
+        if config.sp > 1 and hasattr(self.family, "prefill_ring"):
+            self._jit_prefill_ring = jax.jit(
+                partial(self._prefill_ring_impl, self.family,
+                        self.model_cfg, self.mesh),
+                donate_argnums=(1,),
+            )
         self._jit_inject = jax.jit(self._inject_impl, donate_argnums=(0,))
         self._jit_gather = jax.jit(self._gather_impl)
         self._jit_decode_multi = None
@@ -273,10 +285,22 @@ class JaxEngine:
                     partial(self._decode_multi_impl, self.family,
                             self.model_cfg, self.mesh, g,
                             config.decode_fused_steps),
-                    donate_argnums=(1,),
+                    donate_argnums=(1, 5, 7, 9),
                 )
                 for g in (False, True)
             }
+        # continuation decode (steady state): the burst descriptor lives on
+        # device and advances INSIDE the decode program (advance=k), so an
+        # unchanged-membership burst uploads nothing — the full path
+        # uploads ~12 arrays per burst, each paying the host->device hop
+        # (the round-3 scheduler-overhead finding).  _dev_desc is the
+        # device descriptor pack of the last dispatched burst; _last_desc
+        # the leader's host mirror used to prove the next burst is a pure
+        # continuation of it.
+        self._dev_desc: Optional[Dict[str, Any]] = None
+        self._last_desc: Optional[Dict[str, Any]] = None
+        self._desc_sharding = NamedSharding(self.mesh, P())
+        self._adv_consts: Dict[int, Any] = {}
 
         self.waiting: List[_Slot] = []
         self._sched_calls: List[tuple] = []  # (fn, future) run between steps
@@ -323,13 +347,24 @@ class JaxEngine:
     @staticmethod
     def _decode_impl(family, model_cfg, mesh, greedy, params, kv, chain,
                      use_chain, tokens, positions, block_tables, ctx_lens,
-                     seeds, steps, temps, top_ks, top_ps, valid,
+                     seeds, steps, temps, top_ks, top_ps, valid, advance,
                      lora_bank=None, lidx=None):
         """chain/use_chain: device-resident token chaining — lanes whose
         previous burst is still unread take their input token from the
         prior burst's on-device output instead of a host round-trip.
         `greedy` is a static specialization: an all-greedy batch skips the
-        sampling machinery (sampler.py greedy_tokens)."""
+        sampling machinery (sampler.py greedy_tokens).
+
+        `advance` (traced scalar) is the continuation clock: steady-state
+        bursts re-dispatch the PREVIOUS device descriptor with advance=k
+        instead of uploading fresh positions/ctx/steps — the advanced
+        arrays are returned for the next burst.  One program serves both
+        modes, so donated KV never crosses programs (a separate
+        continuation program made XLA re-lay the multi-GB cache on every
+        transition — measured at seconds per full burst)."""
+        positions = positions + advance
+        ctx_lens = ctx_lens + advance
+        steps = steps + advance
         tokens = jnp.where(use_chain, chain, tokens)
         lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
                    if lora_bank is not None else {})
@@ -342,17 +377,22 @@ class JaxEngine:
         else:
             next_tokens = sample_tokens(logits, seeds, steps, temps,
                                         top_ks, top_ps)
-        return next_tokens[None], kv  # [1, B]: burst-shaped like multi
+        # [1, B]: burst-shaped like multi
+        return next_tokens[None], kv, positions, ctx_lens, steps
 
     @staticmethod
     def _decode_multi_impl(family, model_cfg, mesh, greedy, num_steps,
                            params, kv, chain, use_chain, tokens, positions,
                            block_tables, ctx_lens, seeds, steps, temps,
-                           top_ks, top_ps, valid, lora_bank=None,
+                           top_ks, top_ps, valid, advance, lora_bank=None,
                            lidx=None):
         """num_steps fused decode steps (family decode_multi); sampling
         streams stay per-token identical to the single-step path (seed
-        folded with the running step counter)."""
+        folded with the running step counter).  `advance`: see
+        _decode_impl."""
+        positions = positions + advance
+        ctx_lens = ctx_lens + advance
+        steps = steps + advance
         tokens = jnp.where(use_chain, chain, tokens)
         if greedy:
             sample_fn = None  # decode_multi defaults to argmax
@@ -363,11 +403,12 @@ class JaxEngine:
 
         lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
                    if lora_bank is not None else {})
-        return family.decode_multi(
+        burst, kv = family.decode_multi(
             params, model_cfg, kv, tokens, positions, block_tables,
             ctx_lens, num_steps, sample_fn, valid=valid, mesh=mesh,
             **lora_kw,
         )
+        return burst, kv, positions, ctx_lens, steps
 
     @staticmethod
     def _inject_impl(kv, kb, vb, ids):
@@ -414,6 +455,23 @@ class JaxEngine:
         return tok, kv
 
     @staticmethod
+    def _prefill_ring_impl(family, model_cfg, mesh, params, kv, toks,
+                           positions, block_table, true_len, seed, temp,
+                           top_k, top_p):
+        """One-shot sequence-parallel prefill + first-token sample (the
+        sp analogue of _prefill_impl; ring attention shards the O(T^2)
+        attention over the mesh's sp axis)."""
+        logits, kv = family.prefill_ring(
+            params, model_cfg, kv, toks, positions, block_table,
+            true_len, mesh=mesh,
+        )
+        tok = sample_tokens(
+            logits[None], seed[None], jnp.zeros((1,), jnp.int32),
+            temp[None], top_k[None], top_p[None],
+        )[0]
+        return tok, kv
+
+    @staticmethod
     def _prefill_batched_impl(family, model_cfg, params, kv, toks,
                               positions, tables, ctx_lens, true_lens,
                               seeds, temps, top_ks, top_ps,
@@ -439,24 +497,58 @@ class JaxEngine:
         the exact jit call the leader ran, on this process's local shards
         (parallel/multihost.py).  Sampled tokens are discarded; only the
         KV/weights state evolution matters on followers."""
+        # lora args mirror the leader's calls exactly: when the bank
+        # exists both sides pass (bank, lidx) — a one-sided lora arg would
+        # compile a DIFFERENT program and desynchronize the collective
+        # schedule
         if kind == "prefill_batch":
+            lora = ((self.lora_bank, jnp.asarray(a["lidx"]))
+                    if self.lora_bank is not None else (None, None))
             _, self.kv = self._jit_prefill_batched(
                 self.params, self.kv,
                 jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
                 jnp.asarray(a["tables"]), jnp.asarray(a["ctx_lens"]),
                 jnp.asarray(a["true_lens"]), jnp.asarray(a["seeds"]),
                 jnp.asarray(a["temps"]), jnp.asarray(a["top_ks"]),
-                jnp.asarray(a["top_ps"]),
+                jnp.asarray(a["top_ps"]), *lora,
             )
         elif kind == "prefill":
+            lora = ((self.lora_bank, jnp.int32(a["lidx"]))
+                    if self.lora_bank is not None else (None, None))
             _, self.kv = self._jit_prefill(
                 self.params, self.kv,
                 jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
                 jnp.asarray(a["block_table"]),
                 jnp.int32(a["pos"]), jnp.int32(a["chunk"]),
                 jnp.int32(a["seed"]), jnp.float32(a["temp"]),
-                jnp.int32(a["top_k"]), jnp.float32(a["top_p"]),
+                jnp.int32(a["top_k"]), jnp.float32(a["top_p"]), *lora,
             )
+        elif kind == "decode_topk":
+            # guided candidate step: same collective program, result is
+            # the leader's to consume
+            _, _, self.kv = self._topk_jit()(
+                self.params, self.kv, jnp.asarray(a["tokens"]),
+                jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
+                jnp.asarray(a["ctx_lens"]), jnp.asarray(a["valid"]),
+            )
+        elif kind == "prefill_ring":
+            _, self.kv = self._jit_prefill_ring(
+                self.params, self.kv, jnp.asarray(a["toks"]),
+                jnp.asarray(a["positions"]),
+                jnp.asarray(a["block_table"]),
+                jnp.int32(a["true_len"]), jnp.int32(a["seed"]),
+                jnp.float32(a["temp"]), jnp.int32(a["top_k"]),
+                jnp.float32(a["top_p"]),
+            )
+        elif kind == "lora_write":
+            from ..lora.bank import write_adapter
+
+            tensors = {k: v for k, v in a.items() if k != "slot"}
+            self.lora_bank = write_adapter(self.lora_bank, int(a["slot"]),
+                                           tensors)
+        elif kind == "embed":
+            # read-only, but a collective program every process must run
+            self._run_embed(np.asarray(a["toks"]), int(a["true_len"]))
         elif kind in ("decode", "decode_multi"):
             # _dispatch_decode keeps the follower's device token chain
             # symmetric with the leader's (use_chain lanes resolve to the
@@ -465,6 +557,12 @@ class JaxEngine:
                 self.config.decode_fused_steps if kind == "decode_multi"
                 else 1, a,
             )
+        elif kind == "decode_cont":
+            # continuation bursts ship no arrays: the follower's own
+            # device pack (persisted by its preceding full decode replay)
+            # advances in-program, exactly like the leader's
+            self._dispatch_decode_cont(int(a["k"]), int(a["advance"]),
+                                       bool(int(a["greedy"])))
         elif kind == "gather":
             # read-only, but still a collective program every process of
             # the slice must execute (KVBM offload, parked-KV extraction);
@@ -479,6 +577,50 @@ class JaxEngine:
             )
         else:
             raise ValueError(f"unknown step kind {kind!r}")
+
+    def warmup_decode(self) -> None:
+        """Compile every decode-program variant serving can reach — both
+        burst sizes (k=1 interleaves with prefill, k=fused in steady
+        state), greedy and sampled, full and continuation dispatch — so
+        no first-request or mid-serving burst ever eats a 10s+ XLA
+        compile (measured: a (greedy, k=1) variant compiling inside the
+        serving window cost more than all other scheduler overhead
+        combined).  Runs on the caller's thread; call before serving
+        traffic (worker startup / bench warm phase).  Prefill buckets
+        are NOT warmed here (one per bucket is admission-driven and the
+        first request pays exactly one)."""
+        B = self.config.max_num_seqs
+        zero = {
+            "tokens": np.zeros(B, np.int32),
+            "use_chain": np.zeros(B, bool),
+            "positions": np.zeros(B, np.int32),
+            "tables": np.zeros((B, self.config.max_blocks_per_seq),
+                               np.int32),
+            "ctx_lens": np.ones(B, np.int32),
+            "seeds": np.zeros(B, np.int32),
+            "steps": np.ones(B, np.int32),
+            "top_ks": np.zeros(B, np.int32),
+            "top_ps": np.ones(B, np.float32),
+            "valid": np.zeros(B, bool),  # nothing real decodes
+        }
+        if self.lora_bank is not None:
+            zero["lidx"] = np.zeros(B, np.int32)
+        ks = [1]
+        if self.config.decode_fused_steps > 1:
+            ks.append(self.config.decode_fused_steps)
+        chain0, desc0, last0 = (self._chain_tokens, self._dev_desc,
+                                self._last_desc)
+        for greedy in (True, False):
+            a = dict(zero, temps=np.full(
+                B, 0.0 if greedy else 0.7, np.float32))
+            for k in ks:
+                self._dispatch_decode(k, a)
+                self._dispatch_decode_cont(k, k, greedy)
+        jax.block_until_ready(self.kv)
+        # warmup bursts wrote nothing (valid all-false) but did advance
+        # the chain/descriptor state machinery: reset it
+        self._chain_tokens, self._dev_desc, self._last_desc = (
+            chain0, desc0, last0)
 
     # -- request entry ----------------------------------------------------
     def start(self) -> None:
@@ -598,6 +740,10 @@ class JaxEngine:
         from ..protocols.llm import DISAGG_ANNOTATION
 
         slot.disagg_prefill = DISAGG_ANNOTATION in (request.annotations or [])
+        if request.sampling.guided_json is not None:
+            from ..guided import JsonSchemaGuide
+
+            slot.guide = JsonSchemaGuide(request.sampling.guided_json)
         pull_task = None
         if want_pull:
             slot.pulling = True
@@ -765,6 +911,15 @@ class JaxEngine:
                 self._lora_lru.remove(victim)
             from ..lora.bank import write_adapter
 
+            if self.step_sink is not None:
+                # bank mutations ride the step stream: followers apply the
+                # same write so every process's adapter bank (a jit input)
+                # stays bit-identical with the leader's
+                self.step_sink("lora_write", {
+                    "slot": np.int32(slot),
+                    **{k: np.asarray(v) for k, v in
+                       adapter.tensors.items()},
+                })
             self.lora_bank = write_adapter(self.lora_bank, slot,
                                            adapter.tensors)
             self._lora_slots[name] = slot
@@ -826,21 +981,31 @@ class JaxEngine:
                 f"input is {len(token_ids)} tokens; embedding max is "
                 f"{self.config.prefill_buckets[-1]}")
         bucket = self._bucket_for(len(token_ids))
+        toks = np.zeros(bucket, np.int32)
+        toks[: len(token_ids)] = token_ids
+        true_len = len(token_ids)
+
+        def run():
+            if self.step_sink is not None:
+                # a collective program every process of the slice must
+                # execute — embed rides the step stream like everything
+                # else (the result is the leader's to consume)
+                self.step_sink("embed", {"toks": toks,
+                                         "true_len": np.int32(true_len)})
+            return self._run_embed(toks, true_len)
+
+        self.start()
+        return await self._call_on_scheduler(run)
+
+    def _run_embed(self, toks: np.ndarray, true_len: int) -> np.ndarray:
         jit = getattr(self, "_jit_embed", None)
         if jit is None:
             jit = self._jit_embed = jax.jit(
                 partial(self.family.embed_text, self.params,
                         self.model_cfg))
-        toks = np.zeros(bucket, np.int32)
-        toks[: len(token_ids)] = token_ids
-
-        def run():
-            with self.mesh:
-                return np.asarray(
-                    jit(jnp.asarray(toks), jnp.int32(len(token_ids))),
-                    np.float32)
-
-        return await asyncio.to_thread(run)
+        with self.mesh:
+            return np.asarray(
+                jit(jnp.asarray(toks), jnp.int32(true_len)), np.float32)
 
     async def clear_kv_blocks(self) -> int:
         """Drop the reusable prefix cache (active sequences keep theirs)."""
@@ -1011,6 +1176,7 @@ class JaxEngine:
             self._maybe_offload()
             self._admit_waiting()
             self._prefill_step()
+            self._guided_step()
             if any(s is not None and not s.prefilling for s in self._slots):
                 self._decode_step()
             elif self._inflight:
@@ -1274,16 +1440,17 @@ class JaxEngine:
             temps[i] = s.temperature
             top_ks[i] = s.top_k
             top_ps[i] = s.top_p
+        lidx = np.zeros(Bp, np.int32)
+        for i, (slot, _) in enumerate(zip(pslots, chunks)):
+            lidx[i] = slot.lora_idx
         if self.step_sink is not None:
             self.step_sink("prefill_batch", {
                 "toks": toks, "positions": positions,
                 "tables": tables, "ctx_lens": ctx_lens,
                 "true_lens": true_lens, "seeds": seeds, "temps": temps,
                 "top_ks": top_ks, "top_ps": top_ps,
+                **({"lidx": lidx} if self.lora_bank is not None else {}),
             })
-        lidx = np.zeros(Bp, np.int32)
-        for i, (slot, _) in enumerate(zip(pslots, chunks)):
-            lidx[i] = slot.lora_idx
         tok, self.kv = self._jit_prefill_batched(
             self.params, self.kv,
             jnp.asarray(toks), jnp.asarray(positions), jnp.asarray(tables),
@@ -1299,6 +1466,17 @@ class JaxEngine:
     def _prefill_one(self, slot: "_Slot", budget: int) -> None:
         """The B=1 chunk program (single prefilling slot)."""
         c = self.config
+        if (self._jit_prefill_ring is not None
+                and slot.prefill_pos == 0
+                and slot.prompt_len > c.prefill_buckets[-1]
+                and slot.lora_idx == 0):
+            # long-context path: one sequence-parallel program computes
+            # the whole prompt with ring attention — the O(T^2) FLOPs
+            # shard over sp devices instead of chunk-serializing on each.
+            # Trade-off vs chunking: decode stalls for this ONE program
+            # (not per chunk), but the sp-way split makes it short.
+            self._prefill_ring_one(slot)
+            return
         pos = slot.prefill_pos
         chunk = min(c.prefill_buckets[-1], budget, slot.prompt_len - pos)
         bucket = self._bucket_for(chunk)
@@ -1316,6 +1494,8 @@ class JaxEngine:
                 "seed": np.int32(slot.sampling_seed),
                 "temp": np.float32(s.temperature),
                 "top_k": np.int32(s.top_k), "top_p": np.float32(s.top_p),
+                **({"lidx": np.int32(slot.lora_idx)}
+                   if self.lora_bank is not None else {}),
             })
         tok, self.kv = self._jit_prefill(
             self.params, self.kv,
@@ -1329,6 +1509,38 @@ class JaxEngine:
             else None,
         )
         self._finish_prefill_chunk(slot, chunk, int(tok))
+
+    def _prefill_ring_one(self, slot: "_Slot") -> None:
+        """Whole-prompt sequence-parallel prefill (see _prefill_one)."""
+        c = self.config
+        T = slot.prompt_len
+        # pad to a pow2 multiple of (sp * smallest bucket): T must divide
+        # by sp for the ring, and pow2 rounding bounds distinct shapes
+        g = c.sp * c.prefill_buckets[0]
+        T_pad = _pow2_len(-(-T // g)) * g
+        toks = np.zeros(T_pad, np.int32)
+        toks[:T] = slot.seq.tokens[:T]
+        positions = np.arange(T_pad, dtype=np.int32)
+        s = slot.request.sampling
+        if self.step_sink is not None:
+            self.step_sink("prefill_ring", {
+                "toks": toks, "positions": positions,
+                "block_table": slot.block_table.copy(),
+                "true_len": np.int32(T),
+                "seed": np.int32(slot.sampling_seed),
+                "temp": np.float32(s.temperature),
+                "top_k": np.int32(s.top_k), "top_p": np.float32(s.top_p),
+            })
+        tok, self.kv = self._jit_prefill_ring(
+            self.params, self.kv, jnp.asarray(toks),
+            jnp.asarray(positions), jnp.asarray(slot.block_table),
+            jnp.int32(T), jnp.int32(slot.sampling_seed),
+            jnp.float32(s.temperature), jnp.int32(s.top_k),
+            jnp.float32(s.top_p),
+        )
+        self.metrics["ring_prefills"] = \
+            self.metrics.get("ring_prefills", 0) + 1
+        self._finish_prefill_chunk(slot, T, int(tok))
 
     def _finish_prefill_chunk(self, slot: "_Slot", chunk: int,
                               first: int) -> None:
@@ -1345,6 +1557,14 @@ class JaxEngine:
         slot.first_token_t = time.monotonic()
         if slot.disagg_prefill:
             self._park_prefilled(slot, first)
+            return
+        if slot.guide is not None:
+            # constrained output: discard the unconstrained sample and
+            # re-derive the first token's logits in the guided step by
+            # re-running the last prompt position (its KV rewrite is
+            # value-identical)
+            slot.ctx_len = slot.prompt_len - 1
+            slot.last_token = slot.seq.tokens[slot.prompt_len - 1]
             return
         self._push_token(slot, first)
 
@@ -1488,6 +1708,8 @@ class JaxEngine:
                     "temp": np.float32(s.temperature),
                     "top_k": np.int32(s.top_k),
                     "top_p": np.float32(s.top_p),
+                    **({"lidx": np.int32(slot.lora_idx)}
+                       if self.lora_bank is not None else {}),
                 })
             tok, self.kv = self._jit_prefill(
                 self.params, self.kv, jnp.asarray(toks),
@@ -1562,7 +1784,8 @@ class JaxEngine:
             self._process_oldest_burst()
         k = self._fused_k()
         active = [s for s in self._slots
-                  if s is not None and not s.prefilling]
+                  if s is not None and not s.prefilling
+                  and s.guide is None]
         if not active:
             return
         # Every active slot MUST have a block for its next device position
@@ -1615,7 +1838,8 @@ class JaxEngine:
                 nblocks += 1
 
         active = [s for s in self._slots
-                  if s is not None and not s.prefilling]
+                  if s is not None and not s.prefilling
+                  and s.guide is None]
         if not active:
             return
 
@@ -1664,9 +1888,30 @@ class JaxEngine:
             for s in active:
                 lidx[s.index] = s.lora_idx
             a["lidx"] = lidx
-        if self.step_sink is not None:
-            self.step_sink("decode_multi" if k > 1 else "decode", a)
-        burst = self._dispatch_decode(k, a)
+        if self._is_continuation(a, active, k):
+            # steady state: nothing changed but the clock — advance the
+            # device-resident descriptor in-program, upload nothing
+            prev = self._last_desc
+            adv = prev["k"]
+            greedy = bool(np.all(a["temps"] <= 0.0))
+            if self.step_sink is not None:
+                self.step_sink("decode_cont", {
+                    "k": np.int32(k), "advance": np.int32(adv),
+                    "greedy": np.int32(greedy),
+                })
+            burst = self._dispatch_decode_cont(k, adv, greedy)
+            for name in ("positions", "ctx_lens", "steps"):
+                prev[name] = prev[name] + adv
+            prev["k"] = k
+            self.metrics["cont_bursts"] = \
+                self.metrics.get("cont_bursts", 0) + 1
+        else:
+            if self.step_sink is not None:
+                self.step_sink("decode_multi" if k > 1 else "decode", a)
+            burst = self._dispatch_decode(k, a)
+            self._last_desc = {**a, "k": k}
+            self._last_desc.pop("tokens", None)
+            self._last_desc.pop("use_chain", None)
         # start the device->host copy NOW so the fetch in
         # _process_oldest_burst (>= 1 iteration later) finds the data
         # already local — a fresh fetch pays the full transport RTT
@@ -1682,32 +1927,298 @@ class JaxEngine:
             self._chain_owner[s.index] = lanes[s.index]
         self._inflight.append({"burst": burst, "k": k, "lanes": lanes})
 
+    GUIDED_TOPM = 32
+
+    @staticmethod
+    def _decode_topk_impl(family, model_cfg, mesh, m, params, kv, tokens,
+                          positions, tables, ctx_lens, valid):
+        """One decode step returning the top-M candidate ids + logits for
+        every lane (guided decoding samples on HOST from this candidate
+        set instead of shipping a 128k-vocab mask per token)."""
+        logits, kv = family.decode(
+            params, model_cfg, kv, tokens, positions, tables, ctx_lens,
+            valid=valid, mesh=mesh,
+        )
+        vals, ids = jax.lax.top_k(logits.astype(jnp.float32), m)
+        return ids, vals, kv
+
+    def _topk_jit(self):
+        """ONE lazy-init site for the guided top-M program — leader and
+        follower must compile the identical collective program."""
+        if getattr(self, "_jit_decode_topk", None) is None:
+            self._jit_decode_topk = jax.jit(
+                partial(self._decode_topk_impl, self.family,
+                        self.model_cfg, self.mesh, self.GUIDED_TOPM),
+                donate_argnums=(1,),
+            )
+        return self._jit_decode_topk
+
+    def _guided_codec(self):
+        """Token<->text codec for guided decoding; workers install the
+        model's real tokenizer, presets fall back to the same mock
+        byte tokenizer their model cards advertise."""
+        codec = getattr(self, "guided_codec", None)
+        if codec is None:
+            from ..frontend.tokenizer import MockTokenizer
+
+            codec = self.guided_codec = MockTokenizer(
+                self.model_cfg.vocab_size)
+        return codec
+
+    def _guided_step(self) -> None:
+        """One constrained token for every guided slot (guide != None).
+
+        Each slot steps alone through the top-M program: candidates are
+        tried in sampled order (deterministic gumbel over the top-M
+        logits) and the first whose decoded text keeps the output a
+        valid JSON prefix wins; EOS is admissible only once the document
+        is complete.  When no candidate fits — or the token budget is
+        about to run out mid-document — the canonical completion closes
+        the document, so the response is ALWAYS schema-valid."""
+        gslots = [s for s in self._slots
+                  if s is not None and not s.prefilling
+                  and s.guide is not None and not s.finished]
+        if not gslots:
+            return
+        c = self.config
+        if getattr(self, "_jit_decode_topk", None) is None:
+            self._jit_decode_topk = jax.jit(
+                partial(self._decode_topk_impl, self.family,
+                        self.model_cfg, self.mesh, self.GUIDED_TOPM),
+                donate_argnums=(1,),
+            )
+        codec = self._guided_codec()
+        B = c.max_num_seqs
+        for slot in gslots:
+            # block for the next position (no burst speculation needed)
+            nblocks = int(np.count_nonzero(slot.block_table))
+            if slot.ctx_len >= nblocks * c.block_size:
+                if nblocks >= c.max_blocks_per_seq:
+                    self._guided_finish(slot, codec, forced=True)
+                    continue
+                grow = self.allocator.append_block(self._seq_id(slot))
+                self._emit_events(grow)
+                if grow.block_id is None:
+                    self._preempt(slot)
+                    continue
+                slot.block_table[nblocks] = grow.block_id
+            a = {
+                "tokens": np.zeros(B, np.int32),
+                "positions": np.zeros(B, np.int32),
+                "tables": np.zeros((B, c.max_blocks_per_seq), np.int32),
+                "ctx_lens": np.zeros(B, np.int32),
+                "valid": np.zeros(B, bool),
+            }
+            i = slot.index
+            a["tokens"][i] = slot.last_token
+            a["positions"][i] = slot.ctx_len
+            a["ctx_lens"][i] = slot.ctx_len
+            a["tables"][i] = slot.block_table
+            a["valid"][i] = True
+            if self.step_sink is not None:
+                self.step_sink("decode_topk", a)
+            ids, vals, self.kv = self._jit_decode_topk(
+                self.params, self.kv, jnp.asarray(a["tokens"]),
+                jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
+                jnp.asarray(a["ctx_lens"]), jnp.asarray(a["valid"]),
+            )
+            cand_ids = np.asarray(ids[i])
+            cand_logits = np.asarray(vals[i])
+            slot.ctx_len += 1  # this step's KV write is in the cache
+            s = slot.request.sampling
+            if s.temperature <= 0.0:
+                order = np.argsort(-cand_logits)
+            else:
+                g = np.random.default_rng(
+                    (slot.sampling_seed + slot.generated)
+                    & 0xFFFFFFFF).gumbel(size=cand_logits.shape)
+                order = np.argsort(-(cand_logits / s.temperature + g))
+            text = codec.decode(slot.guided_out)
+            chosen = None
+            for j in order:
+                tok = int(cand_ids[j])
+                if tok in self.eos_ids:
+                    if slot.guide.done(text):
+                        chosen = ("eos", tok)
+                        break
+                    continue
+                if slot.guide.ok(codec.decode(slot.guided_out + [tok])):
+                    chosen = ("tok", tok)
+                    break
+            if chosen is None:
+                # nothing in the candidate set extends the document:
+                # close it canonically
+                self._guided_finish(slot, codec)
+                continue
+            kind, tok = chosen
+            if kind == "eos":
+                self._guided_emit(slot, tok, "stop")
+                continue
+            slot.guided_out.append(tok)
+            done = slot.guide.done(codec.decode(slot.guided_out))
+            self._guided_emit(slot, tok, "stop" if done else None)
+            if not slot.finished \
+                    and slot.generated >= slot.request.stop.max_tokens:
+                # budget exhausted mid-document: schema validity beats
+                # the token budget — close canonically (a few tokens
+                # over) instead of emitting truncated invalid JSON
+                self._guided_finish(slot, codec)
+
+    def _guided_emit(self, slot: _Slot, tok: int,
+                     finish: Optional[str]) -> None:
+        """Stream one guided token with an EXPLICIT finish decision (the
+        generic _finish_reason would truncate at max_tokens mid-document;
+        the guided path closes the document instead)."""
+        now = time.monotonic()
+        if slot.last_push_t > 0.0:
+            gap = now - slot.last_push_t
+            self.itl_ema_s = gap if self.itl_ema_s == 0.0 \
+                else 0.95 * self.itl_ema_s + 0.05 * gap
+        slot.last_push_t = now
+        slot.seq.append(tok)
+        slot.last_token = tok
+        slot.generated += 1
+        self.metrics["decode_tokens"] += 1
+        self._commit_full_blocks(slot)
+        out = LLMEngineOutput(token_ids=[tok], finish_reason=finish)
+        if self._loop_ref is not None:
+            self._loop_ref.call_soon_threadsafe(slot.out_q.put_nowait, out)
+        else:
+            slot.out_q.put_nowait(out)
+        if finish is not None:
+            slot.finished = True
+            if slot.index >= 0:
+                self._slots[slot.index] = None
+                slot.index = -1
+            self._emit_events(self.allocator.free(self._seq_id(slot)))
+
+    def _guided_finish(self, slot: _Slot, codec) -> None:
+        """Emit the canonical completion closing the document and finish
+        the stream."""
+        text = codec.decode(slot.guided_out)
+        try:
+            completion = slot.guide.complete(text)
+        except ValueError:
+            completion = ""
+        toks = codec.encode(completion) if completion else []
+        slot.guided_out.extend(toks)
+        if toks:
+            self.metrics["guided_forced_closes"] = \
+                self.metrics.get("guided_forced_closes", 0) + 1
+        out = LLMEngineOutput(token_ids=list(toks), finish_reason="stop")
+        if self._loop_ref is not None:
+            self._loop_ref.call_soon_threadsafe(slot.out_q.put_nowait, out)
+        else:
+            slot.out_q.put_nowait(out)
+        slot.finished = True
+        if slot.index >= 0:
+            self._slots[slot.index] = None
+            slot.index = -1
+        self._emit_events(self.allocator.free(self._seq_id(slot)))
+
     def _dispatch_decode(self, k: int, a: Dict[str, np.ndarray]):
-        """Dispatch one decode burst (shared by the scheduler and the
+        """Dispatch one full decode burst (shared by the scheduler and the
         multihost follower replay, so chain state stays symmetric).
-        Returns the UNREAD burst device array [k, B] and updates the
-        device-side token chain."""
+        Returns the UNREAD burst device array [k, B], updates the
+        device-side token chain, and persists the descriptor as the
+        device pack continuations advance from (advance=0 here: the host
+        arrays are already current)."""
         greedy = bool(np.all(np.asarray(a["temps"]) <= 0.0))
         chain = self._chain_tokens
         if chain is None:
-            chain = jnp.zeros((self.config.max_num_seqs,), jnp.int32)
+            chain = jax.device_put(
+                jnp.zeros((self.config.max_num_seqs,), jnp.int32),
+                self._desc_sharding)
+        # COMMITTED uploads: continuation bursts feed the program's own
+        # (committed) outputs back in, and a committed-vs-uncommitted
+        # split on the same avals forks the jit cache — the fork's
+        # compile then lands mid-serving (measured at 8-14s per fork on
+        # the tunneled chip)
+        sh = self._desc_sharding
+        dd = {
+            name: jax.device_put(a[name], sh)
+            for name in ("tokens", "use_chain", "positions", "tables",
+                         "ctx_lens", "seeds", "steps", "temps", "top_ks",
+                         "top_ps", "valid")
+        }
+        dd["lidx"] = (jax.device_put(a["lidx"], sh) if "lidx" in a
+                      else None)
+        return self._run_decode(k, greedy, dd, chain, advance=0)
+
+    def _dispatch_decode_cont(self, k: int, advance: int, greedy: bool):
+        """Dispatch a continuation burst from the persisted device pack —
+        zero host->device array uploads (the descriptor advances inside
+        the SAME compiled program, advance=k).  Shared by the scheduler
+        and follower replay (followers hold their own _dev_desc from
+        replaying the preceding full burst).  All lanes chain (the host
+        proved every active lane's last token is the device chain's)."""
+        dd = self._dev_desc
+        if dd.get("_all_chain") is None:
+            dd["_all_chain"] = jax.device_put(
+                jnp.ones((self.config.max_num_seqs,), bool),
+                self._desc_sharding)
+        dd = dict(dd, use_chain=dd["_all_chain"])
+        self._dev_desc = dd
+        return self._run_decode(k, greedy, dd, self._chain_tokens,
+                                advance=advance)
+
+    def _run_decode(self, k: int, greedy: bool, dd: Dict[str, Any],
+                    chain, advance: int):
+        # committed per-value device constants for the advance clock: a
+        # raw python int is an UnspecifiedValue in the jit cache key and
+        # forks the executable (see _dispatch_decode)
+        adv = self._adv_consts.get(advance)
+        if adv is None:
+            adv = self._adv_consts[advance] = jax.device_put(
+                jnp.int32(advance), self._desc_sharding)
         args = (
-            self.params, self.kv, chain,
-            jnp.asarray(a["use_chain"]), jnp.asarray(a["tokens"]),
-            jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
-            jnp.asarray(a["ctx_lens"]), jnp.asarray(a["seeds"]),
-            jnp.asarray(a["steps"]), jnp.asarray(a["temps"]),
-            jnp.asarray(a["top_ks"]), jnp.asarray(a["top_ps"]),
-            jnp.asarray(a["valid"]),
-            self.lora_bank,
-            jnp.asarray(a["lidx"]) if "lidx" in a else None,
+            self.params, self.kv, chain, dd["use_chain"], dd["tokens"],
+            dd["positions"], dd["tables"], dd["ctx_lens"], dd["seeds"],
+            dd["steps"], dd["temps"], dd["top_ks"], dd["top_ps"],
+            dd["valid"], adv,
+            self.lora_bank, dd["lidx"],
         )
-        if k > 1:
-            burst, self.kv = self._jit_decode_multi[greedy](*args)
-        else:
-            burst, self.kv = self._jit_decode[greedy](*args)
+        fn = self._jit_decode_multi[greedy] if k > 1 \
+            else self._jit_decode[greedy]
+        burst, self.kv, pos, ctx, steps = fn(*args)
+        dd["positions"], dd["ctx_lens"], dd["steps"] = pos, ctx, steps
         self._chain_tokens = burst[k - 1]
+        self._dev_desc = dd
         return burst
+
+    def _is_continuation(self, a: Dict[str, np.ndarray], active,
+                         k: int) -> bool:
+        """True when this burst is provably the pure continuation of the
+        last one: same k, same membership/tables/sampling, every lane's
+        input token available in the device chain, and positions/steps
+        exactly one advance ahead — so the device pack can evolve in
+        place.  Requiring k == prev k keeps the compiled-variant set at
+        (greedy, k) pairs the warm-up already hits; a k transition
+        (prefill interleaving) takes the full path instead of compiling a
+        fresh program mid-serving."""
+        prev = self._last_desc
+        if prev is None or self._dev_desc is None \
+                or self._chain_tokens is None or k != prev["k"]:
+            return False
+        for s in active:
+            if self._chain_owner[s.index] != (self._seq_id(s), s.epoch):
+                return False
+        m = a["valid"]
+        adv = prev["k"]
+        return (
+            np.array_equal(a["valid"], prev["valid"])
+            and ("lidx" in a) == (prev.get("lidx") is not None)
+            and np.array_equal(a["positions"][m], prev["positions"][m] + adv)
+            and np.array_equal(a["ctx_lens"][m], prev["ctx_lens"][m] + adv)
+            and np.array_equal(a["steps"][m], prev["steps"][m] + adv)
+            and np.array_equal(a["tables"][m], prev["tables"][m])
+            and np.array_equal(a["seeds"][m], prev["seeds"][m])
+            and np.array_equal(a["temps"][m], prev["temps"][m])
+            and np.array_equal(a["top_ks"][m], prev["top_ks"][m])
+            and np.array_equal(a["top_ps"][m], prev["top_ps"][m])
+            and ("lidx" not in a
+                 or np.array_equal(a["lidx"][m], prev["lidx"][m]))
+        )
 
     def _process_oldest_burst(self) -> None:
         """Read back the oldest dispatched burst and apply it: stream

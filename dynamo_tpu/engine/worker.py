@@ -208,6 +208,17 @@ class JaxEngineWorker:
             "namespace": self.namespace,
             "component": self.component,
         }
+        # guided decoding validates candidate text with the MODEL'S
+        # tokenizer (engine falls back to the byte mock only for mock
+        # cards — where the frontend uses the same mock)
+        from ..frontend.tokenizer import tokenizer_from_mdc
+
+        try:
+            self.engine.guided_codec = tokenizer_from_mdc(
+                self.tokenizer_cfg)
+        except Exception:
+            logger.warning("guided codec unavailable; guided decoding "
+                           "will use the byte fallback", exc_info=True)
         self._pull_clients = {}
         from ..disagg.device_transfer import SenderChunkRegistry
 
@@ -327,10 +338,9 @@ class JaxEngineWorker:
                 self._kvbm_index, self._kvbm_pull_client,
                 max_blocks=self.config.kvbm_remote_max_blocks,
             ).fetch_run
-        if self.engine.supports_embedding and self.mh.world == 1:
-            # multi-host slices serve generate only: embed does not ride
-            # the step broadcast, so a leader-only dispatch would hang the
-            # slice's collective schedule
+        if self.engine.supports_embedding:
+            # embed rides the step broadcast like every other collective
+            # program, so multi-host slices serve it too
             async def embed_handler(payload, ctx):
                 vec = await self.engine.embed(payload["token_ids"])
                 yield {"embedding": vec.tolist(), "dim": int(vec.shape[0])}
@@ -347,6 +357,14 @@ class JaxEngineWorker:
 
         broker.register_engine(instance_id, self.engine)
         self._broker_id = instance_id
+        if self.config.warmup and self.mh.world == 1:
+            # compile all decode variants BEFORE the model becomes
+            # discoverable, so no request ever waits on a decode compile.
+            # Multi-host slices skip it: warmup dispatches are collective
+            # programs the followers would never replay (they only run
+            # what arrives on the step stream), so a leader-side warmup
+            # would hang the slice's collective schedule.
+            await asyncio.to_thread(self.engine.warmup_decode)
         await register_model(rt, self.card, instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
         logger.info("jax engine worker %d serving %s (tp=%d)",

@@ -338,9 +338,16 @@ class SessionAffinityRouter:
                 return await self._route_inner(req, avoid)
             if not entry.bound:
                 break
-            wid = entry.worker_id
+            # bindings store TARGET ids (worker, dp_rank) so a session
+            # stuck to rank r of a dp worker keeps landing on rank r —
+            # its KV lives in that rank's cache, not "the worker's"
+            tid = entry.worker_id
+            targets = getattr(self.inner, "targets", None)
+            wid, rank = (targets.resolve(tid) if targets is not None
+                         else (tid, 0))
             if wid in self.client.instance_ids and wid not in avoid:
                 coord._count("hit")
+                req.dp_rank = rank
                 if hasattr(self.inner, "charge"):
                     # keep the KV router's load accounting truthful for
                     # placements it didn't make
@@ -367,7 +374,11 @@ class SessionAffinityRouter:
             coord.abort(sid, entry)
             return None
         coord._count("bind")
-        coord.bind(sid, entry, choice)
+        # bind the (worker, dp_rank) target the route actually picked
+        from ..router.targets import target_id
+
+        coord.bind(sid, entry, target_id(choice,
+                                         getattr(req, "dp_rank", 0)))
         self._held[req.request_id] = (sid, entry, req.session_final)
         return choice
 

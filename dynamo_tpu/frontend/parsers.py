@@ -233,3 +233,21 @@ def render_tools_preamble(tools: List[Dict[str, Any]]) -> str:
         "<args-object>}</tool_call>."
     )
     return "\n".join(lines)
+
+
+def envelope_to_tool_call(text: str):
+    """Guided tool-choice envelope {"name":..., "arguments": {...}} ->
+    OpenAI tool_call dict; None when the text isn't the envelope (the
+    caller falls back to plain content)."""
+    try:
+        obj = json.loads(text)
+        name = obj["name"]
+        args = obj.get("arguments", {})
+    except (ValueError, TypeError, KeyError):
+        return None
+    return {
+        "id": f"call_{secrets.token_hex(8)}",
+        "index": 0,
+        "type": "function",
+        "function": {"name": name, "arguments": json.dumps(args)},
+    }

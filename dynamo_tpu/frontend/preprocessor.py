@@ -102,7 +102,43 @@ class OpenAIPreprocessor:
                          "content": render_tools_preamble(tools)}
                         ] + messages
         prompt = self.render_chat(messages, tools=tools)
-        return self._build(prompt, body, media=media)
+        req = self._build(prompt, body, media=media)
+        # structural outputs (ref preprocessor.rs structural_tag / the
+        # engines' guided_json):
+        #  * response_format json_schema / json_object -> engine-side
+        #    constrained sampling (guided/json_prefix.py)
+        #  * tool_choice "required" or a named function -> the output IS
+        #    a tool-call envelope, guided by the tool's own parameter
+        #    schema; the HTTP layer wraps it as tool_calls
+        rf = body.get("response_format") or {}
+        if rf.get("type") == "json_schema":
+            req.sampling.guided_json = (
+                rf.get("json_schema", {}).get("schema")
+                or rf.get("schema") or {})
+        elif rf.get("type") == "json_object":
+            # any JSON OBJECT (arbitrary keys) — not any JSON value
+            req.sampling.guided_json = {"type": "object"}
+        choice = body.get("tool_choice")
+        forced = None
+        if tools and choice == "required":
+            forced = [t.get("function", t) for t in tools]
+        elif isinstance(choice, dict) and tools:
+            name = (choice.get("function") or {}).get("name")
+            forced = [t.get("function", t) for t in tools
+                      if t.get("function", t).get("name") == name]
+            if not forced:
+                raise ValueError(f"tool_choice names unknown tool {name!r}")
+        if forced:
+            req.sampling.guided_json = {
+                "type": "object",
+                "properties": {
+                    "name": {"enum": [f.get("name", "") for f in forced]},
+                    "arguments": (forced[0].get("parameters") or {}
+                                  if len(forced) == 1 else {}),
+                },
+            }
+            req.annotations = list(req.annotations) + ["forced_tool_call"]
+        return req
 
     def preprocess_completion(self, body: Dict[str, Any]) -> PreprocessedRequest:
         prompt = body.get("prompt", "")

@@ -600,7 +600,9 @@ class HttpService:
         # when the model card declares a reasoning parser
         from .parsers import OutputParser
 
-        parser = OutputParser.for_request(pipeline, body) if chat else None
+        forced_tool = "forced_tool_call" in (req.annotations or [])
+        parser = (OutputParser.for_request(pipeline, body)
+                  if chat and not forced_tool else None)
         include_usage = bool(
             (body.get("stream_options") or {}).get("include_usage"))
 
@@ -685,6 +687,15 @@ class HttpService:
             reasoning_parts.append(out.reasoning)
             tool_calls.extend(out.tool_calls)
         text = "".join(text_parts)
+        if chat and "forced_tool_call" in (req.annotations or []):
+            # guided tool envelope (preprocessor tool_choice): the text
+            # IS {"name":..., "arguments": {...}} — wrap as a tool call
+            from .parsers import envelope_to_tool_call
+
+            call = envelope_to_tool_call(text)
+            if call is not None:
+                tool_calls = [call]
+                text = ""
         usage = {
             "prompt_tokens": len(req.token_ids),
             "completion_tokens": ntok,
@@ -782,6 +793,11 @@ class HttpService:
         saw_tools = False
         disconnected = False
         final_finish = None
+        # forced tool_choice (guided envelope): the whole output IS one
+        # tool call — buffer it and emit a single tool_calls delta at the
+        # end instead of streaming raw JSON as content
+        forced_tool = chat and "forced_tool_call" in (req.annotations or [])
+        forced_parts: list[str] = []
         probe = _LatencyProbe(self._m_requests, model)
         try:
             async for d in pipeline.generate_deltas(req, token=token,
@@ -807,6 +823,28 @@ class HttpService:
                     saw_tools |= bool(calls)
                     if finish is not None and saw_tools:
                         finish = "tool_calls"
+                if forced_tool:
+                    forced_parts.append(text or "")
+                    if finish is not None:
+                        from .parsers import envelope_to_tool_call
+
+                        call = envelope_to_tool_call("".join(forced_parts))
+                        if call is not None:
+                            if tracker is not None:
+                                tracker.add_tool_calls([call])
+                            await resp.write(chunk(None, None, first,
+                                                   tool_calls=[call]))
+                            finish = "tool_calls"
+                        else:
+                            # not a parseable envelope: fall back to the
+                            # buffered text as one content chunk
+                            await resp.write(chunk("".join(forced_parts),
+                                                   None, first))
+                        first = False
+                        await resp.write(chunk(None, finish))
+                        final_finish = finish
+                        break
+                    continue
                 if text or reasoning or calls or finish or first:
                     if calls and tracker is not None:
                         tracker.add_tool_calls(calls)

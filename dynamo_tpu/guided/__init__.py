@@ -1,0 +1,3 @@
+from .json_prefix import JsonSchemaGuide
+
+__all__ = ["JsonSchemaGuide"]

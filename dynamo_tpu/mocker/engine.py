@@ -46,6 +46,10 @@ class MockEngineArgs:
     # tokenizer decodes verbatim), then EOS — lets frontend tests drive
     # the output parsers (tool calls / reasoning) with structured text
     canned_text: str = ""
+    # simulated data-parallel ranks: the worker runs dp_size independent
+    # engines (disjoint KV caches) and exposes each as a routing target
+    # (ref WorkerWithDpRank; per-rank publishers, vllm/main.py:379-425)
+    dp_size: int = 1
 
 
 @dataclass
@@ -62,6 +66,7 @@ class _Seq:
     disagg_prefill: bool = False   # prefill-only hop; return transfer params
     remote_prefilled: bool = False  # KV arrives via transfer; skip prefill
     rng: random.Random = field(default_factory=random.Random)
+    guided_doc: Optional[str] = None  # lazily built canonical document
 
 
 class MockEngine:
@@ -312,8 +317,20 @@ class MockEngine:
                 self._publish(res)
 
     def _next_token(self, seq: _Seq) -> int:
-        if self.args.canned_text:
-            data = self.args.canned_text.encode()
+        canned = self.args.canned_text
+        if seq.request.sampling.guided_json is not None:
+            # simulated guided decoding: emit the schema's canonical
+            # document (the real engine's constrained path is
+            # engine/core.py _guided_step; the sim keeps frontend /
+            # router tests GPU-free, like everything else here)
+            if seq.guided_doc is None:
+                from ..guided import JsonSchemaGuide
+
+                seq.guided_doc = JsonSchemaGuide(
+                    seq.request.sampling.guided_json).complete("")
+            canned = seq.guided_doc
+        if canned:
+            data = canned.encode()
             if seq.generated < len(data):
                 return 3 + data[seq.generated]  # MockTokenizer BYTE_BASE
             return self.args.eos_token_id

@@ -67,16 +67,24 @@ class MockerWorker:
         from ..runtime.discovery import new_instance_id
 
         instance_id = new_instance_id()
-        self.publisher = KvEventPublisher(
-            rt, self.namespace, self.component, worker_id=instance_id
-        )
-        self.engine = MockEngine(self.args, kv_event_publisher=self.publisher)
+        # dp ranks: one simulated engine + one event publisher per rank —
+        # each rank is a distinct routing target with its own KV cache
+        dp = max(1, self.args.dp_size)
+        self.publishers = [
+            KvEventPublisher(rt, self.namespace, self.component,
+                             worker_id=instance_id, dp_rank=r)
+            for r in range(dp)
+        ]
+        self.publisher = self.publishers[0]
+        self.engines = [MockEngine(self.args, kv_event_publisher=p)
+                        for p in self.publishers]
+        self.engine = self.engines[0]
 
         async def generate_handler(payload, ctx):
             request = PreprocessedRequest.from_dict(payload)
-            assert self.engine is not None
+            eng = self.engines[request.dp_rank % len(self.engines)]
             ntok = 0
-            async for out in self.engine.generate(request, token=ctx.token):
+            async for out in eng.generate(request, token=ctx.token):
                 ntok += len(out.token_ids)
                 yield out.to_dict()
             # trace join (same contract as the JAX engine worker)
@@ -88,8 +96,17 @@ class MockerWorker:
                     "output_tokens": ntok})
 
         async def clear_handler(payload, ctx):
-            n = await self.engine.clear_kv_blocks()
+            n = 0
+            for eng in self.engines:
+                n += await eng.clear_kv_blocks()
             yield {"cleared_blocks": n}
+
+        async def replay_handler(payload, ctx):
+            # per-rank replay rings: the router asks for a specific rank
+            r = int((payload or {}).get("dp_rank", 0))
+            pub = self.publishers[r % len(self.publishers)]
+            async for ev in pub.replay_handler(payload, ctx):
+                yield ev
 
         async def embed_handler(payload, ctx):
             # deterministic unit vector from the token ids (test double
@@ -118,7 +135,7 @@ class MockerWorker:
                 clear_handler, instance_id=instance_id
             ),
             await comp.endpoint("kv_events_replay").serve_endpoint(
-                self.publisher.replay_handler, instance_id=instance_id
+                replay_handler, instance_id=instance_id
             ),
             await comp.endpoint("embed").serve_endpoint(
                 embed_handler, instance_id=instance_id
@@ -139,12 +156,21 @@ class MockerWorker:
                 continue
             await self.runtime.event_plane.publish(subject, {
                 "worker_id": self.served.instance_id,
-                "active_seqs": self.engine.num_active_seqs,
-                "kv_usage": self.engine.kv_usage(),
+                "active_seqs": sum(e.num_active_seqs for e in self.engines),
+                "kv_usage": (sum(e.kv_usage() for e in self.engines)
+                             / len(self.engines)),
                 "kv_total_blocks": self.engine.cache.num_blocks,
+                # per-rank load: the router costs each rank separately
+                **({"dp_size": len(self.engines),
+                    "ranks": [{"dp_rank": r, "kv_usage": e.kv_usage(),
+                               "kv_total_blocks": e.cache.num_blocks}
+                              for r, e in enumerate(self.engines)]}
+                   if len(self.engines) > 1 else {}),
                 # SLA-planner inputs (planner/metrics.py differentiates)
-                "requests_total": self.engine.metrics["requests"],
-                "prompt_tokens_total": self.engine.metrics["prompt_tokens"],
+                "requests_total": sum(e.metrics["requests"]
+                                      for e in self.engines),
+                "prompt_tokens_total": sum(e.metrics["prompt_tokens"]
+                                           for e in self.engines),
                 "itl_ema_s": self.engine.itl_ema_s,
             })
 
@@ -153,8 +179,9 @@ class MockerWorker:
 
         if self._load_task is not None:
             self._load_task.cancel()
-        if self.engine is not None:
-            await self.engine.close()
+        for eng in getattr(self, "engines", []) or (
+                [self.engine] if self.engine else []):
+            await eng.close()
         if self.served is not None:
             await deregister_model(self.runtime, self.card,
                                    self.served.instance_id)

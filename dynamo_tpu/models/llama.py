@@ -94,6 +94,49 @@ def kv_cache_specs() -> tuple:
     return kv_cache_spec(), kv_cache_spec()
 
 
+def prefill_ring(
+    params: Dict[str, Any],
+    cfg: "LlamaConfig",
+    kv_cache: Tuple[jax.Array, jax.Array],
+    token_ids: jax.Array,      # [T_pad] int32 (one sequence, padded)
+    positions: jax.Array,      # [T_pad] int32, absolute positions
+    block_table: jax.Array,    # [max_blocks] int32
+    true_len: jax.Array,       # scalar int32: valid tokens
+    mesh=None,
+):
+    """Sequence-parallel COLD prefill: attention FLOPs shard over the
+    mesh's sp axis via ring attention (ops/ring_attention.py) instead of
+    running the whole O(T^2) prompt on every device — the long-context
+    path for prompts beyond the chunked-prefill buckets (SURVEY §5: the
+    reference's engines own this; here it is native).
+
+    One-shot (ctx_len=0, no prefix reuse — a partially cached long prompt
+    falls back to chunked prefill).  Causality alone isolates the padded
+    tail: valid queries only attend to j <= i < true_len, and
+    write_prompt_kv masks the padded KV writes.  Returns
+    (logits at the last valid position, updated kv_cache)."""
+    from ..ops.ring_attention import ring_attention
+
+    k_cache, v_cache = kv_cache
+    zero = jnp.int32(0)
+    x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
+    T = x.shape[0]
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
+        q, k, v = _qkv(layer, cfg, h, positions)
+        k_cache, v_cache = write_prompt_kv(
+            k_cache, v_cache, li, k, v, block_table, zero, true_len
+        )
+        attn = ring_attention(q[None], k[None], v[None], mesh,
+                              head_axis="tp")[0]
+        x = x + _attn_out(layer, attn.reshape(T, cfg.q_dim))
+        h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
+        x = x + _ffn(layer, cfg, h, valid=jnp.arange(T) < true_len)
+    last = jnp.maximum(true_len - 1, 0)
+    logits = _logits(params, cfg, x[last])
+    return logits, (k_cache, v_cache)
+
+
 PRESETS: Dict[str, LlamaConfig] = {
     # test-scale
     "tiny": LlamaConfig(),
@@ -102,6 +145,14 @@ PRESETS: Dict[str, LlamaConfig] = {
     "llama-1b": LlamaConfig(
         name="llama-1b", vocab_size=128256, d_model=2048, n_layers=16,
         n_heads=32, n_kv_heads=8, head_dim=64, ffn_dim=8192,
+        max_context=131072,
+    ),
+    # largest public-architecture config that fits ONE v5e chip (16G HBM)
+    # with a serving KV cache: ~3.2B bf16 = ~6.4G weights (Llama-3.2-3B
+    # geometry); the single-chip north-star bench model
+    "llama-3b": LlamaConfig(
+        name="llama-3b", vocab_size=128256, d_model=3072, n_layers=28,
+        n_heads=24, n_kv_heads=8, head_dim=128, ffn_dim=8192,
         max_context=131072,
     ),
     # target configs (multi-chip; shapes from the public architectures)

@@ -392,6 +392,7 @@ def load_params(
     model_path: str,
     cfg=None,
     mesh=None,
+    host_cache: bool = True,
 ) -> Dict[str, Any]:
     """Load a HF checkpoint into the matching family's params pytree.
 
@@ -399,8 +400,21 @@ def load_params(
     (per-weight streaming: host RAM holds one tensor at a time beyond the
     checkpoint mmap).  Without, arrays stay as committed jax arrays on the
     default device.
+
+    host_cache: consult/populate the tmpfs weight cache
+    (models/weight_cache.py) so a restarted worker skips the disk reload
+    and every transform — the fast-restart path (the reference covers
+    this with GMS/ModelExpress).  DYN_WEIGHT_CACHE=0 disables globally.
     """
     from jax.sharding import NamedSharding
+
+    from .weight_cache import default_cache_dir, read_cache, write_cache
+
+    cache_dir = default_cache_dir() if host_cache else None
+    if cache_dir is not None:
+        cached = read_cache(cache_dir, model_path, mesh=mesh)
+        if cached is not None:
+            return cached
 
     cfg = cfg or load_hf_config(model_path)
     rules = param_sharding_rules()
@@ -414,7 +428,12 @@ def load_params(
         return arr
 
     if isinstance(cfg, DeepseekConfig):
-        return _load_deepseek_params(model_path, cfg, put)
+        params = _load_deepseek_params(model_path, cfg, put)
+        if cache_dir is not None:
+            # MLA's de-interleaves/permutes are the most expensive
+            # transforms in the repo — exactly what the cache amortizes
+            write_cache(cache_dir, model_path, params)
+        return params
 
     norm_dt = jnp.float32
     params: Dict[str, Any] = {
@@ -499,4 +518,6 @@ def load_params(
     if missing:
         raise ValueError(f"incomplete checkpoint {model_path}: missing "
                          f"{missing[:5]}")
+    if cache_dir is not None:
+        write_cache(cache_dir, model_path, params)
     return params

@@ -26,10 +26,18 @@ def build_args() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("dynamo_tpu.planner")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--component", default="backend")
-    p.add_argument("--worker-module", required=True,
-                   help="module spawned per replica (e.g. dynamo_tpu.mocker)")
+    p.add_argument("--connector", default="subprocess",
+                   choices=["subprocess", "kubernetes"],
+                   help="EXECUTE target: subprocess fleet on this host, "
+                        "or a K8s Deployment's scale subresource")
+    p.add_argument("--worker-module",
+                   help="module spawned per replica (subprocess connector;"
+                        " e.g. dynamo_tpu.mocker)")
     p.add_argument("--worker-arg", action="append", default=[],
                    help="argument passed to each worker (repeatable)")
+    p.add_argument("--k8s-deployment",
+                   help="Deployment name to scale (kubernetes connector); "
+                        "API/namespace/token from DYN_K8S_* or in-cluster")
     p.add_argument("--min-replicas", type=int, default=1)
     p.add_argument("--max-replicas", type=int, default=8)
     p.add_argument("--target-active-per-replica", type=float, default=4.0)
@@ -51,7 +59,18 @@ async def main() -> None:
     setup_logging()
     args = build_args().parse_args()
     rt = await DistributedRuntime.detached().start()
-    connector = SubprocessConnector(args.worker_module, args.worker_arg)
+    if args.connector == "kubernetes":
+        from .connectors import KubernetesConnector
+
+        if not args.k8s_deployment:
+            raise SystemExit("--connector kubernetes needs "
+                             "--k8s-deployment")
+        connector = KubernetesConnector(args.k8s_deployment)
+    else:
+        if not args.worker_module:
+            raise SystemExit("--connector subprocess needs "
+                             "--worker-module")
+        connector = SubprocessConnector(args.worker_module, args.worker_arg)
     planner = Planner(
         rt, args.namespace, args.component, connector,
         PlannerConfig(

@@ -99,3 +99,68 @@ class SubprocessConnector(Connector):
 
     async def close(self) -> None:
         await self.scale(0)
+
+
+class KubernetesConnector(Connector):
+    """One replica == one pod of a Deployment: scaling patches the
+    Deployment's scale subresource through the API server's JSON
+    interface (no client library — same aiohttp discipline as
+    runtime/kube.py).
+
+    Ref: components/src/dynamo/planner/connectors/kubernetes.py:63 —
+    the reference's planner EXECUTE stage patches DynamoGraphDeployment
+    replica counts; here the unit is a plain Deployment (deploy/
+    manifests) so any K8s cluster works without CRDs."""
+
+    def __init__(self, deployment: str, namespace: str = "",
+                 api_url: str = "", token: str = ""):
+        from ..runtime.kube import resolve_k8s_credentials
+
+        self.deployment = deployment
+        # ONE credential/namespace resolution shared with the discovery
+        # backend (runtime/kube.py): same in-cluster namespace file, same
+        # cluster-CA TLS context
+        self.api, self.namespace, self.token, self._ssl = \
+            resolve_k8s_credentials(api_url, namespace, token)
+        self._session = None
+
+    def _http(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            headers = {}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            self._session = aiohttp.ClientSession(
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=30),
+                connector=(aiohttp.TCPConnector(ssl=self._ssl)
+                           if self._ssl is not None else None))
+        return self._session
+
+    def _scale_url(self) -> str:
+        return (f"{self.api}/apis/apps/v1/namespaces/{self.namespace}"
+                f"/deployments/{self.deployment}/scale")
+
+    async def current_replicas(self) -> int:
+        async with self._http().get(self._scale_url()) as resp:
+            resp.raise_for_status()
+            out = await resp.json()
+        return int(out.get("spec", {}).get("replicas", 0))
+
+    async def scale(self, replicas: int) -> int:
+        patch = {"spec": {"replicas": int(replicas)}}
+        async with self._http().patch(
+            self._scale_url(), json=patch,
+            headers={"Content-Type": "application/merge-patch+json"},
+        ) as resp:
+            resp.raise_for_status()
+            out = await resp.json()
+        applied = int(out.get("spec", {}).get("replicas", replicas))
+        logger.info("k8s scaled %s/%s to %d", self.namespace,
+                    self.deployment, applied)
+        return applied
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()

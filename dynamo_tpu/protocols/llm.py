@@ -27,6 +27,10 @@ class SamplingOptions:
     seed: Optional[int] = None
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    # guided decoding (ref structural outputs / guided_json): constrain
+    # output to a JSON document conforming to this schema
+    # (guided/json_prefix.py); None = unconstrained
+    guided_json: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -36,6 +40,7 @@ class SamplingOptions:
             "seed": self.seed,
             "frequency_penalty": self.frequency_penalty,
             "presence_penalty": self.presence_penalty,
+            "guided_json": self.guided_json,
         }
 
     @staticmethod
@@ -46,6 +51,7 @@ class SamplingOptions:
             top_k=d.get("top_k", 0),
             seed=d.get("seed"),
             frequency_penalty=d.get("frequency_penalty", 0.0),
+            guided_json=d.get("guided_json"),
             presence_penalty=d.get("presence_penalty", 0.0),
         )
 
@@ -102,6 +108,11 @@ class PreprocessedRequest:
     disaggregated_params: Optional[Dict[str, Any]] = None
     # annotations requested by the client (e.g. request tracing)
     annotations: List[str] = field(default_factory=list)
+    # data-parallel rank of the target engine (ref WorkerWithDpRank,
+    # selector.rs:33): set by the KV router when it picks a specific dp
+    # rank; workers with dp ranks dispatch the request to that rank's
+    # scheduler/cache
+    dp_rank: int = 0
     # multimodal items (encoder disagg, multimodal/): before the encoder
     # hop each item is a descriptor {media_hash, data_uri, insert_pos};
     # after it, {media_hash, n_tokens, embedding(bytes), shape, dtype}.
@@ -126,6 +137,7 @@ class PreprocessedRequest:
             "session_final": self.session_final,
             "disaggregated_params": self.disaggregated_params,
             "annotations": self.annotations,
+            "dp_rank": self.dp_rank,
             "multimodal": self.multimodal,
         }
 
@@ -142,6 +154,7 @@ class PreprocessedRequest:
             session_final=bool(d.get("session_final", False)),
             disaggregated_params=d.get("disaggregated_params"),
             annotations=d.get("annotations", []),
+            dp_rank=int(d.get("dp_rank", 0)),
             multimodal=d.get("multimodal"),
         )
 

@@ -28,6 +28,7 @@ from .indexer import make_indexer
 from .replica_sync import RouterReplicaSync
 from .selector import DefaultWorkerSelector, KvRouterConfig, WorkerState
 from .sequences import ActiveSequences
+from .targets import TargetMap
 
 logger = logging.getLogger(__name__)
 
@@ -61,6 +62,9 @@ class KvRouter:
             if replica_sync else None
         )
         self.states: Dict[int, WorkerState] = {}
+        # (worker, dp_rank) -> target id (ref WorkerWithDpRank): every
+        # structure below (indexer, states, sequences) is keyed by TARGET
+        self.targets = TargetMap()
         # per-worker routing observability (ref metrics.rs): a skewed
         # fleet or a dead-prefix regression shows up here first
         self._metrics = runtime.metrics.scoped(component="router")
@@ -110,7 +114,8 @@ class KvRouter:
             pass
 
     def _apply_event(self, ev: KvCacheEvent) -> None:
-        last = self.indexer.last_event_id.get(ev.worker_id)
+        tid = self.targets.observe(ev.worker_id, ev.dp_rank)
+        last = self.indexer.last_event_id.get(tid)
         # Gap in two forms: missed events mid-stream (last known, jump > 1)
         # and a router that subscribed after the worker started publishing
         # (first observed event from an unknown worker has event_id > 0 —
@@ -118,64 +123,66 @@ class KvRouter:
         # invisible to routing forever).
         expected_next = 0 if last is None else last + 1
         if (ev.event_id > expected_next
-                and ev.worker_id not in self._recovering):
+                and tid not in self._recovering):
             # recover from the worker's ring buffer (hold a strong task
             # ref — the loop only keeps weak ones)
-            self._recovering.add(ev.worker_id)
+            self._recovering.add(tid)
             task = asyncio.ensure_future(
-                self._recover(ev.worker_id, expected_next)
+                self._recover(tid, expected_next)
             )
             self._recover_tasks.add(task)
             task.add_done_callback(self._recover_tasks.discard)
-        self.indexer.last_event_id[ev.worker_id] = max(
+        self.indexer.last_event_id[tid] = max(
             ev.event_id, last if last is not None else -1
         )
         if ev.op == "stored":
-            self.indexer.apply_stored(ev.worker_id, ev.block_hashes)
+            self.indexer.apply_stored(tid, ev.block_hashes)
         elif ev.op == "removed":
-            self.indexer.apply_removed(ev.worker_id, ev.block_hashes)
+            self.indexer.apply_removed(tid, ev.block_hashes)
         elif ev.op == "cleared":
-            self.indexer.clear_worker(ev.worker_id)
+            self.indexer.clear_worker(tid)
 
-    async def _recover(self, worker_id: int, since: int) -> None:
+    async def _recover(self, tid: int, since: int) -> None:
         if self._replay_client is None:
-            self._recovering.discard(worker_id)
+            self._recovering.discard(tid)
             return
+        worker_id, dp_rank = self.targets.resolve(tid)
         try:
             events = []
             async for wire_ev in self._replay_client.generate(
-                {"since_event_id": since}, instance_id=worker_id
+                {"since_event_id": since, "dp_rank": dp_rank},
+                instance_id=worker_id,
             ):
                 events.append(KvCacheEvent.from_wire(wire_ev))
             if events and events[0].event_id > since:
                 # the worker's replay ring evicted part of the requested
                 # range: blocks stored in the lost events would stay
                 # invisible if we just applied the tail.  Reset this
-                # worker's index and rebuild from what the ring still has —
+                # target's index and rebuild from what the ring still has —
                 # a conservative miss (some resident blocks unindexed, will
                 # reappear on their next stored event) instead of a silent
                 # permanent hole presented as full recovery.
                 logger.warning(
-                    "replay ring for worker %d starts at %d > requested %d; "
+                    "replay ring for target %d starts at %d > requested %d; "
                     "resetting its index to the ring tail",
-                    worker_id, events[0].event_id, since,
+                    tid, events[0].event_id, since,
                 )
-                self.indexer.clear_worker(worker_id)
+                self.indexer.clear_worker(tid)
             for ev in events:
                 if ev.op == "stored":
-                    self.indexer.apply_stored(ev.worker_id, ev.block_hashes)
+                    self.indexer.apply_stored(tid, ev.block_hashes)
                 elif ev.op == "removed":
-                    self.indexer.apply_removed(ev.worker_id, ev.block_hashes)
+                    self.indexer.apply_removed(tid, ev.block_hashes)
                 elif ev.op == "cleared":
-                    self.indexer.clear_worker(ev.worker_id)
-            logger.info("recovered %d kv events for worker %d since %d",
-                        len(events), worker_id, since)
+                    self.indexer.clear_worker(tid)
+            logger.info("recovered %d kv events for target %d since %d",
+                        len(events), tid, since)
         except Exception:
-            logger.warning("kv event recovery failed for worker %d; "
-                           "dropping its index", worker_id, exc_info=True)
-            self.indexer.remove_worker(worker_id)
+            logger.warning("kv event recovery failed for target %d; "
+                           "dropping its index", tid, exc_info=True)
+            self.indexer.remove_worker(tid)
         finally:
-            self._recovering.discard(worker_id)
+            self._recovering.discard(tid)
 
     async def _load_loop(self) -> None:
         subject = f"load_metrics.{self.namespace}.{self.component}"
@@ -186,9 +193,23 @@ class KvRouter:
                 w = payload.get("worker_id")
                 if w is None:
                     continue
-                st = self.states.setdefault(w, WorkerState())
-                st.kv_usage = payload.get("kv_usage", 0.0)
-                st.kv_total_blocks = payload.get("kv_total_blocks", 0)
+                # per-rank load when the worker reports dp ranks
+                # (ref: per-dp_rank publishers, vllm/main.py:379-425)
+                ranks = payload.get("ranks")
+                if ranks:
+                    for r in ranks:
+                        tid = self.targets.observe(
+                            w, int(r.get("dp_rank", 0)))
+                        st = self.states.setdefault(tid, WorkerState())
+                        st.kv_usage = r.get("kv_usage",
+                                            payload.get("kv_usage", 0.0))
+                        st.kv_total_blocks = r.get(
+                            "kv_total_blocks",
+                            payload.get("kv_total_blocks", 0))
+                else:
+                    st = self.states.setdefault(w, WorkerState())
+                    st.kv_usage = payload.get("kv_usage", 0.0)
+                    st.kv_total_blocks = payload.get("kv_total_blocks", 0)
         except asyncio.CancelledError:
             pass
 
@@ -208,9 +229,10 @@ class KvRouter:
                     continue
                 for gone in self._known_workers - live:
                     logger.info("worker %d gone; purging from KV index", gone)
-                    self.indexer.remove_worker(gone)
-                    self.sequences.remove_worker(gone)
-                    self.states.pop(gone, None)
+                    for tid in self.targets.remove_worker(gone):
+                        self.indexer.remove_worker(tid)
+                        self.sequences.remove_worker(tid)
+                        self.states.pop(tid, None)
                 self._known_workers = live
         except asyncio.CancelledError:
             pass
@@ -229,6 +251,18 @@ class KvRouter:
         if request.lora_name:
             workers = self.lora_selector.filter(request.lora_name, workers,
                                                 avoid=avoid)
+        # expand workers to (worker, dp_rank) TARGETS — each rank holds a
+        # disjoint KV cache, so cost/overlap are per rank
+        # (ref WorkerWithDpRank).  `avoid` carries instance ids
+        # (migration): avoiding a worker avoids all its ranks.
+        candidates: list[int] = []
+        for w in workers:
+            candidates.extend(self.targets.targets_of(w))
+        avoid_targets = None
+        if avoid:
+            avoid_targets = set()
+            for w in avoid:
+                avoid_targets.update(self.targets.targets_of(w))
         hashes = compute_block_hashes_for_request(
             request.token_ids, self.block_size, lora_name=request.lora_name,
             media_hashes=request.media_hashes,
@@ -237,11 +271,12 @@ class KvRouter:
         request_blocks = (len(request.token_ids) + self.block_size - 1) \
             // self.block_size
         # refresh decode-load estimates from the slot manager
-        for w in workers:
-            st = self.states.setdefault(w, WorkerState())
-            st.active_blocks = self.sequences.active_blocks(w)
+        for t in candidates:
+            st = self.states.setdefault(t, WorkerState())
+            st.active_blocks = self.sequences.active_blocks(t)
         choice = self.selector.select(
-            workers, request_blocks, overlaps, self.states, avoid=avoid
+            candidates, request_blocks, overlaps, self.states,
+            avoid=avoid_targets,
         )
         if choice is not None:
             blocks = request_blocks + (request.stop.max_tokens
@@ -256,29 +291,37 @@ class KvRouter:
             self._metrics.inc("dynamo_router_routed_requests_total",
                               worker=str(choice))
             self._metrics.observe("dynamo_router_overlap_blocks", overlap)
-        else:
-            self._metrics.inc("dynamo_router_no_worker_total")
-        return choice
+            # the wire needs the instance; the engine needs the rank
+            worker_id, dp_rank = self.targets.resolve(choice)
+            request.dp_rank = dp_rank
+            return worker_id
+        self._metrics.inc("dynamo_router_no_worker_total")
+        return None
 
     def charge(self, request: PreprocessedRequest, worker_id: int) -> None:
         """Record a placement decided outside this router (session
         affinity, explicit backend_instance_id) so the worker's load
         accounting stays truthful for subsequent picks."""
+        from .targets import target_id
+
+        # account under the actual (worker, dp_rank) target — a session
+        # pinned to rank r must charge rank r, not rank 0
+        tid = target_id(worker_id, getattr(request, "dp_rank", 0))
         hashes = compute_block_hashes_for_request(
             request.token_ids, self.block_size, lora_name=request.lora_name,
             media_hashes=request.media_hashes,
         )
-        overlap = self.indexer.find_matches(hashes).get(worker_id, 0)
+        overlap = self.indexer.find_matches(hashes).get(tid, 0)
         blocks = ((len(request.token_ids) + self.block_size - 1)
                   // self.block_size
                   + request.stop.max_tokens // self.block_size)
-        self.sequences.add_request(request.request_id, worker_id, blocks,
+        self.sequences.add_request(request.request_id, tid, blocks,
                                    overlap)
         if self.sync is not None:
-            self.sync.publish_add(request.request_id, worker_id, blocks,
+            self.sync.publish_add(request.request_id, tid, blocks,
                                   overlap)
         self._metrics.inc("dynamo_router_routed_requests_total",
-                          worker=str(worker_id))
+                          worker=str(tid))
 
     def mark_prefill_completed(self, request_id: str) -> None:
         self.sequences.mark_prefill_completed(request_id)

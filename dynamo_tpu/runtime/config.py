@@ -34,7 +34,7 @@ def env_truthy(name: str, default: bool = False) -> bool:
 @dataclass
 class RuntimeConfig:
     # discovery plane (ref: docs/design-docs/distributed-runtime.md:40-48)
-    discovery_backend: str = "mem"  # mem | file | etcd
+    discovery_backend: str = "mem"  # mem | file | etcd | kubernetes
     discovery_path: str = ""  # root dir for the file backend
     etcd_endpoint: str = ""   # etcd v3 JSON-gateway URL (etcd backend)
     lease_ttl_s: float = 5.0

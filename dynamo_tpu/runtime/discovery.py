@@ -378,4 +378,10 @@ def make_discovery(backend: str, *, path: str = "", ttl_s: float = 5.0,
 
         return EtcdDiscovery(etcd_endpoint or "http://127.0.0.1:2379",
                              ttl_s=ttl_s)
+    if backend == "kubernetes":
+        from .kube import KubeDiscovery
+
+        # api/namespace/token resolve from DYN_K8S_* or the in-cluster
+        # service account (runtime/kube.py)
+        return KubeDiscovery(cluster_id=cluster_id, ttl_s=ttl_s)
     raise ValueError(f"unknown discovery backend: {backend}")

@@ -12,6 +12,10 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# tests write throwaway checkpoints under tmp paths; populating the global
+# tmpfs weight cache for them would grow /dev/shm forever (explicit cache
+# tests point DYN_WEIGHT_CACHE_DIR at a tmp dir instead)
+os.environ.setdefault("DYN_WEIGHT_CACHE", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

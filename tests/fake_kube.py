@@ -1,0 +1,166 @@
+"""In-process fake of the Kubernetes API server's Lease + scale subset.
+
+Serves just enough of the JSON API for KubeDiscovery (coordination.k8s.io
+Leases: create/patch/delete/list/watch) and the planner's
+KubernetesConnector (apps/v1 Deployment scale subresource) — the same
+role tests/fake_etcd.py plays for the etcd backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+from typing import Any, Dict, List
+
+from aiohttp import web
+
+LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+
+class FakeKubeApiServer:
+    def __init__(self):
+        self.leases: Dict[str, Dict[str, Any]] = {}  # name -> object
+        self.deployments: Dict[str, Dict[str, Any]] = {}  # name -> {replicas}
+        self.rv = 0
+        self._watchers: List[asyncio.Queue] = []
+        self._runner = None
+        self.endpoint = ""
+        # test hooks
+        self.scale_calls: List[tuple] = []
+
+    def _bump(self) -> str:
+        self.rv += 1
+        return str(self.rv)
+
+    def _notify(self, etype: str, obj: Dict[str, Any]) -> None:
+        ev = {"type": etype, "object": copy.deepcopy(obj)}
+        for q in list(self._watchers):
+            q.put_nowait(ev)
+
+    # -- lease handlers ---------------------------------------------------
+
+    async def h_list_or_watch(self, request: web.Request):
+        if request.query.get("watch") == "true":
+            return await self._h_watch(request)
+        sel = request.query.get("labelSelector", "")
+        items = []
+        for obj in self.leases.values():
+            if sel and "=" in sel:
+                k, v = sel.split("=", 1)
+                if (obj["metadata"].get("labels") or {}).get(k) != v:
+                    continue
+            items.append(copy.deepcopy(obj))
+        return web.json_response({
+            "kind": "LeaseList", "items": items,
+            "metadata": {"resourceVersion": str(self.rv)},
+        })
+
+    async def _h_watch(self, request: web.Request):
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        q: asyncio.Queue = asyncio.Queue()
+        self._watchers.append(q)
+        try:
+            while True:
+                ev = await q.get()
+                await resp.write(json.dumps(ev).encode() + b"\n")
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            self._watchers.remove(q)
+        return resp
+
+    async def h_create(self, request: web.Request):
+        body = await request.json()
+        name = body["metadata"]["name"]
+        if name in self.leases:
+            return web.json_response(
+                {"kind": "Status", "code": 409, "reason": "AlreadyExists"},
+                status=409)
+        body["metadata"]["resourceVersion"] = self._bump()
+        self.leases[name] = body
+        self._notify("ADDED", body)
+        return web.json_response(body, status=201)
+
+    async def h_patch(self, request: web.Request):
+        name = request.match_info["name"]
+        obj = self.leases.get(name)
+        if obj is None:
+            return web.json_response({"kind": "Status", "code": 404},
+                                     status=404)
+        patch = await request.json()
+
+        def merge(dst, src):
+            for k, v in src.items():
+                if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = v
+
+        merge(obj, patch)
+        obj["metadata"]["resourceVersion"] = self._bump()
+        self._notify("MODIFIED", obj)
+        return web.json_response(obj)
+
+    async def h_delete(self, request: web.Request):
+        name = request.match_info["name"]
+        obj = self.leases.pop(name, None)
+        if obj is None:
+            return web.json_response({"kind": "Status", "code": 404},
+                                     status=404)
+        self._bump()
+        self._notify("DELETED", obj)
+        return web.json_response({"kind": "Status", "status": "Success"})
+
+    # -- deployment scale (planner connector) -----------------------------
+
+    async def h_get_scale(self, request: web.Request):
+        name = request.match_info["name"]
+        dep = self.deployments.setdefault(name, {"replicas": 1})
+        return web.json_response({
+            "kind": "Scale",
+            "metadata": {"name": name,
+                         "namespace": request.match_info["ns"]},
+            "spec": {"replicas": dep["replicas"]},
+            "status": {"replicas": dep["replicas"]},
+        })
+
+    async def h_patch_scale(self, request: web.Request):
+        name = request.match_info["name"]
+        body = await request.json()
+        n = int(body.get("spec", {}).get("replicas", 0))
+        dep = self.deployments.setdefault(name, {"replicas": 1})
+        dep["replicas"] = n
+        self.scale_calls.append((name, n))
+        return web.json_response({
+            "kind": "Scale", "metadata": {"name": name},
+            "spec": {"replicas": n}, "status": {"replicas": n},
+        })
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> "FakeKubeApiServer":
+        app = web.Application()
+        base = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+        app.router.add_get(base, self.h_list_or_watch)
+        app.router.add_post(base, self.h_create)
+        app.router.add_patch(base + "/{name}", self.h_patch)
+        app.router.add_delete(base + "/{name}", self.h_delete)
+        dep = "/apis/apps/v1/namespaces/{ns}/deployments/{name}/scale"
+        app.router.add_get(dep, self.h_get_scale)
+        app.router.add_patch(dep, self.h_patch_scale)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = self._runner.addresses[0][1]
+        self.endpoint = f"http://127.0.0.1:{port}"
+        return self
+
+    async def close(self) -> None:
+        for q in list(self._watchers):
+            q.put_nowait({"type": "BOOKMARK", "object": {}})
+        if self._runner is not None:
+            await self._runner.cleanup()

@@ -426,3 +426,73 @@ async def test_concurrent_prefill_batched_and_correct():
     # 4×12 prompt tokens fit one 64-token budget: batched prefill must not
     # take one step per sequence (allow slack for admission raciness)
     assert steps < 4, f"prefill serialized: {steps} steps for 4 arrivals"
+
+
+async def test_continuation_bursts_engage_and_match_full_dispatch():
+    """Steady-state decode takes the device-resident continuation path
+    (zero per-burst uploads); its token streams must be identical to the
+    always-full-dispatch path for greedy AND sampled requests, and the
+    path must disengage cleanly around membership changes (a second
+    request arriving mid-decode)."""
+
+    async def run(force_full, rid_tag):
+        # block_size > k * a few bursts, so tables don't grow every burst
+        # (growth forces a full dispatch by design)
+        eng = engine(decode_fused_steps=4, max_num_seqs=2, block_size=16,
+                     prefill_buckets=(16, 32))
+        if force_full:
+            eng._is_continuation = lambda a, active, k: False
+        r1 = PreprocessedRequest(
+            token_ids=list(range(7, 20)), request_id=f"c1-{rid_tag}",
+            sampling=SamplingOptions(temperature=0.9, seed=5),
+            stop=StopConditions(max_tokens=24, ignore_eos=True),
+        )
+        r2 = greedy_req(list(range(40, 49)), 16, f"c2-{rid_tag}")
+
+        async def delayed():
+            await asyncio.sleep(0.25)  # arrive mid-decode of r1
+            return await collect(eng, r2)
+
+        t2 = asyncio.create_task(delayed())
+        toks1 = await collect(eng, r1)
+        toks2 = await t2
+        bursts = eng.metrics.get("cont_bursts", 0)
+        await eng.close()
+        return toks1, toks2, bursts
+
+    full1, full2, b_full = await run(True, "full")
+    cont1, cont2, b_cont = await run(False, "cont")
+    assert b_full == 0
+    assert b_cont >= 2, "continuation path never engaged"
+    assert cont1 == full1, "sampled stream diverged on continuation path"
+    assert cont2 == full2, "greedy stream diverged on continuation path"
+
+
+async def test_ring_attention_prefill_long_prompt_matches_chunked():
+    """Long-context path: a prompt beyond the largest prefill bucket on
+    an sp=2 mesh takes ONE sequence-parallel ring-attention program and
+    must produce the same greedy continuation as the chunked path on an
+    sp=1 engine (exactness of ops/ring_attention.py composed with the
+    paged cache + sampler)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    base = dict(model_config=FP32, block_size=4, num_blocks=128,
+                max_blocks_per_seq=32, max_num_seqs=2,
+                prefill_buckets=(8, 16), seed=7)
+    prompt = list(range(1, 41))  # 40 tokens > largest bucket (16)
+
+    chunked = JaxEngine(EngineConfig(**base))
+    expect = await collect(chunked, greedy_req(prompt, 5, "chunked"))
+    await chunked.close()
+
+    eng = JaxEngine(EngineConfig(sp=2, **base))
+    toks = await collect(eng, greedy_req(prompt, 5, "ring"))
+    assert eng.metrics.get("ring_prefills", 0) == 1, \
+        "long prompt did not take the ring-attention path"
+    assert toks == expect, "ring prefill continuation diverged"
+
+    # short prompts stay on the (cheaper) chunked path
+    toks2 = await collect(eng, greedy_req(list(range(50, 60)), 3, "short"))
+    assert eng.metrics.get("ring_prefills", 0) == 1
+    assert len(toks2) == 3
+    await eng.close()

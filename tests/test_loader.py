@@ -11,6 +11,7 @@ components/src/dynamo/vllm/main.py:114).
 import json
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -329,3 +330,84 @@ def test_mixtral_prefill_matches_hf_logits(tiny_mixtral_checkpoint):
     np.testing.assert_allclose(
         np.asarray(logits), ref[-1], rtol=3e-4, atol=3e-4
     )
+
+
+# --------------------------- host weight cache -----------------------------
+
+
+def test_weight_cache_restores_without_checkpoint(tiny_checkpoint,
+                                                  tmp_path, monkeypatch):
+    """Fast restart: the first load populates the tmpfs cache; a second
+    load must rebuild the identical pytree FROM the cache alone — proven
+    by deleting the safetensors before the reload (the reference covers
+    this role with GMS/ModelExpress, README.md:79)."""
+    import shutil
+
+    from dynamo_tpu.models.loader import load_hf_config, load_params
+    from dynamo_tpu.models.weight_cache import clear_cache
+
+    src, _ = tiny_checkpoint
+    path = str(tmp_path / "ckpt")
+    shutil.copytree(src, path)
+    cache = str(tmp_path / "wcache")
+    monkeypatch.setenv("DYN_WEIGHT_CACHE_DIR", cache)
+    monkeypatch.delenv("DYN_WEIGHT_CACHE", raising=False)
+
+    p1 = load_params(path)
+    assert os.path.isdir(cache)
+
+    # remove the weights; keep the fingerprint inputs (names/sizes/mtimes
+    # are recorded at write time, so the check must pass without re-stat
+    # of the .safetensors? -> fingerprint includes them; keep file stats
+    # by moving content away but restoring the entry is cheating — the
+    # honest simulation is a reload in a NEW process with the checkpoint
+    # intact; here we prove no safetensors BYTES are read by truncating
+    # the tensor file after stashing its stat
+    st_file = next(f for f in os.listdir(path)
+                   if f.endswith(".safetensors"))
+    full = os.path.join(path, st_file)
+    st = os.stat(full)
+    with open(full, "r+b") as f:  # corrupt the payload, keep the size
+        f.seek(8)
+        f.write(b"\xff" * 8)
+    os.utime(full, (st.st_atime, st.st_mtime))
+
+    p2 = load_params(path)
+    flat1 = jax.tree_util.tree_leaves(p1)
+    flat2 = jax.tree_util.tree_leaves(p2)
+    assert len(flat1) == len(flat2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # a changed checkpoint (new mtime) must MISS and reload from disk
+    os.utime(full, (st.st_atime, st.st_mtime + 60))
+    from dynamo_tpu.models.weight_cache import read_cache
+
+    assert read_cache(cache, path) is None  # stale fingerprint
+
+    clear_cache(cache)
+    assert not os.path.isdir(cache)
+
+
+def test_weight_cache_read_resharpens_to_mesh(tiny_checkpoint, tmp_path,
+                                              monkeypatch):
+    """A restarted worker may come back with a different tp: cached
+    tensors re-derive their NamedSharding from the same rules the loader
+    uses."""
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.models.loader import load_params
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    src, _ = tiny_checkpoint
+    cache = str(tmp_path / "wcache2")
+    monkeypatch.setenv("DYN_WEIGHT_CACHE_DIR", cache)
+    monkeypatch.delenv("DYN_WEIGHT_CACHE", raising=False)
+
+    p1 = load_params(src)  # writes cache (no mesh)
+    mesh = make_mesh(MeshConfig(dp=1, tp=2), devices=jax.devices()[:2])
+    p2 = load_params(src, mesh=mesh)  # cache hit, sharded read
+    wq = p2["layers"][0]["wq"]
+    assert len(wq.sharding.device_set) == 2
+    np.testing.assert_array_equal(np.asarray(p1["layers"][0]["wq"]),
+                                  np.asarray(wq))

@@ -249,3 +249,63 @@ async def test_multihost_disagg_north_star():
     for w in (p_leader, p_follower, d_leader, d_follower):
         await w.close()
     await rt.shutdown()
+
+
+async def test_multihost_lora_and_embed_compose(tmp_path):
+    """Round-3 composition holes closed: an adapter request's bank write
+    and an embed dispatch both ride the step stream, so a world-2 slice
+    serves them with the follower's adapter bank AND KV bit-identical to
+    the leader's (a one-sided bank would compile a different program and
+    desynchronize the collective schedule)."""
+    from test_lora import write_peft_adapter
+
+    rt = await fresh_runtime().start()
+    write_peft_adapter(str(tmp_path), "style-a", FP32, rank=2, alpha=2,
+                       seed=11)
+    ecfg = dict(model_config=FP32, block_size=4, num_blocks=32,
+                max_blocks_per_seq=8, max_num_seqs=2,
+                prefill_buckets=(8, 16), seed=5,
+                lora_max_adapters=2, lora_rank=4, lora_dir=str(tmp_path))
+
+    follower = await JaxEngineWorker(
+        rt, EngineConfig(**ecfg), mh=MultihostContext(rank=1, world=2),
+    ).start()
+    leader = await JaxEngineWorker(
+        rt, EngineConfig(**ecfg), mh=MultihostContext(rank=0, world=2),
+    ).start()
+
+    # adapter request: triggers a lazy bank load on the leader, whose
+    # write must reach the follower before its prefill replay needs it
+    req = PreprocessedRequest(
+        token_ids=list(range(3, 17)), request_id="mh-lora",
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=5, ignore_eos=True),
+        lora_name="style-a",
+    )
+    toks = []
+    async for out in leader.engine.generate(req):
+        toks.extend(out.token_ids)
+    assert len(toks) == 5
+
+    await _wait_kv_equal(leader, follower)
+    for key in leader.engine.lora_bank:
+        np.testing.assert_array_equal(
+            np.asarray(leader.engine.lora_bank[key]),
+            np.asarray(follower.engine.lora_bank[key]),
+            err_msg=f"adapter bank diverged at {key}")
+
+    # embed dispatch broadcasts (the follower executes the same program;
+    # a leader-only dispatch would hang a real collective slice) and the
+    # leader's pooled vector equals a single-engine oracle's
+    vec = await leader.engine.embed(list(range(5, 15)))
+    from dynamo_tpu.engine import JaxEngine
+
+    oracle = JaxEngine(EngineConfig(**{k: v for k, v in ecfg.items()
+                                       if not k.startswith("lora")}))
+    ovec = await oracle.embed(list(range(5, 15)))
+    await oracle.close()
+    np.testing.assert_allclose(vec, ovec, rtol=1e-5, atol=1e-5)
+
+    await leader.close()
+    await follower.close()
+    await rt.shutdown()

@@ -358,3 +358,82 @@ def test_selector_tiebreak_not_herded():
             sel.select(workers, 4, {}, {}) for _ in range(64)
         ])
     assert seqs[0] != seqs[1], "replicas picked identical tie-break sequences"
+
+
+async def test_dp_ranks_are_distinct_routing_targets():
+    """One worker with dp_size=2: the router must treat each rank as its
+    own target — a warmed prefix routes repeats to the SAME rank (overlap
+    credit is per rank, the caches are disjoint), and a cold request under
+    load lands on the other rank (ref WorkerWithDpRank, selector.rs:33)."""
+    from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+    from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.router.kv_router import KvRouter
+    from dynamo_tpu.router.targets import target_id
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex
+    ).start()
+    args = MockEngineArgs(model_name="m", block_size=4, dp_size=2,
+                          base_step_s=0.0005, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0)
+    w = await MockerWorker(rt, args).start()
+    wid = w.served.instance_id
+    comp = rt.namespace("dynamo").component("mocker")
+    client = await comp.endpoint("generate").client().start()
+    router = await KvRouter(rt, "dynamo", "mocker", client,
+                            block_size=4).start()
+    await client.wait_for_instances()
+    # both ranks visible as targets (load metrics carry per-rank state)
+    for _ in range(200):
+        if len(router.targets.targets_of(wid)) == 2:
+            break
+        await asyncio.sleep(0.02)
+    assert len(router.targets.targets_of(wid)) == 2
+
+    async def serve(req):
+        picked = await router.pick(req)
+        assert picked == wid
+        async for item in client.generate(req.to_dict(),
+                                          instance_id=picked):
+            pass
+        router.complete(req.request_id)
+        return req.dp_rank
+
+    # warm a prefix: whatever rank it lands on must attract the repeat
+    prompt = list(range(64))
+    r1 = await serve(PreprocessedRequest(
+        token_ids=prompt, request_id="a1",
+        stop=StopConditions(max_tokens=4, ignore_eos=True)))
+    # wait for the stored events of that rank's engine to index
+    tid = target_id(wid, r1)
+    for _ in range(200):
+        if router.indexer.find_matches(
+                __import__("dynamo_tpu.tokens", fromlist=["x"])
+                .compute_block_hashes_for_request(prompt, 4)).get(tid):
+            break
+        await asyncio.sleep(0.02)
+    r2 = await serve(PreprocessedRequest(
+        token_ids=prompt, request_id="a2",
+        stop=StopConditions(max_tokens=4, ignore_eos=True)))
+    assert r2 == r1, "repeat did not follow its rank's warm prefix"
+
+    # distinct prompts spread across ranks (load balancing over targets)
+    ranks = set()
+    for i in range(6):
+        ranks.add(await serve(PreprocessedRequest(
+            token_ids=list(range(100 + 40 * i, 140 + 40 * i)),
+            request_id=f"b{i}",
+            stop=StopConditions(max_tokens=4, ignore_eos=True))))
+    assert ranks == {0, 1}, f"cold requests never spread: {ranks}"
+
+    # each rank's engine actually served requests (the worker dispatched
+    # by request.dp_rank)
+    served = [e.metrics["requests"] for e in w.engines]
+    assert all(n > 0 for n in served), served
+
+    await router.close()
+    await client.close()
+    await w.close()
+    await rt.shutdown()
