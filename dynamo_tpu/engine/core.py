@@ -531,6 +531,13 @@ class JaxEngine:
                 jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
                 jnp.asarray(a["ctx_lens"]), jnp.asarray(a["valid"]),
             )
+        elif kind == "decode_topk_wide":
+            # widened-M retry: the position's KV rewrite is value-identical
+            _, _, self.kv = self._topk_wide_jit()(
+                self.params, self.kv, jnp.asarray(a["tokens"]),
+                jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
+                jnp.asarray(a["ctx_lens"]), jnp.asarray(a["valid"]),
+            )
         elif kind == "prefill_ring":
             _, self.kv = self._jit_prefill_ring(
                 self.params, self.kv, jnp.asarray(a["toks"]),
@@ -1690,6 +1697,18 @@ class JaxEngine:
         slot.pulling = False
         self._commit_full_blocks(slot)
         slot.first_token_t = time.monotonic()
+        if slot.guide is not None:
+            # constrained output served via disagg: the prefill worker
+            # sampled its first token UNCONSTRAINED (it parks before the
+            # guided branch runs), so pushing it would stream a stray
+            # token ahead of the JSON document.  Mirror the aggregated
+            # guided branch instead: rewind to the last prompt position
+            # and let _guided_step re-derive the first token under the
+            # constraint (the position's KV rewrite is value-identical).
+            self.metrics["cache_hit_tokens"] += prompt_len
+            slot.ctx_len = prompt_len - 1
+            slot.last_token = slot.seq.tokens[prompt_len - 1]
+            return
         if first is None:
             # transfer metadata lacked the first token: recompute from the
             # last prompt position (cache already holds prompt[:-1])
@@ -1928,6 +1947,7 @@ class JaxEngine:
         self._inflight.append({"burst": burst, "k": k, "lanes": lanes})
 
     GUIDED_TOPM = 32
+    GUIDED_TOPM_WIDE = 256
 
     @staticmethod
     def _decode_topk_impl(family, model_cfg, mesh, m, params, kv, tokens,
@@ -1952,6 +1972,18 @@ class JaxEngine:
                 donate_argnums=(1,),
             )
         return self._jit_decode_topk
+
+    def _topk_wide_jit(self):
+        """Widened-M retry program (GUIDED_TOPM_WIDE candidates): compiled
+        lazily on the first time a guided slot's top-M set has no valid
+        continuation, before giving up and force-closing the document."""
+        if getattr(self, "_jit_decode_topk_wide", None) is None:
+            self._jit_decode_topk_wide = jax.jit(
+                partial(self._decode_topk_impl, self.family,
+                        self.model_cfg, self.mesh, self.GUIDED_TOPM_WIDE),
+                donate_argnums=(1,),
+            )
+        return self._jit_decode_topk_wide
 
     def _guided_codec(self):
         """Token<->text codec for guided decoding; workers install the
@@ -2022,33 +2054,49 @@ class JaxEngine:
                 jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
                 jnp.asarray(a["ctx_lens"]), jnp.asarray(a["valid"]),
             )
-            cand_ids = np.asarray(ids[i])
-            cand_logits = np.asarray(vals[i])
             slot.ctx_len += 1  # this step's KV write is in the cache
             s = slot.request.sampling
-            if s.temperature <= 0.0:
-                order = np.argsort(-cand_logits)
-            else:
-                g = np.random.default_rng(
-                    (slot.sampling_seed + slot.generated)
-                    & 0xFFFFFFFF).gumbel(size=cand_logits.shape)
-                order = np.argsort(-(cand_logits / s.temperature + g))
             text = codec.decode(slot.guided_out)
-            chosen = None
-            for j in order:
-                tok = int(cand_ids[j])
-                if tok in self.eos_ids:
-                    if slot.guide.done(text):
-                        chosen = ("eos", tok)
-                        break
-                    continue
-                if slot.guide.ok(codec.decode(slot.guided_out + [tok])):
-                    chosen = ("tok", tok)
-                    break
+
+            def choose(cand_ids, cand_logits):
+                if s.temperature <= 0.0:
+                    order = np.argsort(-cand_logits)
+                else:
+                    g = np.random.default_rng(
+                        (slot.sampling_seed + slot.generated)
+                        & 0xFFFFFFFF).gumbel(size=cand_logits.shape)
+                    order = np.argsort(-(cand_logits / s.temperature + g))
+                for j in order:
+                    tok = int(cand_ids[j])
+                    if tok in self.eos_ids:
+                        if slot.guide.done(text):
+                            return ("eos", tok)
+                        continue
+                    if slot.guide.ok(codec.decode(slot.guided_out + [tok])):
+                        return ("tok", tok)
+                return None
+
+            chosen = choose(np.asarray(ids[i]), np.asarray(vals[i]))
             if chosen is None:
-                # nothing in the candidate set extends the document:
-                # close it canonically
-                self._guided_finish(slot, codec)
+                # nothing in the top-M set extends the document: retry
+                # once with a widened candidate set before giving up —
+                # an uncooperative model may still have a valid token in
+                # the tail of its distribution (the step re-runs the
+                # same position; its KV rewrite is value-identical)
+                self.metrics["guided_widened_retries"] = \
+                    self.metrics.get("guided_widened_retries", 0) + 1
+                if self.step_sink is not None:
+                    self.step_sink("decode_topk_wide", a)
+                wids, wvals, self.kv = self._topk_wide_jit()(
+                    self.params, self.kv, jnp.asarray(a["tokens"]),
+                    jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
+                    jnp.asarray(a["ctx_lens"]), jnp.asarray(a["valid"]),
+                )
+                chosen = choose(np.asarray(wids[i]), np.asarray(wvals[i]))
+            if chosen is None:
+                # even the widened set has no valid continuation: close
+                # the document canonically (and say so in the response)
+                self._guided_finish(slot, codec, forced=True)
                 continue
             kind, tok = chosen
             if kind == "eos":
@@ -2062,7 +2110,7 @@ class JaxEngine:
                 # budget exhausted mid-document: schema validity beats
                 # the token budget — close canonically (a few tokens
                 # over) instead of emitting truncated invalid JSON
-                self._guided_finish(slot, codec)
+                self._guided_finish(slot, codec, forced=True)
 
     def _guided_emit(self, slot: _Slot, tok: int,
                      finish: Optional[str]) -> None:
@@ -2092,9 +2140,17 @@ class JaxEngine:
                 slot.index = -1
             self._emit_events(self.allocator.free(self._seq_id(slot)))
 
-    def _guided_finish(self, slot: _Slot, codec) -> None:
+    def _guided_finish(self, slot: _Slot, codec,
+                       forced: bool = False) -> None:
         """Emit the canonical completion closing the document and finish
-        the stream."""
+        the stream.  A non-empty completion means the engine, not the
+        model, wrote the document's tail — surfaced per request in the
+        final chunk's metrics (`guided_forced_close_tokens`) so clients
+        can tell schema-valid-but-model-independent output from a real
+        completion (the reference's token-mask approach cannot emit an
+        invalid token in the first place; the top-M rescoring design
+        trades that guarantee for TPU-side simplicity and must report
+        when the trade bites)."""
         text = codec.decode(slot.guided_out)
         try:
             completion = slot.guide.complete(text)
@@ -2102,10 +2158,13 @@ class JaxEngine:
             completion = ""
         toks = codec.encode(completion) if completion else []
         slot.guided_out.extend(toks)
-        if toks:
+        metrics = None
+        if toks or forced:
             self.metrics["guided_forced_closes"] = \
                 self.metrics.get("guided_forced_closes", 0) + 1
-        out = LLMEngineOutput(token_ids=list(toks), finish_reason="stop")
+            metrics = {"guided_forced_close_tokens": len(toks)}
+        out = LLMEngineOutput(token_ids=list(toks), finish_reason="stop",
+                              metrics=metrics)
         if self._loop_ref is not None:
             self._loop_ref.call_soon_threadsafe(slot.out_q.put_nowait, out)
         else:

@@ -116,3 +116,13 @@ class ObjectStorePool:
                         yield int(name, 16)
                     except ValueError:
                         continue
+                elif len(name) == 16 and ".tmp" not in name:
+                    # pre-128-bit-key blobs (16 hex chars): never indexed
+                    # under the widened naming, so without this they would
+                    # sit unindexed and unevicted forever — an unbounded
+                    # disk leak in any store populated before the upgrade
+                    try:
+                        int(name, 16)  # only reap actual legacy keys
+                        os.unlink(os.path.join(d, name))
+                    except (ValueError, OSError):
+                        pass

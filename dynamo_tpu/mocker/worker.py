@@ -154,12 +154,23 @@ class MockerWorker:
             await asyncio.sleep(0.25)
             if self.engine is None or self.served is None:
                 continue
+            # cross-rank ITL: weight each engine's EMA by its active
+            # sequences (an idle rank's stale EMA must not drag the
+            # worker-level signal the SLA planner consumes); totals SUM
+            # across ranks — each rank owns its own KV pool
+            weights = [e.num_active_seqs for e in self.engines]
+            if not any(weights):
+                weights = [1] * len(self.engines)
+            itl = sum(w * e.itl_ema_s
+                      for w, e in zip(weights, self.engines)) \
+                / sum(weights)
             await self.runtime.event_plane.publish(subject, {
                 "worker_id": self.served.instance_id,
                 "active_seqs": sum(e.num_active_seqs for e in self.engines),
                 "kv_usage": (sum(e.kv_usage() for e in self.engines)
                              / len(self.engines)),
-                "kv_total_blocks": self.engine.cache.num_blocks,
+                "kv_total_blocks": sum(e.cache.num_blocks
+                                       for e in self.engines),
                 # per-rank load: the router costs each rank separately
                 **({"dp_size": len(self.engines),
                     "ranks": [{"dp_rank": r, "kv_usage": e.kv_usage(),
@@ -171,7 +182,7 @@ class MockerWorker:
                                       for e in self.engines),
                 "prompt_tokens_total": sum(e.metrics["prompt_tokens"]
                                            for e in self.engines),
-                "itl_ema_s": self.engine.itl_ema_s,
+                "itl_ema_s": itl,
             })
 
     async def close(self) -> None:

@@ -1,0 +1,24 @@
+"""Deployment operator: declarative graph spec -> reconciled Deployments.
+
+Ref: deploy/operator/internal/controller/dynamographdeployment_controller.go
+and api/v1beta1/dynamographdeployment_types.go:181 — the reference ships a
+Go kubebuilder operator whose DynamoGraphDeployment CRD describes a whole
+serving graph (frontend + workers + planner) and whose controller
+reconciles it into component Deployments with rolling updates.
+
+This is the CRD-free redesign: the graph spec lives in a ConfigMap
+(`dynamo.dev/graph: "1"`-labeled), so any cluster works without CRD
+install rights, and a Python reconcile loop (`python -m
+dynamo_tpu.operator`) renders the spec into plain apps/v1 Deployments —
+the same objects deploy/*.yaml hand-write — and keeps them converged:
+create on add, merge-patch on drift (image/replicas/args/env roll pods
+via the Deployment's own rolling-update machinery), delete on removal.
+Scale-subresource writes from the planner's KubernetesConnector are
+preserved on spec-unrelated reconciles (replicas drift is only corrected
+when the spec's own replica count changed).
+"""
+
+from .spec import GraphSpec, render_deployments
+from .reconciler import GraphOperator
+
+__all__ = ["GraphSpec", "render_deployments", "GraphOperator"]

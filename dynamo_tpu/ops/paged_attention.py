@@ -289,11 +289,17 @@ def paged_attention_decode(
     """
     tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
     if impl == "auto":
-        # the compiled kernel needs lane-aligned blocks (bs % 128); smaller
-        # block sizes (tests, CPU configs) take the jnp path
-        bs = k_cache.shape[4]
-        impl = ("pallas" if jax.default_backend() == "tpu"
-                and bs % 128 == 0 else "jnp")
+        # "auto" = the XLA gather path.  Measured on v5e (round 5,
+        # benchmarks/bench_decode_phases.py, llama-3b B=8 ctx=2048): the
+        # full decode step runs 14.2 ms with this path vs 17.1 ms with
+        # the Pallas kernel — the kernel's explicit DMAs cap at ~206 GB/s
+        # on this platform (per-engine ceiling, measured in
+        # benchmarks/bench_dma_layouts.py) while XLA's fused gather
+        # sustains ~340 GB/s.  The kernel stays available via
+        # impl="pallas" for platforms where Pallas DMA streams at full
+        # bandwidth.  Under tp the jnp ops partition natively (kv_heads
+        # axis), so no shard_map is needed either way.
+        impl = "jnp"
     if impl in ("pallas", "pallas_interpret"):
         interpret = impl == "pallas_interpret"
         if tp > 1:

@@ -7,27 +7,42 @@ kernels permute paged blocks through a universal layout, on TPU the same
 block-gather problem is fused INTO attention — each sequence's scattered
 KV blocks are DMA'd from HBM into VMEM by physical block id and consumed
 by an online-softmax accumulation without ever materializing a gathered
-context tensor in HBM (which is what the jnp fallback in paged_attention.py
-makes XLA do, and why that path measures ~80% of the decode step).
+context tensor in HBM (which is what the jnp fallback in
+paged_attention.py makes XLA do — double traffic through HBM).
 
 Layout: the cache stores TRANSPOSED blocks, [n_kv, num_blocks, head_dim,
 block_size] per layer (paged_attention.py docstring).  block_size is the
 lane dimension, so with block_size a multiple of 128:
-  * every (head, block) DMA slab [hd, bs] is lane-aligned for ANY head_dim
-    (Mosaic rejects sub-128 lane slices; head_dim=64 models would otherwise
-    need padded storage);
-  * scores q[g,hd] @ k[hd,bs] and the p@v contraction are MXU-shaped with
-    no in-kernel reshapes or lane-splits (both unsupported on this Mosaic).
+  * every per-block DMA ([nkv, hd, bs] — one strided descriptor covering
+    all heads) is lane-aligned for ANY head_dim;
+  * scores q[g,hd] @ k[hd,S] and the p@v contraction are MXU-shaped with
+    no in-kernel reshapes or lane-splits.
 
-Structure: grid = (batch,); block tables + kv lengths ride scalar prefetch
-(SMEM); per sequence, KV is consumed in chunks of `bpc` physical blocks,
-double-buffered (chunk c+1's DMAs fly while chunk c is reduced into fp32
-m/l/acc carries).  Padded table entries point at physical block 0 (the
-garbage block) and are masked by position, so shapes stay static.
+Structure (what round-4's 0.55-of-roofline bench paid for getting wrong,
+each point measured in benchmarks/bench_decode_phases.py):
+  * grid = (batch,), sequential; block tables + kv lengths ride scalar
+    prefetch (SMEM).
+  * KV is consumed in chunks of `bpc` physical blocks DMA'd into
+    [nkv, hd, S=bpc*bs] VMEM buffers, double-buffered, and the prefetch
+    chain CROSSES grid steps (the last chunk of sequence b prefetches
+    chunk 0 of sequence b+1, bookkept in SMEM scratch that persists
+    across grid iterations) — the DMA engines never drain between
+    sequences.  The prior per-(head, block) copies were latency-bound at
+    ~190 GB/s; whole-chunk strided descriptors with a cross-sequence
+    chain stream continuously.
+  * compute per chunk is TWO batched bf16 dot_generals with fp32
+    accumulation ([nkv, g, hd] @ [nkv, hd, S] and the p@v contraction)
+    plus one online-softmax update on [nkv, g, S].  The prior kernel
+    upcast K/V to fp32 and issued 2 matmuls PER BLOCK — fp32 MXU
+    throughput plus 64 fill-bound passes made compute as slow as the
+    entire bandwidth budget.
 
-Numerics match paged_attention.paged_attention_decode_jnp exactly (fp32
-softmax accumulation); tests/test_paged_attention.py cross-checks the two,
-and interpret mode keeps the kernel runnable on CPU.
+Padded table entries point at physical block 0 (the garbage block) and
+are masked by position, so shapes stay static.  Numerics match
+paged_attention.paged_attention_decode_jnp to bf16 matmul tolerance
+(fp32 softmax and accumulation); tests/test_paged_attention.py
+cross-checks the two, and interpret mode keeps the kernel runnable on
+CPU.
 """
 
 from __future__ import annotations
@@ -53,82 +68,120 @@ def _decode_kernel(
     # output
     o_ref,        # [1, nkv, group, hd] VMEM
     # scratch
-    k_buf,        # [2, nkv, bpc, hd, bs] VMEM
+    k_buf,        # [2, nkv, hd, S] VMEM
     v_buf,
     sem,          # DMA semaphores [2 slots, 2 (k/v)]
     *,
     bpc: int,
     bs: int,
+    debug_mode: str = "",  # "" | "dma_only" | "compute_only" (profiling)
 ):
     b = pl.program_id(0)
+    B = pl.num_programs(0)
     nkv = k_hbm.shape[0]
     hd = k_hbm.shape[2]
     S = bpc * bs  # positions per chunk
     kv_len = kv_lens_ref[b]
     n_chunks = pl.cdiv(kv_len, S)
 
-    def chunk_copies(c, slot):
-        """Per-(head, block) DMAs for chunk c into buffer `slot`: each copy
-        is one full [hd, bs] plane — contiguous, lane-aligned for any hd."""
-        copies = []
+    def start_chunk(seq, c, slot):
+        """One strided descriptor per block per tensor: [nkv, hd, bs]
+        (all heads) landing at the block's S-offset in the chunk buffer."""
         for i in range(bpc):
-            pid = tables_ref[b, c * bpc + i]
-            for h in range(nkv):
-                copies.append(pltpu.make_async_copy(
-                    k_hbm.at[h, pid], k_buf.at[slot, h, i], sem.at[slot, 0],
-                ))
-                copies.append(pltpu.make_async_copy(
-                    v_hbm.at[h, pid], v_buf.at[slot, h, i], sem.at[slot, 1],
-                ))
-        return copies
+            pid = tables_ref[seq, c * bpc + i]
+            pltpu.make_async_copy(
+                k_hbm.at[:, pid], k_buf.at[slot, :, :, pl.ds(i * bs, bs)],
+                sem.at[slot, 0],
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[:, pid], v_buf.at[slot, :, :, pl.ds(i * bs, bs)],
+                sem.at[slot, 1],
+            ).start()
 
-    def start_chunk(c, slot):
-        for cp in chunk_copies(c, slot):
-            cp.start()
+    def wait_chunk(seq, c, slot):
+        for i in range(bpc):
+            pid = tables_ref[seq, c * bpc + i]
+            pltpu.make_async_copy(
+                k_hbm.at[:, pid], k_buf.at[slot, :, :, pl.ds(i * bs, bs)],
+                sem.at[slot, 0],
+            ).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[:, pid], v_buf.at[slot, :, :, pl.ds(i * bs, bs)],
+                sem.at[slot, 1],
+            ).wait()
 
-    def wait_chunk(c, slot):
-        for cp in chunk_copies(c, slot):
-            cp.wait()
+    # the very first grid step primes the pipeline; afterwards chunk 0 of
+    # sequence b was prefetched by sequence b-1's last chunk, so the DMA
+    # chain never drains between sequences
+    @pl.when(b == 0)
+    def _():
+        start_chunk(0, 0, 0)
 
-    start_chunk(0, 0)
-    q = q_ref[0].astype(jnp.float32)  # [nkv, group, hd]
+    # slot phase = chunks consumed by earlier sequences (recomputed from
+    # kv_lens — stateless, so the kernel needs nothing persisted across
+    # grid steps); the wrapper clamps kv_lens >= 1, mirrored here so the
+    # phase arithmetic cannot desync from the chunk loop
+    base = jax.lax.fori_loop(
+        0, b,
+        lambda j, acc: acc + pl.cdiv(jnp.maximum(kv_lens_ref[j], 1), S),
+        jnp.int32(0),
+    )
+    q = q_ref[0]     # [nkv, g, hd] bf16, pre-scaled
     g = q.shape[1]
 
     def body(c, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(c, 2)
+        if debug_mode == "compute_only":
+            # profiling: every sequence reduces the primed buffer 0 (only
+            # b==0/c==0 may wait — nothing ever signals the other grid
+            # steps' semaphores, so waiting there would deadlock)
+            slot = jnp.int32(0)
 
-        @pl.when(c + 1 < n_chunks)
-        def _():
-            start_chunk(c + 1, jax.lax.rem(c + 1, 2))
+            @pl.when((c == 0) & (b == 0))
+            def _():
+                wait_chunk(0, 0, slot)
+        else:
+            slot = jax.lax.rem(base + c, 2)
+            nxt = jax.lax.rem(base + c + 1, 2)
 
-        wait_chunk(c, slot)
-        # one online-softmax update per block plane: every matmul is a
-        # single-contracting-dim batched 2D form Mosaic lowers directly
-        for i in range(bpc):
-            k = k_buf[slot, :, i].astype(jnp.float32)  # [nkv, hd, bs]
-            v = v_buf[slot, :, i].astype(jnp.float32)
-            # scores [nkv, g, bs]: q[g,hd] @ k[hd,bs] per kv head
-            s = jax.lax.dot_general(
-                q, k, (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            )
-            pos = (c * bpc + i) * bs \
-                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-            s = jnp.where(pos < kv_len, s, NEG_INF)
+            # prefetch BEFORE waiting: next chunk of this sequence, or
+            # chunk 0 of the next sequence (cross-grid-step chain)
+            @pl.when(c + 1 < n_chunks)
+            def _():
+                start_chunk(b, c + 1, nxt)
 
-            m_new = jnp.maximum(m, jnp.max(s, axis=2, keepdims=True))
-            alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new)
-            l = l * alpha + jnp.sum(p, axis=2, keepdims=True)
-            # out [nkv, g, hd]: p[g,bs] @ v[hd,bs]^T per kv head
-            pv = jax.lax.dot_general(
-                p, v, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-            )
-            acc = acc * alpha + pv
-            m = m_new
-        return m, l, acc
+            @pl.when((c + 1 == n_chunks) & (b + 1 < B))
+            def _():
+                start_chunk(b + 1, 0, nxt)
+
+            wait_chunk(b, c, slot)
+        if debug_mode == "dma_only":
+            acc = acc + jnp.max(k_buf[slot].astype(jnp.float32)) \
+                + jnp.max(v_buf[slot].astype(jnp.float32))
+            return m, l, acc
+
+        # scores [nkv, g, S]: ONE batched bf16 matmul for the whole chunk
+        k = k_buf[slot]  # [nkv, hd, S]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        pos = c * S + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos < kv_len, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p, axis=2, keepdims=True)
+        # out [nkv, g, hd]: p is cast to bf16 for the MXU (standard flash
+        # practice; the fp32 running accumulation keeps the precision)
+        pv = jax.lax.dot_general(
+            p.astype(v_buf.dtype), v_buf[slot],
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha + pv
+        return m_new, l, acc
 
     m0 = jnp.full((nkv, g, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((nkv, g, 1), jnp.float32)
@@ -139,7 +192,7 @@ def _decode_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("layer", "blocks_per_chunk", "interpret"),
+    static_argnames=("layer", "blocks_per_chunk", "interpret", "debug_mode"),
 )
 def paged_attention_decode_pallas(
     q: jax.Array,             # [B, nh, hd] (rope applied, NOT pre-scaled)
@@ -151,6 +204,7 @@ def paged_attention_decode_pallas(
     *,
     blocks_per_chunk: int | None = None,
     interpret: bool = False,
+    debug_mode: str = "",
 ) -> jax.Array:
     """Drop-in fast path for paged_attention.paged_attention_decode."""
     B, nh, hd = q.shape
@@ -159,19 +213,27 @@ def paged_attention_decode_pallas(
     group = nh // nkv
     max_blocks = block_tables.shape[1]
 
-    bpc = blocks_per_chunk or max(1, min(max_blocks, -(-256 // bs)))
+    # chunk of up to 8 blocks (S = 1024 lanes at bs=128): big enough that
+    # the two per-chunk matmuls amortize their pipeline fills and DMA
+    # descriptors stay few, small enough for double-buffered VMEM
+    bpc = blocks_per_chunk or max(1, min(max_blocks, -(-1024 // bs)))
     n_chunks = -(-max_blocks // bpc)
     pad = n_chunks * bpc - max_blocks
     if pad:
         # padded entries hit the garbage block (0) and are masked by pos
         block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+    # the kernel's slot/semaphore chain assumes every sequence consumes
+    # >= 1 chunk; the engine always passes ctx+1 >= 1, this is a guard
+    kv_lens = jnp.maximum(kv_lens, 1)
 
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
     qg = (q.astype(jnp.float32) * scale).astype(q.dtype)
     qg = qg.reshape(B, nkv, group, hd)
 
+    S = bpc * bs
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, bpc=bpc, bs=bs),
+        functools.partial(_decode_kernel, bpc=bpc, bs=bs,
+                          debug_mode=debug_mode),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B,),
@@ -184,14 +246,15 @@ def paged_attention_decode_pallas(
             out_specs=pl.BlockSpec((1, nkv, group, hd),
                                    lambda b, *refs: (b, 0, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((2, nkv, bpc, hd, bs), kc.dtype),
-                pltpu.VMEM((2, nkv, bpc, hd, bs), vc.dtype),
+                pltpu.VMEM((2, nkv, hd, S), kc.dtype),
+                pltpu.VMEM((2, nkv, hd, S), vc.dtype),
                 pltpu.SemaphoreType.DMA((2, 2)),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024,
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * B * nh * hd * max_blocks * bs,

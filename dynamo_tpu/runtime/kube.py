@@ -233,8 +233,15 @@ class KubeDiscovery(DiscoveryBackend):
         async with self._http().post(self._leases_url(),
                                      json=body) as resp:
             if resp.status == 409:  # exists: replace via merge patch
+                patch = json.loads(json.dumps(body))
+                if lease:
+                    # merge-patch leaves absent keys intact: a key first
+                    # written durable and later re-put with lease=True
+                    # would otherwise keep the durable marker and never go
+                    # stale; null explicitly deletes it
+                    patch["metadata"]["annotations"][ANN_DURABLE] = None
                 async with self._http().patch(
-                    self._leases_url(body["metadata"]["name"]), json=body,
+                    self._leases_url(body["metadata"]["name"]), json=patch,
                     headers={"Content-Type":
                              "application/merge-patch+json"},
                 ) as r2:
@@ -315,20 +322,29 @@ class KubeDiscovery(DiscoveryBackend):
 
     async def _watch_stream(self, rv: str, prefix: str,
                             known: Dict[str, str]):
-        """One API-server watch connection; raises TimeoutError at the
-        staleness-sweep interval."""
+        """One API-server watch connection, bounded to the staleness-sweep
+        interval by WALL CLOCK, not read idleness: in a busy cluster every
+        live worker renews its Lease each ttl/3, so the stream never idles
+        long enough for a sock_read timeout to fire — yet the API server
+        emits no event for a holder that simply stops renewing.  Returning
+        after ttl/2 regardless of traffic guarantees the caller's
+        list+diff sweep runs and surfaces crashed holders as deletes.
+        Raises TimeoutError on a genuinely idle stream (same effect)."""
         import aiohttp
 
         params = {
             "labelSelector": f"{LABEL_CLUSTER}={self.cluster_id}",
             "watch": "true", "resourceVersion": rv,
         }
-        timeout = aiohttp.ClientTimeout(total=None,
-                                        sock_read=max(self.ttl_s / 2, 1.0))
+        sweep = max(self.ttl_s / 2, 1.0)
+        deadline = asyncio.get_running_loop().time() + sweep
+        timeout = aiohttp.ClientTimeout(total=None, sock_read=sweep)
         async with self._http().get(self._leases_url(), params=params,
                                     timeout=timeout) as resp:
             resp.raise_for_status()
             async for line in resp.content:
+                if asyncio.get_running_loop().time() >= deadline:
+                    return  # sweep due: caller re-snapshots
                 if not line.strip():
                     continue
                 try:

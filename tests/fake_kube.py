@@ -18,16 +18,52 @@ from aiohttp import web
 LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
 
 
+def _merge_patch(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+    """RFC 7386 JSON merge-patch: null deletes, dicts recurse."""
+    for k, v in src.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge_patch(dst[k], v)
+        else:
+            dst[k] = copy.deepcopy(v)
+
+
+def _match_selector(obj: Dict[str, Any], sel: str) -> bool:
+    """k=v and bare-key ("k") selector terms, comma-joined."""
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for term in filter(None, (t.strip() for t in sel.split(","))):
+        if "=" in term:
+            k, v = term.split("=", 1)
+            if labels.get(k) != v:
+                return False
+        elif term not in labels:
+            return False
+    return True
+
+
 class FakeKubeApiServer:
     def __init__(self):
         self.leases: Dict[str, Dict[str, Any]] = {}  # name -> object
-        self.deployments: Dict[str, Dict[str, Any]] = {}  # name -> {replicas}
+        # name -> full apps/v1 Deployment object (scale-only callers get a
+        # minimal synthesized object)
+        self.deployments: Dict[str, Dict[str, Any]] = {}
+        self.configmaps: Dict[str, Dict[str, Any]] = {}  # name -> object
         self.rv = 0
         self._watchers: List[asyncio.Queue] = []
         self._runner = None
         self.endpoint = ""
         # test hooks
         self.scale_calls: List[tuple] = []
+
+    def set_graph_spec(self, name: str, spec: Dict[str, Any]) -> None:
+        """Store a graph ConfigMap the way the operator expects it."""
+        self.configmaps[name] = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name,
+                         "labels": {"dynamo.dev/graph": "1"}},
+            "data": {"spec": json.dumps(spec)},
+        }
 
     def _bump(self) -> str:
         self.rv += 1
@@ -90,16 +126,7 @@ class FakeKubeApiServer:
         if obj is None:
             return web.json_response({"kind": "Status", "code": 404},
                                      status=404)
-        patch = await request.json()
-
-        def merge(dst, src):
-            for k, v in src.items():
-                if isinstance(v, dict) and isinstance(dst.get(k), dict):
-                    merge(dst[k], v)
-                else:
-                    dst[k] = v
-
-        merge(obj, patch)
+        _merge_patch(obj, await request.json())
         obj["metadata"]["resourceVersion"] = self._bump()
         self._notify("MODIFIED", obj)
         return web.json_response(obj)
@@ -114,29 +141,91 @@ class FakeKubeApiServer:
         self._notify("DELETED", obj)
         return web.json_response({"kind": "Status", "status": "Success"})
 
-    # -- deployment scale (planner connector) -----------------------------
+    # -- deployments (operator + planner connector) -----------------------
+
+    def _dep(self, name: str) -> Dict[str, Any]:
+        return self.deployments.setdefault(name, {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name}, "spec": {"replicas": 1},
+        })
 
     async def h_get_scale(self, request: web.Request):
         name = request.match_info["name"]
-        dep = self.deployments.setdefault(name, {"replicas": 1})
+        dep = self._dep(name)
+        n = dep["spec"].get("replicas", 1)
         return web.json_response({
             "kind": "Scale",
             "metadata": {"name": name,
                          "namespace": request.match_info["ns"]},
-            "spec": {"replicas": dep["replicas"]},
-            "status": {"replicas": dep["replicas"]},
+            "spec": {"replicas": n},
+            "status": {"replicas": n},
         })
 
     async def h_patch_scale(self, request: web.Request):
         name = request.match_info["name"]
         body = await request.json()
         n = int(body.get("spec", {}).get("replicas", 0))
-        dep = self.deployments.setdefault(name, {"replicas": 1})
-        dep["replicas"] = n
+        self._dep(name)["spec"]["replicas"] = n
         self.scale_calls.append((name, n))
         return web.json_response({
             "kind": "Scale", "metadata": {"name": name},
             "spec": {"replicas": n}, "status": {"replicas": n},
+        })
+
+    async def h_dep_list(self, request: web.Request):
+        sel = request.query.get("labelSelector", "")
+        items = [copy.deepcopy(o) for o in self.deployments.values()
+                 if _match_selector(o, sel)]
+        return web.json_response({
+            "kind": "DeploymentList", "items": items,
+            "metadata": {"resourceVersion": str(self.rv)},
+        })
+
+    async def h_dep_create(self, request: web.Request):
+        body = await request.json()
+        name = body["metadata"]["name"]
+        if name in self.deployments:
+            return web.json_response(
+                {"kind": "Status", "code": 409, "reason": "AlreadyExists"},
+                status=409)
+        body["metadata"]["resourceVersion"] = self._bump()
+        self.deployments[name] = body
+        return web.json_response(body, status=201)
+
+    async def h_dep_get(self, request: web.Request):
+        obj = self.deployments.get(request.match_info["name"])
+        if obj is None:
+            return web.json_response({"kind": "Status", "code": 404},
+                                     status=404)
+        return web.json_response(obj)
+
+    async def h_dep_patch(self, request: web.Request):
+        name = request.match_info["name"]
+        obj = self.deployments.get(name)
+        if obj is None:
+            return web.json_response({"kind": "Status", "code": 404},
+                                     status=404)
+        _merge_patch(obj, await request.json())
+        obj["metadata"]["resourceVersion"] = self._bump()
+        return web.json_response(obj)
+
+    async def h_dep_delete(self, request: web.Request):
+        obj = self.deployments.pop(request.match_info["name"], None)
+        if obj is None:
+            return web.json_response({"kind": "Status", "code": 404},
+                                     status=404)
+        self._bump()
+        return web.json_response({"kind": "Status", "status": "Success"})
+
+    # -- configmaps (graph specs) -----------------------------------------
+
+    async def h_cm_list(self, request: web.Request):
+        sel = request.query.get("labelSelector", "")
+        items = [copy.deepcopy(o) for o in self.configmaps.values()
+                 if _match_selector(o, sel)]
+        return web.json_response({
+            "kind": "ConfigMapList", "items": items,
+            "metadata": {"resourceVersion": str(self.rv)},
         })
 
     # -- lifecycle --------------------------------------------------------
@@ -148,9 +237,16 @@ class FakeKubeApiServer:
         app.router.add_post(base, self.h_create)
         app.router.add_patch(base + "/{name}", self.h_patch)
         app.router.add_delete(base + "/{name}", self.h_delete)
-        dep = "/apis/apps/v1/namespaces/{ns}/deployments/{name}/scale"
-        app.router.add_get(dep, self.h_get_scale)
-        app.router.add_patch(dep, self.h_patch_scale)
+        deps = "/apis/apps/v1/namespaces/{ns}/deployments"
+        app.router.add_get(deps + "/{name}/scale", self.h_get_scale)
+        app.router.add_patch(deps + "/{name}/scale", self.h_patch_scale)
+        app.router.add_get(deps, self.h_dep_list)
+        app.router.add_post(deps, self.h_dep_create)
+        app.router.add_get(deps + "/{name}", self.h_dep_get)
+        app.router.add_patch(deps + "/{name}", self.h_dep_patch)
+        app.router.add_delete(deps + "/{name}", self.h_dep_delete)
+        app.router.add_get("/api/v1/namespaces/{ns}/configmaps",
+                           self.h_cm_list)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, "127.0.0.1", 0)

@@ -232,6 +232,6 @@ async def test_planner_drives_kubernetes_connector():
                                         mean_kv_usage=0.5)
         n = await p.tick()
         assert n is not None and n >= 2
-        assert fake.deployments["workers"]["replicas"] == n
+        assert fake.deployments["workers"]["spec"]["replicas"] == n
         assert fake.scale_calls[-1] == ("workers", n)
         await conn.close()

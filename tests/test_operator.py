@@ -1,0 +1,190 @@
+"""Operator reconcile loop vs the fake API server.
+
+Ref behavior model: the reference's DynamoGraphDeployment controller
+(deploy/operator/internal/controller/dynamographdeployment_controller.go)
+— apply a graph spec, get the component Deployment set; edit the spec,
+the set converges; planner scale writes survive spec-unrelated passes.
+"""
+
+import asyncio
+import copy
+
+import pytest
+
+from dynamo_tpu.operator import GraphSpec, GraphOperator, render_deployments
+from dynamo_tpu.operator.spec import HASH_ANN, REPLICAS_ANN
+
+from fake_kube import FakeKubeApiServer
+
+SPEC = {
+    "name": "llama-fleet",
+    "image": "reg/dynamo-tpu:v1",
+    "model": {"name": "llama-3b", "path": "/models/llama-3b"},
+    "components": {
+        "frontend": {"kind": "frontend", "replicas": 2, "port": 8000},
+        "decode": {"kind": "worker", "role": "decode", "replicas": 3,
+                   "tpu": 1},
+        "prefill": {"kind": "worker", "role": "prefill", "replicas": 2,
+                    "tpu": 1},
+        "planner": {"kind": "planner", "replicas": 1,
+                    "args": ["--mode", "sla"]},
+    },
+}
+
+
+def test_spec_parse_and_render():
+    spec = GraphSpec.parse(SPEC)
+    deps = render_deployments(spec)
+    assert set(deps) == {"llama-fleet-frontend", "llama-fleet-decode",
+                         "llama-fleet-prefill", "llama-fleet-planner"}
+    fe = deps["llama-fleet-frontend"]
+    assert fe["spec"]["replicas"] == 2
+    cont = fe["spec"]["template"]["spec"]["containers"][0]
+    assert cont["command"][:3] == ["python", "-m", "dynamo_tpu.frontend"]
+    assert {"name": "JAX_PLATFORMS", "value": "cpu"} in cont["env"]
+    dec = deps["llama-fleet-decode"]
+    dcont = dec["spec"]["template"]["spec"]["containers"][0]
+    assert "--role" in dcont["command"] and "decode" in dcont["command"]
+    assert dcont["resources"]["limits"]["google.com/tpu"] == "1"
+    assert not any(e["name"] == "JAX_PLATFORMS" for e in dcont["env"])
+    # rolling updates never drop to zero
+    assert dec["spec"]["strategy"]["rollingUpdate"]["maxUnavailable"] == 0
+    # annotations carry the drift-detection state
+    ann = dec["metadata"]["annotations"]
+    assert ann[REPLICAS_ANN] == "3" and ann[HASH_ANN]
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        GraphSpec.parse({"image": "x", "components": {"a": {}}})
+    with pytest.raises(ValueError):
+        GraphSpec.parse({"name": "g", "image": "x",
+                         "components": {"a": {"kind": "nope"}}})
+    with pytest.raises(ValueError):
+        GraphSpec.parse({"name": "g", "image": "x", "components": {}})
+
+
+@pytest.mark.asyncio
+async def test_reconcile_create_update_delete():
+    fake = await FakeKubeApiServer().start()
+    op = GraphOperator(api_url=fake.endpoint, namespace="ns",
+                       interval_s=0.05)
+    try:
+        fake.set_graph_spec("llama-fleet", SPEC)
+        await op.reconcile_once()
+        assert set(fake.deployments) == {
+            "llama-fleet-frontend", "llama-fleet-decode",
+            "llama-fleet-prefill", "llama-fleet-planner"}
+        assert fake.deployments["llama-fleet-decode"]["spec"][
+            "replicas"] == 3
+        assert op.stats["created"] == 4
+
+        # no-op pass: converged, nothing patched
+        await op.reconcile_once()
+        assert op.stats["patched"] == 0
+
+        # spec edit: image change rolls every component; replica change
+        # on decode scales it; prefill removed -> deleted
+        spec2 = copy.deepcopy(SPEC)
+        spec2["image"] = "reg/dynamo-tpu:v2"
+        spec2["components"]["decode"]["replicas"] = 5
+        del spec2["components"]["prefill"]
+        fake.set_graph_spec("llama-fleet", spec2)
+        await op.reconcile_once()
+        assert "llama-fleet-prefill" not in fake.deployments
+        dec = fake.deployments["llama-fleet-decode"]
+        assert dec["spec"]["replicas"] == 5
+        assert dec["spec"]["template"]["spec"]["containers"][0][
+            "image"] == "reg/dynamo-tpu:v2"
+        assert op.stats["deleted"] == 1
+    finally:
+        await op.close()
+        await fake.close()
+
+
+@pytest.mark.asyncio
+async def test_planner_scale_survives_reconcile():
+    """The planner patches the scale subresource; a spec-unrelated
+    reconcile pass must NOT fight it (replicas only corrected when the
+    SPEC's replica count changes)."""
+    fake = await FakeKubeApiServer().start()
+    op = GraphOperator(api_url=fake.endpoint, namespace="ns")
+    try:
+        fake.set_graph_spec("llama-fleet", SPEC)
+        await op.reconcile_once()
+
+        # planner scales decode 3 -> 7 out of band
+        fake.deployments["llama-fleet-decode"]["spec"]["replicas"] = 7
+        await op.reconcile_once()
+        assert fake.deployments["llama-fleet-decode"]["spec"][
+            "replicas"] == 7  # left alone
+
+        # but a SPEC replica edit wins over the planner's value
+        spec2 = copy.deepcopy(SPEC)
+        spec2["components"]["decode"]["replicas"] = 4
+        fake.set_graph_spec("llama-fleet", spec2)
+        await op.reconcile_once()
+        assert fake.deployments["llama-fleet-decode"]["spec"][
+            "replicas"] == 4
+    finally:
+        await op.close()
+        await fake.close()
+
+
+@pytest.mark.asyncio
+async def test_broken_spec_never_reaps_running_fleet():
+    """A config typo in a live graph's spec must NOT take down its
+    running Deployments: the graph is quarantined (parseable-JSON case)
+    or all stray deletion freezes (unparseable-JSON case) until the spec
+    parses again."""
+    fake = await FakeKubeApiServer().start()
+    op = GraphOperator(api_url=fake.endpoint, namespace="ns")
+    try:
+        fake.set_graph_spec("llama-fleet", SPEC)
+        await op.reconcile_once()
+        assert len(fake.deployments) == 4
+
+        # JSON parses but spec is invalid (image dropped): quarantine
+        bad = copy.deepcopy(SPEC)
+        del bad["image"]
+        fake.set_graph_spec("llama-fleet", bad)
+        await op.reconcile_once()
+        assert len(fake.deployments) == 4 and op.stats["deleted"] == 0
+
+        # JSON itself is garbage: graph name unknowable, deletes freeze
+        fake.configmaps["llama-fleet"]["data"]["spec"] = "{nope"
+        await op.reconcile_once()
+        assert len(fake.deployments) == 4 and op.stats["deleted"] == 0
+
+        # spec restored: converges again, still nothing reaped
+        fake.set_graph_spec("llama-fleet", SPEC)
+        await op.reconcile_once()
+        assert len(fake.deployments) == 4 and op.stats["deleted"] == 0
+    finally:
+        await op.close()
+        await fake.close()
+
+
+@pytest.mark.asyncio
+async def test_bad_spec_skipped_and_loop_runs():
+    """One malformed graph must not stall the others; the run() loop
+    reconciles on its own."""
+    fake = await FakeKubeApiServer().start()
+    op = GraphOperator(api_url=fake.endpoint, namespace="ns",
+                       interval_s=0.02)
+    try:
+        fake.set_graph_spec("bad", {"name": "bad"})  # no image/components
+        fake.set_graph_spec("llama-fleet", SPEC)
+        task = asyncio.create_task(op.run())
+        for _ in range(100):
+            if len(fake.deployments) == 4:
+                break
+            await asyncio.sleep(0.02)
+        assert len(fake.deployments) == 4
+        assert op.stats["errors"] >= 1
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+    finally:
+        await op.close()
+        await fake.close()

@@ -50,8 +50,10 @@ def test_pallas_matches_jnp(case):
         out = paged_attention_decode_pallas(
             q, kc, vc, layer, tables, kv_lens, interpret=True
         )
+        # 1e-4: the kernel's online softmax accumulates per chunk (not
+        # per whole context), so f32 sums reassociate
         np.testing.assert_allclose(
-            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
         )
 
 
@@ -68,7 +70,7 @@ def test_pallas_matches_jnp_multichunk():
         q, kc, vc, 0, tables, kv_lens, blocks_per_chunk=2, interpret=True
     )
     np.testing.assert_allclose(
-        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
     )
 
 
