@@ -48,6 +48,10 @@ class LocalEnginePullSource:
     inside its inject op.  Each gather is one scheduler op on the SENDER,
     so its decode keeps stepping during the extraction."""
 
+    # chunks are device arrays: the receiver may use device-sized chunks
+    # (no host frame bound) and pipeline gathers against injects
+    device_resident = True
+
     def __init__(self, src_engine, request_id: str):
         self.src = src_engine
         self.request_id = request_id

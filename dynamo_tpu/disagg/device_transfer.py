@@ -144,6 +144,12 @@ class NegotiatedPullSource(RequestPlanePullSource):
         self.allow_transfer = allow_transfer and device is not None
         self._conn = None
 
+    @property
+    def device_resident(self) -> bool:
+        """True once tier 2 is negotiated: chunks land as device arrays,
+        so the receiver can size chunks for the device path."""
+        return self._conn is not None
+
     async def open(self) -> Dict[str, Any]:
         header = await super().open()
         addr = header.get("transfer_addr")

@@ -327,6 +327,14 @@ class JaxEngine:
             "requests": 0, "prompt_tokens": 0,
         }
         self.itl_ema_s = 0.0  # streamed inter-token latency (SLA planner)
+        # forward-pass metrics stream (ref fpm_publisher.rs:1-10 /
+        # instrumented_scheduler.py): one record per dispatched program —
+        # decode bursts carry (lanes, fused k, gap since the previous
+        # decode dispatch), prefill programs carry (rows, chunk tokens).
+        # The worker drains this ring onto the event plane; the SLA
+        # planner regresses its perf model on it online.
+        self.fpm: deque = deque(maxlen=4096)
+        self._fpm_last_decode_t = 0.0
 
     # -- cache ------------------------------------------------------------
     def _init_kv_cache(self):
@@ -615,6 +623,10 @@ class JaxEngine:
         ks = [1]
         if self.config.decode_fused_steps > 1:
             ks.append(self.config.decode_fused_steps)
+        interleave = min(self.INTERLEAVE_BURST,
+                         self.config.decode_fused_steps)
+        if interleave not in ks:
+            ks.append(interleave)
         chain0, desc0, last0 = (self._chain_tokens, self._dev_desc,
                                 self._last_desc)
         for greedy in (True, False):
@@ -1466,9 +1478,23 @@ class JaxEngine:
             jnp.asarray(top_ps), self.lora_bank,
             jnp.asarray(lidx) if self.lora_bank is not None else None,
         )
-        firsts = np.asarray(tok)
+        self.fpm.append({
+            "t": time.monotonic(), "kind": "prefill", "rows": n,
+            "tokens": int(sum(chunks)), "bucket": bucket,
+        })
+        # fetch the sampled tokens ONLY when some row completes its
+        # prompt this chunk: np.asarray is a blocking device round trip
+        # (~35-100ms through the tunnel), and intermediate chunks discard
+        # the sample anyway — per-chunk fetches were the dominant term in
+        # round 4's 2.9s TTFT (prefill MFU 9%)
+        firsts = None
+        if any(s.prefill_pos + ch >= s.prompt_len
+               for s, ch in zip(pslots, chunks)):
+            firsts = np.asarray(tok)
         for i, (slot, chunk) in enumerate(zip(pslots, chunks)):
-            self._finish_prefill_chunk(slot, chunk, int(firsts[i]))
+            self._finish_prefill_chunk(
+                slot, chunk,
+                int(firsts[i]) if firsts is not None else -1)
 
     def _prefill_one(self, slot: "_Slot", budget: int) -> None:
         """The B=1 chunk program (single prefilling slot)."""
@@ -1515,7 +1541,15 @@ class JaxEngine:
             jnp.int32(slot.lora_idx) if self.lora_bank is not None
             else None,
         )
-        self._finish_prefill_chunk(slot, chunk, int(tok))
+        self.fpm.append({
+            "t": time.monotonic(), "kind": "prefill", "rows": 1,
+            "tokens": int(chunk), "bucket": bucket,
+        })
+        # blocking token fetch only on the completing chunk (see
+        # _prefill_step: intermediate chunks discard the sample)
+        first = int(np.asarray(tok)) \
+            if pos + chunk >= slot.prompt_len else -1
+        self._finish_prefill_chunk(slot, chunk, first)
 
     def _prefill_ring_one(self, slot: "_Slot") -> None:
         """Whole-prompt sequence-parallel prefill (see _prefill_one)."""
@@ -1578,10 +1612,11 @@ class JaxEngine:
     async def _stream_pull(self, slot: _Slot, dp: Dict[str, Any]) -> None:
         """Decode-side streaming pull: inject the prefill's KV chunk by
         chunk, each chunk one scheduler op, so decode bursts for OTHER
-        slots run in between (no whole-prompt stall, host memory bounded
-        by one chunk).  Any failure falls back to local prefill — the
-        slot's blocks are already allocated and prefill_pos still points
-        at the cached prefix."""
+        slots run in between (no whole-prompt stall; host memory bounded
+        by two chunks — the injecting one plus one prefetch in flight).
+        Any failure falls back to local prefill — the slot's blocks are
+        already allocated and prefill_pos still points at the cached
+        prefix."""
         src = None
         t0 = time.monotonic()
         try:
@@ -1609,20 +1644,46 @@ class JaxEngine:
             # materialized at admission — pull only the missing tail
             start = slot.cached_tokens // bs
             per = layout.blocks_per_chunk(self.config.transfer_chunk_bytes)
+            if getattr(src, "device_resident", False):
+                # device tiers: the chunk bound protects HOST memory, which
+                # device-resident chunks never touch — 8x chunks cut the
+                # scheduler-op round trips that dominated round-4's
+                # 0.24 GB/s tier-1 pull
+                per *= 8
+            spans = [(b0, min(per, n_blocks - b0))
+                     for b0 in range(start, n_blocks, per)]
             pulled = 0
-            for b0 in range(start, n_blocks, per):
-                if slot.finished or slot.cancel_requested:
-                    return
-                n = min(per, n_blocks - b0)
-                kb, vb = await src.chunk(b0, n)
-                await self._call_on_scheduler(
-                    partial(self._inject_pulled_chunk, slot, b0, n, kb, vb))
-                if isinstance(kb, np.ndarray):
-                    nbytes = kb.nbytes + vb.nbytes
-                    self.metrics["pull_host_chunk_bytes_max"] = max(
-                        self.metrics.get("pull_host_chunk_bytes_max", 0),
-                        nbytes)
-                pulled += n
+            # pipelined: chunk i+1 is in flight on the SOURCE while chunk
+            # i injects on this engine's scheduler (receiver-paced, one
+            # outstanding prefetch — the sender registry holds one chunk)
+            nxt = (asyncio.ensure_future(src.chunk(*spans[0]))
+                   if spans else None)
+            try:
+                for idx, (b0, n) in enumerate(spans):
+                    if slot.finished or slot.cancel_requested:
+                        return
+                    kb, vb = await nxt
+                    nxt = (asyncio.ensure_future(
+                        src.chunk(*spans[idx + 1]))
+                        if idx + 1 < len(spans) else None)
+                    await self._call_on_scheduler(
+                        partial(self._inject_pulled_chunk, slot, b0, n,
+                                kb, vb))
+                    if isinstance(kb, np.ndarray):
+                        nbytes = kb.nbytes + vb.nbytes
+                        self.metrics["pull_host_chunk_bytes_max"] = max(
+                            self.metrics.get("pull_host_chunk_bytes_max",
+                                             0),
+                            nbytes)
+                    pulled += n
+            finally:
+                if nxt is not None and not nxt.done():
+                    nxt.cancel()
+                if nxt is not None:
+                    try:
+                        await nxt
+                    except (asyncio.CancelledError, Exception):
+                        pass
             self.metrics["pull_blocks"] = (
                 self.metrics.get("pull_blocks", 0) + pulled)
             self.metrics["pull_seconds"] = (
@@ -1781,15 +1842,25 @@ class JaxEngine:
             slot.out_q.put_nowait(out)
 
     # -- decode -----------------------------------------------------------
+    # decode burst size while prefill/admission work is pending: single
+    # stepping bounds how long a chunk waits behind decode, but on this
+    # platform each dispatch costs ~15-30ms of tunnel RTT — at burst 1
+    # the interleave tax dominates the whole prefill phase (round-4 p50
+    # TTFT 2.9s).  A burst of 4 amortizes the dispatch 4x while holding
+    # a prefill chunk back ~3 extra steps (~8ms of compute).
+    INTERLEAVE_BURST = 4
+
     def _fused_k(self) -> int:
-        """Decode-burst size for this step.  Burst only when the scheduler
-        has no other work: pending admissions or prefill chunks must run
-        between single decode steps (chunked-prefill interleaving), and a
-        burst would hold them back k steps."""
+        """Decode-burst size for this step.  Full bursts only when the
+        scheduler has no other work: pending admissions or prefill chunks
+        run between SHORT decode bursts (chunked-prefill interleaving),
+        and a full burst would hold them back k steps."""
         c = self.config
-        if (self._jit_decode_multi is None or self.waiting
-                or any(s is not None and s.prefilling for s in self._slots)):
+        if self._jit_decode_multi is None:
             return 1
+        if (self.waiting
+                or any(s is not None and s.prefilling for s in self._slots)):
+            return min(self.INTERLEAVE_BURST, c.decode_fused_steps)
         return c.decode_fused_steps
 
     def _decode_step(self) -> None:
@@ -2243,6 +2314,21 @@ class JaxEngine:
         dd["positions"], dd["ctx_lens"], dd["steps"] = pos, ctx, steps
         self._chain_tokens = burst[k - 1]
         self._dev_desc = dd
+        now = time.monotonic()
+        gap = (now - self._fpm_last_decode_t
+               if self._fpm_last_decode_t else 0.0)
+        if gap > 1.0:
+            gap = 0.0  # idle period, not decode latency: mark unknown
+        self.fpm.append({
+            "t": now, "kind": "decode", "k": k,
+            "lanes": sum(1 for s in self._slots
+                         if s is not None and not s.prefilling),
+            # dispatch-to-dispatch gap: with the pipeline saturated this
+            # IS the burst's wall time (k tokens per lane per gap);
+            # 0.0 = unknown (first burst after an idle stretch)
+            "gap_s": gap,
+        })
+        self._fpm_last_decode_t = now
         return burst
 
     def _is_continuation(self, a: Dict[str, np.ndarray], active,

@@ -496,6 +496,7 @@ class JaxEngineWorker:
 
     async def _load_loop(self) -> None:
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"
+        fpm_subject = f"fpm.{self.namespace}.{self.component}"
         # local /metrics surface (system-status server): queue depth,
         # active sequences, KV pressure per worker
         m = self.runtime.metrics.scoped(component=self.component)
@@ -503,6 +504,20 @@ class JaxEngineWorker:
             await asyncio.sleep(0.5)
             if self.engine is None or self.served is None:
                 continue
+            # forward-pass metrics stream (ref fpm_publisher.rs): drain
+            # the engine's per-program ring onto the event plane — the
+            # planner's online perf regression input
+            steps = []
+            while self.engine.fpm and len(steps) < 512:
+                steps.append(self.engine.fpm.popleft())
+            if steps:
+                try:
+                    await self.runtime.event_plane.publish(fpm_subject, {
+                        "worker_id": self.served.instance_id,
+                        "steps": steps,
+                    })
+                except Exception:
+                    logger.warning("fpm publish failed", exc_info=True)
             # tier-2 sender refs whose receiver died mid-pull (mirrors the
             # engine's parked-KV TTL)
             self._chunk_refs.sweep(self.engine.parked_ttl_s)

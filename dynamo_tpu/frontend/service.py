@@ -365,6 +365,11 @@ class HttpService:
         from .anthropic import AnthropicRoutes
 
         AnthropicRoutes(self).mount(self.app)
+        # Responses + Files + Batches (ref openai.rs:2297,3112)
+        from .openai_extra import ExtraRoutes
+
+        self.extra = ExtraRoutes(self)
+        self.extra.mount(self.app)
 
     # -- helpers ----------------------------------------------------------
     def _inflight_delta(self, d: int) -> None:
@@ -891,6 +896,9 @@ class HttpService:
         return self
 
     async def close(self) -> None:
+        # cancel in-flight batch jobs BEFORE tearing the pipelines down
+        # (a running batch would keep calling handlers on a dead service)
+        await self.extra.close()
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

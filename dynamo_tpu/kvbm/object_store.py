@@ -38,6 +38,12 @@ class ObjectStorePool:
         self.dir = directory
         self.ttl_s = ttl_s
         os.makedirs(directory, exist_ok=True)
+        # startup GC: reap expired + legacy-named blobs once (any number
+        # of clients may do this concurrently; unlink races are benign)
+        try:
+            self.sweep()
+        except OSError:
+            logger.warning("G4 startup sweep failed", exc_info=True)
 
     def _path(self, h: int) -> str:
         # full 128-bit PLH in the blob name: the key must commit to the
@@ -86,9 +92,10 @@ class ObjectStorePool:
             return None  # concurrent GC / torn write: treat as miss
 
     def sweep(self, now: Optional[float] = None) -> int:
-        """TTL GC by mtime; safe to run from any client concurrently."""
-        if self.ttl_s is None:
-            return 0
+        """GC: TTL eviction by mtime (when a TTL is set) plus reaping of
+        pre-128-bit-key legacy blobs (16 hex chars — never indexed under
+        the widened naming, so without this they would sit unindexed and
+        unevicted forever).  Safe to run from any client concurrently."""
         now = now if now is not None else time.time()
         removed = 0
         for sub in os.listdir(self.dir):
@@ -97,8 +104,17 @@ class ObjectStorePool:
                 continue
             for name in os.listdir(d):
                 p = os.path.join(d, name)
+                legacy = False
+                if len(name) == 16 and ".tmp" not in name:
+                    try:
+                        int(name, 16)  # only reap actual legacy keys
+                        legacy = True
+                    except ValueError:
+                        pass
                 try:
-                    if now - os.path.getmtime(p) > self.ttl_s:
+                    if legacy or (
+                            self.ttl_s is not None
+                            and now - os.path.getmtime(p) > self.ttl_s):
                         os.unlink(p)
                         removed += 1
                 except OSError:
@@ -111,18 +127,10 @@ class ObjectStorePool:
             if not os.path.isdir(d):
                 continue
             for name in os.listdir(d):
+                # legacy 16-char blobs are invisible here by design;
+                # sweep() reaps them
                 if len(name) == 32 and ".tmp" not in name:
                     try:
                         yield int(name, 16)
                     except ValueError:
                         continue
-                elif len(name) == 16 and ".tmp" not in name:
-                    # pre-128-bit-key blobs (16 hex chars): never indexed
-                    # under the widened naming, so without this they would
-                    # sit unindexed and unevicted forever — an unbounded
-                    # disk leak in any store populated before the upgrade
-                    try:
-                        int(name, 16)  # only reap actual legacy keys
-                        os.unlink(os.path.join(d, name))
-                    except (ValueError, OSError):
-                        pass

@@ -99,7 +99,10 @@ class GraphOperator:
             try:
                 doc = json.loads((obj.get("data") or {}).get("spec", ""))
                 specs.append(GraphSpec.parse(doc))
-            except (ValueError, TypeError):
+            except Exception:
+                # ANY malformed spec (bad JSON, wrong shapes, surprise
+                # types) must quarantine that graph, never wedge the
+                # reconcile loop for the others
                 self.stats["errors"] += 1
                 logger.warning("graph ConfigMap %s has invalid spec; "
                                "skipping", name, exc_info=True)

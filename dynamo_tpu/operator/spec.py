@@ -59,6 +59,8 @@ class GraphSpec:
     def parse(cls, doc: Dict[str, Any]) -> "GraphSpec":
         """Validate + normalize a spec document (the ConfigMap's
         data["spec"] JSON)."""
+        if not isinstance(doc, dict):
+            raise ValueError("graph spec must be a JSON object")
         name = doc.get("name")
         image = doc.get("image")
         comps = doc.get("components")
@@ -71,6 +73,10 @@ class GraphSpec:
         model = doc.get("model") or {}
         out: Dict[str, ComponentSpec] = {}
         for cname, c in comps.items():
+            if not isinstance(c, dict):
+                raise ValueError(
+                    f"graph {name!r}: component {cname!r} must be an "
+                    "object")
             kind = c.get("kind", cname)
             if kind not in _KIND_MODULE:
                 raise ValueError(

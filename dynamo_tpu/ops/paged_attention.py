@@ -142,24 +142,38 @@ def _gather_ctx(cache: jax.Array, layer: int,
     return g.swapaxes(2, 3).reshape(nkv, mb * bs, hd)
 
 
-def _gqa_scores(q: jax.Array, k: jax.Array) -> jax.Array:
-    """q [.., nh, hd] x k [nkv, S, hd] -> scores [.., nh, S] with GQA."""
+def _gqa_scores(q: jax.Array, k: jax.Array,
+                native_dtype: bool = False) -> jax.Array:
+    """q [.., nh, hd] x k [nkv, S, hd] -> scores [.., nh, S] with GQA.
+
+    native_dtype=True feeds the MXU the storage dtype (bf16) with fp32
+    accumulation instead of upcasting operands — the decode fast path."""
     nh = q.shape[-2]
     nkv = k.shape[0]
     group = nh // nkv
     qg = q.reshape(*q.shape[:-2], nkv, group, q.shape[-1])
+    if native_dtype:
+        return jnp.einsum(
+            "...kgh,ksh->...kgs", qg, k,
+            preferred_element_type=jnp.float32,
+        ).reshape(*q.shape[:-2], nh, k.shape[1])
     s = jnp.einsum("...kgh,ksh->...kgs", qg.astype(jnp.float32),
                    k.astype(jnp.float32))
     return s.reshape(*q.shape[:-2], nh, k.shape[1])
 
 
-def _gqa_out(p: jax.Array, v: jax.Array) -> jax.Array:
+def _gqa_out(p: jax.Array, v: jax.Array,
+             native_dtype: bool = False) -> jax.Array:
     """p [.., nh, S] x v [nkv, S, hd] -> out [.., nh, hd]."""
     nh = p.shape[-2]
     nkv = v.shape[0]
     group = nh // nkv
     pg = p.reshape(*p.shape[:-2], nkv, group, p.shape[-1])
-    o = jnp.einsum("...kgs,ksh->...kgh", pg, v.astype(jnp.float32))
+    if native_dtype:
+        o = jnp.einsum("...kgs,ksh->...kgh", pg.astype(v.dtype), v,
+                       preferred_element_type=jnp.float32)
+    else:
+        o = jnp.einsum("...kgs,ksh->...kgh", pg, v.astype(jnp.float32))
     return o.reshape(*p.shape[:-2], nh, v.shape[-1])
 
 
@@ -212,19 +226,23 @@ def paged_attention_decode_jnp(
     layer: int,
     block_tables: jax.Array,  # [B, max_blocks]
     kv_lens: jax.Array,       # [B] valid tokens (incl. the one just written)
+    native_dtype: bool = False,
 ) -> jax.Array:
-    """Reference jnp path: XLA materializes the gathered context."""
+    """XLA path: the block gather feeds the einsums directly (fused by
+    XLA — no explicit DMA kernel).  native_dtype=True keeps matmul
+    operands in the cache dtype (bf16) with fp32 accumulation; False
+    upcasts to fp32 (exact reference numerics for tests)."""
     B, nh, hd = q.shape
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
 
     def one(qb, table, kvlen):
         kb = _gather_ctx(k_cache, layer, table)  # [nkv, S, hd]
         vb = _gather_ctx(v_cache, layer, table)
-        s = _gqa_scores(qb, kb) * scale          # [nh, S]
+        s = _gqa_scores(qb, kb, native_dtype) * scale   # [nh, S]
         mask = (jnp.arange(kb.shape[1]) < kvlen)[None, :]
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        return _gqa_out(p, vb)                   # [nh, hd]
+        return _gqa_out(p, vb, native_dtype)     # [nh, hd]
 
     out = jax.vmap(one)(q, block_tables, kv_lens)
     return out.astype(q.dtype)
@@ -313,11 +331,12 @@ def paged_attention_decode(
             q, k_cache, v_cache, layer, block_tables, kv_lens,
             interpret=interpret,
         )
-    if impl != "jnp":
+    if impl not in ("jnp", "jnp_bf16"):
         raise ValueError(
             f"unknown attention impl {impl!r}; expected auto | pallas | "
-            "pallas_interpret | jnp"
+            "pallas_interpret | jnp | jnp_bf16"
         )
     return paged_attention_decode_jnp(
-        q, k_cache, v_cache, layer, block_tables, kv_lens
+        q, k_cache, v_cache, layer, block_tables, kv_lens,
+        native_dtype=(impl == "jnp_bf16"),
     )

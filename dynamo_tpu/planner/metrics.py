@@ -145,3 +145,107 @@ class LoadObserver:
             mean_isl=mean_isl,
             mean_itl_s=sum(itls) / len(itls) if itls else 0.0,
         )
+
+
+class FpmObserver:
+    """Forward-pass-metrics consumer (ref fpm_publisher.rs + the
+    reference's instrumented_scheduler.py): workers stream one record per
+    dispatched program on `fpm.{ns}.{component}`; this observer keeps a
+    sliding window per worker and derives the measured decode ITL
+    (Σ dispatch gaps / Σ tokens-per-lane) and prefill throughput —
+    finer-grained and fresher than the 0.5s EMA in load_metrics, and the
+    input the SLA planner's perf model regresses on online."""
+
+    def __init__(self, runtime, namespace: str, component: str,
+                 window_s: float = 20.0):
+        self.runtime = runtime
+        self.subject = f"fpm.{namespace}.{component}"
+        self.window_s = window_s
+        # per-worker deques of (recv_t, record)
+        self._steps: Dict[int, Deque[Tuple[float, dict]]] = {}
+        self._cancel = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "FpmObserver":
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def close(self) -> None:
+        self._cancel.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            async for subj, payload in self.runtime.event_plane.subscribe(
+                self.subject, cancel=self._cancel
+            ):
+                if subj != self.subject:
+                    continue
+                w = payload.get("worker_id")
+                steps = payload.get("steps")
+                if w is None or not isinstance(steps, list):
+                    continue
+                dq = self._steps.setdefault(w, deque(maxlen=4096))
+                now = time.monotonic()
+                for rec in steps:
+                    if isinstance(rec, dict):
+                        dq.append((now, rec))
+        except asyncio.CancelledError:
+            pass
+
+    def _window(self):
+        cutoff = time.monotonic() - self.window_s
+        for w in list(self._steps):
+            dq = self._steps[w]
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+            if not dq:
+                del self._steps[w]
+        return self._steps
+
+    def decode_itl_s(self) -> float:
+        """Fleet decode ITL: dispatch-gap time per token-step, weighted
+        by fused burst size (gap covers k steps once the pipeline is
+        saturated).  0.0 when no decode records are in the window.
+
+        gap_s == 0.0 marks the first burst after an idle stretch (the
+        engine zeroes it); the 1s ceiling here drops anything that still
+        smells like request-boundary idleness rather than decode."""
+        gap_total, steps_total = 0.0, 0
+        for dq in self._window().values():
+            for _, rec in dq:
+                if rec.get("kind") != "decode":
+                    continue
+                gap = float(rec.get("gap_s", 0.0))
+                k = int(rec.get("k", 1))
+                if 0.0 < gap < 1.0 and k > 0:
+                    gap_total += gap
+                    steps_total += k
+        return gap_total / steps_total if steps_total else 0.0
+
+    def prefill_tokens_per_s(self) -> float:
+        """Fleet prefill token rate over the window (0.0 when idle).
+
+        Spans use each record's OWN engine timestamp ("t", monotonic on
+        that worker) per worker — a publish batches many records under
+        one receive time, and monotonic clocks do not compare across
+        workers — then per-worker rates sum."""
+        total_rate = 0.0
+        for dq in self._window().values():
+            toks, t0, t1 = 0, None, None
+            for _recv_t, rec in dq:
+                if rec.get("kind") != "prefill":
+                    continue
+                toks += int(rec.get("tokens", 0))
+                t = float(rec.get("t", 0.0))
+                t0 = t if t0 is None else min(t0, t)
+                t1 = t if t1 is None else max(t1, t)
+            if toks and t0 is not None and t1 > t0:
+                total_rate += toks / (t1 - t0)
+        return total_rate

@@ -27,7 +27,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .connectors import Connector
-from .metrics import LoadObserver
+from .metrics import FpmObserver, LoadObserver
 from .predictor import make_predictor
 
 logger = logging.getLogger(__name__)
@@ -55,6 +55,10 @@ class PlannerConfig:
     ttft_target_s: Optional[float] = None
     itl_target_s: Optional[float] = None
     perf_model_path: Optional[str] = None
+    # consume the workers' forward-pass-metrics stream (fpm.{ns}.{comp})
+    # for the online perf-model regression: per-program dispatch records
+    # beat the 0.5s itl_ema_s scalar both in freshness and in resolution
+    consume_fpm: bool = True
 
 
 class Planner:
@@ -64,6 +68,9 @@ class Planner:
                  perf_model=None):
         self.config = config or PlannerConfig()
         self.observer = LoadObserver(runtime, namespace, component)
+        self.fpm: Optional[FpmObserver] = (
+            FpmObserver(runtime, namespace, component)
+            if self.config.consume_fpm else None)
         self.predictor = make_predictor(self.config.predictor,
                                         self.config.predictor_window)
         # second forecast stream for SLA mode: request arrival rate
@@ -89,6 +96,8 @@ class Planner:
 
     async def start(self) -> "Planner":
         await self.observer.start()
+        if self.fpm is not None:
+            await self.fpm.start()
         self._task = asyncio.create_task(self._loop())
         return self
 
@@ -100,6 +109,8 @@ class Planner:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self.fpm is not None:
+            await self.fpm.close()
         await self.observer.close()
 
     async def _loop(self) -> None:
@@ -182,9 +193,14 @@ class Planner:
         c = self.config
         pm = self.perf_model
         isl = load.mean_isl or None
-        # online correction from live decode latency (FPM analogue)
-        if load.mean_itl_s > 0 and load.workers and load.active_seqs:
-            pm.observe_itl(load.active_per_worker, load.mean_itl_s, isl)
+        # online correction from live decode latency: prefer the FPM
+        # stream's per-program dispatch gaps; fall back to the coarse
+        # itl_ema_s scalar in load_metrics
+        fpm_itl = self.fpm.decode_itl_s() if self.fpm is not None else 0.0
+        measured = fpm_itl or load.mean_itl_s
+        if measured > 0 and load.workers and load.active_seqs:
+            pm.observe_itl(load.active_per_worker, measured, isl)
+            diag["fpm_itl_s"] = fpm_itl
 
         # decode bound: ITL capacity when targeted, else the load-mode
         # constant — an arrival lull must never scale away a fleet that is

@@ -1,0 +1,279 @@
+"""/v1/responses, /v1/files, /v1/batches against the mocker stack.
+
+Ref behavior model: lib/llm/src/http/service/openai.rs:2297 (responses
+family), :3112 (batches/files).
+"""
+
+import asyncio
+import json
+import uuid
+
+import aiohttp
+
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+def fresh_runtime() -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+async def start_stack(model_name="api-model", **kw):
+    rt = await fresh_runtime().start()
+    args = MockEngineArgs(model_name=model_name, block_size=4,
+                          base_step_s=0.0002, prefill_s_per_token=0.0,
+                          decode_s_per_seq=0.0, **kw)
+    worker = await MockerWorker(rt, args).start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    service = await HttpService(rt, manager, host="127.0.0.1",
+                                port=0).start()
+    port = service._runner.addresses[0][1]
+    for _ in range(100):
+        if manager.get(model_name):
+            break
+        await asyncio.sleep(0.02)
+    assert manager.get(model_name)
+    return rt, worker, watcher, service, f"http://127.0.0.1:{port}"
+
+
+async def stop_stack(rt, worker, watcher, service):
+    await service.extra.close()
+    await service.close()
+    await watcher.close()
+    await worker.close()
+    await rt.shutdown()
+
+
+async def test_responses_unary_and_chaining():
+    stack = await start_stack()
+    rt, worker, watcher, service, url = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "api-model", "input": "hello there",
+                    "instructions": "be brief", "max_output_tokens": 8}
+            async with s.post(f"{url}/v1/responses", json=body) as r:
+                assert r.status == 200, await r.text()
+                resp = await r.json()
+            assert resp["object"] == "response"
+            assert resp["status"] == "completed"
+            msg = resp["output"][-1]
+            assert msg["type"] == "message" and msg["role"] == "assistant"
+            text = msg["content"][0]["text"]
+            assert text == resp["output_text"] and text
+            assert resp["usage"]["input_tokens"] > 0
+            assert resp["usage"]["output_tokens"] > 0
+
+            # retrieve by id
+            async with s.get(f"{url}/v1/responses/{resp['id']}") as r:
+                assert r.status == 200
+                assert (await r.json())["id"] == resp["id"]
+
+            # chain a second turn; the stored transcript grows
+            body2 = {"model": "api-model", "input": "and again",
+                     "previous_response_id": resp["id"],
+                     "max_output_tokens": 8}
+            async with s.post(f"{url}/v1/responses", json=body2) as r:
+                assert r.status == 200
+                resp2 = await r.json()
+            msgs = service.extra.responses.messages(resp2["id"])
+            roles = [m["role"] for m in msgs]
+            assert roles == ["system", "user", "assistant", "user",
+                             "assistant"]
+
+            # structured input items are accepted
+            body3 = {"model": "api-model", "input": [
+                {"type": "message", "role": "user",
+                 "content": [{"type": "input_text", "text": "hi"}]}],
+                "max_output_tokens": 4}
+            async with s.post(f"{url}/v1/responses", json=body3) as r:
+                assert r.status == 200
+
+            # delete
+            async with s.delete(f"{url}/v1/responses/{resp['id']}") as r:
+                assert (await r.json())["deleted"] is True
+            async with s.get(f"{url}/v1/responses/{resp['id']}") as r:
+                assert r.status == 404
+
+            # chaining a deleted/unknown id 404s
+            async with s.post(f"{url}/v1/responses", json={
+                    "model": "api-model", "input": "x",
+                    "previous_response_id": resp["id"]}) as r:
+                assert r.status == 404
+    finally:
+        await stop_stack(*stack[:4])
+
+
+async def test_responses_streaming_events():
+    stack = await start_stack()
+    rt, worker, watcher, service, url = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "api-model", "input": "stream this",
+                    "stream": True, "max_output_tokens": 6}
+            events = []
+            async with s.post(f"{url}/v1/responses", json=body) as r:
+                assert r.status == 200
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: "):
+                        events.append(json.loads(line[6:]))
+        types = [e["type"] for e in events]
+        assert types[0] == "response.created"
+        assert "response.output_text.delta" in types
+        assert types[-2] == "response.output_text.done"
+        assert types[-1] == "response.completed"
+        deltas = "".join(e["delta"] for e in events
+                         if e["type"] == "response.output_text.delta")
+        done = next(e for e in events
+                    if e["type"] == "response.output_text.done")
+        final = events[-1]["response"]
+        assert deltas == done["text"] == final["output_text"]
+        assert final["status"] == "completed"
+        # sequence numbers increase monotonically
+        seqs = [e["sequence_number"] for e in events]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        # the streamed response is stored for chaining
+        assert service.extra.responses.get(final["id"]) is not None
+    finally:
+        await stop_stack(*stack[:4])
+
+
+async def test_files_roundtrip():
+    stack = await start_stack()
+    rt, worker, watcher, service, url = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            # multipart upload
+            form = aiohttp.FormData()
+            form.add_field("purpose", "batch")
+            form.add_field("file", b"line1\nline2\n",
+                           filename="data.jsonl")
+            async with s.post(f"{url}/v1/files", data=form) as r:
+                assert r.status == 200, await r.text()
+                meta = await r.json()
+            assert meta["object"] == "file"
+            assert meta["bytes"] == 12
+            assert meta["filename"] == "data.jsonl"
+            fid = meta["id"]
+
+            async with s.get(f"{url}/v1/files") as r:
+                ids = [f["id"] for f in (await r.json())["data"]]
+            assert fid in ids
+            async with s.get(f"{url}/v1/files/{fid}/content") as r:
+                assert await r.read() == b"line1\nline2\n"
+            async with s.delete(f"{url}/v1/files/{fid}") as r:
+                assert (await r.json())["deleted"] is True
+            async with s.get(f"{url}/v1/files/{fid}") as r:
+                assert r.status == 404
+            # path traversal attempts are 404s, not filesystem reads
+            async with s.get(f"{url}/v1/files/..%2F..%2Fetc") as r:
+                assert r.status == 404
+    finally:
+        await stop_stack(*stack[:4])
+
+
+async def test_batches_end_to_end():
+    stack = await start_stack()
+    rt, worker, watcher, service, url = stack
+    try:
+        lines = [
+            json.dumps({
+                "custom_id": f"req-{i}",
+                "method": "POST", "url": "/v1/chat/completions",
+                "body": {"model": "api-model",
+                         "messages": [{"role": "user",
+                                       "content": f"item {i}"}],
+                         "max_tokens": 4},
+            }) for i in range(5)
+        ]
+        # one bad line: unknown model -> lands in request_counts.failed
+        lines.append(json.dumps({
+            "custom_id": "req-bad",
+            "method": "POST", "url": "/v1/chat/completions",
+            "body": {"model": "nope", "messages": [
+                {"role": "user", "content": "x"}]},
+        }))
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{url}/v1/files", json={
+                    "purpose": "batch", "filename": "in.jsonl",
+                    "content": "\n".join(lines) + "\n"}) as r:
+                assert r.status == 200, await r.text()
+                fid = (await r.json())["id"]
+            async with s.post(f"{url}/v1/batches", json={
+                    "input_file_id": fid,
+                    "endpoint": "/v1/chat/completions",
+                    "completion_window": "24h"}) as r:
+                assert r.status == 200, await r.text()
+                batch = await r.json()
+            assert batch["status"] in ("validating", "in_progress")
+            bid = batch["id"]
+            for _ in range(200):
+                async with s.get(f"{url}/v1/batches/{bid}") as r:
+                    batch = await r.json()
+                if batch["status"] == "completed":
+                    break
+                await asyncio.sleep(0.05)
+            assert batch["status"] == "completed"
+            assert batch["request_counts"] == {
+                "total": 6, "completed": 5, "failed": 1}
+            out_id = batch["output_file_id"]
+            async with s.get(f"{url}/v1/files/{out_id}/content") as r:
+                out_lines = [json.loads(x) for x in
+                             (await r.read()).decode().splitlines()]
+        by_cid = {o["custom_id"]: o for o in out_lines}
+        assert set(by_cid) == {f"req-{i}" for i in range(5)} | {"req-bad"}
+        ok = by_cid["req-0"]["response"]
+        assert ok["status_code"] == 200
+        assert ok["body"]["choices"][0]["message"]["content"]
+        assert by_cid["req-bad"]["response"]["status_code"] == 404
+        # batch listing sees it
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{url}/v1/batches") as r:
+                assert bid in [b["id"] for b in (await r.json())["data"]]
+    finally:
+        await stop_stack(*stack[:4])
+
+
+async def test_batch_cancel_and_validation():
+    stack = await start_stack()
+    rt, worker, watcher, service, url = stack
+    try:
+        async with aiohttp.ClientSession() as s:
+            # bad endpoint rejected
+            async with s.post(f"{url}/v1/batches", json={
+                    "input_file_id": "file-x",
+                    "endpoint": "/v1/nope"}) as r:
+                assert r.status == 400
+            # missing file rejected
+            async with s.post(f"{url}/v1/batches", json={
+                    "input_file_id": "file-missing",
+                    "endpoint": "/v1/chat/completions"}) as r:
+                assert r.status == 404
+            # cancel a running batch
+            many = "\n".join(json.dumps({
+                "custom_id": f"c{i}", "url": "/v1/chat/completions",
+                "body": {"model": "api-model",
+                         "messages": [{"role": "user", "content": "x"}],
+                         "max_tokens": 64}}) for i in range(50))
+            async with s.post(f"{url}/v1/files", json={
+                    "purpose": "batch", "filename": "big.jsonl",
+                    "content": many}) as r:
+                fid = (await r.json())["id"]
+            async with s.post(f"{url}/v1/batches", json={
+                    "input_file_id": fid,
+                    "endpoint": "/v1/chat/completions"}) as r:
+                bid = (await r.json())["id"]
+            async with s.post(f"{url}/v1/batches/{bid}/cancel") as r:
+                assert r.status == 200
+            for _ in range(100):
+                async with s.get(f"{url}/v1/batches/{bid}") as r:
+                    b = await r.json()
+                if b["status"] in ("cancelled", "completed"):
+                    break
+                await asyncio.sleep(0.05)
+            assert b["status"] in ("cancelled", "completed")
+    finally:
+        await stop_stack(*stack[:4])

@@ -149,6 +149,7 @@ def _sla_planner(cfg, conn, pm):
     p.config = cfg
     p.connector = conn
     p.observer = _FakeObserver()
+    p.fpm = None
     p.predictor = make_predictor("constant")
     p.rate_predictor = make_predictor("constant")
     p.perf_model = pm
@@ -284,3 +285,76 @@ async def test_sla_planner_e2e_profile_then_plan_mocker():
                                     mean_kv_usage=0.2, mean_isl=64)
     applied = await p.tick()
     assert applied == min(8, math.ceil(32 / cap))
+
+
+# ------------------------------- FPM --------------------------------------
+
+
+async def test_fpm_observer_derives_itl_and_prefill_rate():
+    """The FpmObserver turns per-program dispatch records into a fleet
+    decode ITL (gap per fused step) and a prefill token rate."""
+    from dynamo_tpu.planner.metrics import FpmObserver
+
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex).start()
+    obs = await FpmObserver(rt, "dynamo", "backend").start()
+    await asyncio.sleep(0.05)  # let the subscription attach
+    subj = "fpm.dynamo.backend"
+    # 16-step bursts dispatched every 64ms -> 4ms per token-step
+    await rt.event_plane.publish(subj, {"worker_id": 1, "steps": [
+        {"t": i * 0.064, "kind": "decode", "k": 16, "lanes": 8,
+         "gap_s": 0.064} for i in range(10)
+    ]})
+    # two prefill programs ~0.1s apart totalling 4096 tokens
+    await rt.event_plane.publish(subj, {"worker_id": 1, "steps": [
+        {"t": 0.0, "kind": "prefill", "rows": 2, "tokens": 2048},
+    ]})
+    await asyncio.sleep(0.1)
+    await rt.event_plane.publish(subj, {"worker_id": 1, "steps": [
+        {"t": 0.1, "kind": "prefill", "rows": 2, "tokens": 2048},
+    ]})
+    await asyncio.sleep(0.05)
+    assert abs(obs.decode_itl_s() - 0.004) < 1e-6
+    rate = obs.prefill_tokens_per_s()
+    assert rate > 0  # window spans the two publishes
+    await obs.close()
+    await rt.shutdown()
+
+
+async def test_sla_planner_consumes_live_fpm_stream():
+    """End-to-end: FPM records published on the event plane reach the SLA
+    planner's perf-model regression (the correction moves toward the
+    measured ITL, and the tick diagnostics carry fpm_itl_s)."""
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex).start()
+    pm = PerfModel(synthetic_profile())
+    pcfg = PlannerConfig(mode="sla", itl_target_s=0.007, cooldown_s=0.0,
+                         min_replicas=1, max_replicas=8, max_step=8,
+                         consume_fpm=True)
+    conn = _FakeConnector(replicas=1)
+    p = Planner(rt, "dynamo", "backend", conn, config=pcfg, perf_model=pm)
+    await p.start()
+    await asyncio.sleep(0.05)  # let the subscriptions attach
+    try:
+        # the model predicts ~6ms at c=4; the live fleet measures 12ms
+        await rt.event_plane.publish("fpm.dynamo.backend", {
+            "worker_id": 7, "steps": [
+                {"t": i * 0.2, "kind": "decode", "k": 16, "lanes": 4,
+                 "gap_s": 0.192} for i in range(8)
+            ]})
+        await rt.event_plane.publish(
+            "load_metrics.dynamo.backend",
+            {"worker_id": 7, "active_seqs": 4, "kv_usage": 0.2,
+             "requests_total": 10, "prompt_tokens_total": 1280,
+             "itl_ema_s": 0.001})  # the coarse EMA disagrees; FPM wins
+        await asyncio.sleep(0.1)
+        before = pm.itl_correction
+        await p.tick()
+        assert pm.itl_correction > before  # corrected UP toward 12ms
+        assert p.fpm is not None
+        assert abs(p.fpm.decode_itl_s() - 0.012) < 1e-6
+    finally:
+        await p.close()
+        await rt.shutdown()
