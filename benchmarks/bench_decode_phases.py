@@ -19,7 +19,7 @@ and prints a table with achieved GB/s per phase vs the v5e 819 GB/s pin.
 Run on the chip:  python benchmarks/bench_decode_phases.py
 """
 
-import sys
+import argparse
 import time
 from functools import partial
 
@@ -28,7 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 # phase selection: e.g. `python bench_decode_phases.py attn kv_write`
-_SEL = set(sys.argv[1:])
+# (populated from argv by the __main__ block; empty = all phases)
+_SEL = set()
 
 
 def want(tag: str) -> bool:
@@ -271,4 +272,11 @@ def main():
 
 
 if __name__ == "__main__":
+    p = argparse.ArgumentParser(
+        description="per-phase decode profiler (see module docstring)")
+    p.add_argument("phases", nargs="*",
+                   help="phase tags to run: full full_jnp weights attn "
+                        "attn_debug attn_jnp attn_jaxlib kv_write sample "
+                        "(default: all)")
+    _SEL = set(p.parse_args().phases)
     main()

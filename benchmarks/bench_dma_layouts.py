@@ -16,6 +16,8 @@ Prints GB/s for each.  Run: python benchmarks/bench_dma_layouts.py
 """
 
 import functools
+import os
+import sys
 import time
 
 import jax
@@ -23,6 +25,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dynamo_tpu.ops.pallas_paged_attention import (  # noqa: E402
+    tpu_compiler_params,
+)
 
 NKV, HD, BS = 8, 128, 128
 NB = 1024            # pool blocks (256 MB slab at bf16)
@@ -103,7 +112,7 @@ def make_gather(mode):
             scratch_shapes=[buf, pltpu.SemaphoreType.DMA((2,))],
         ),
         out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
@@ -147,7 +156,7 @@ def main():
             lambda i: (jax.lax.rem(i, NB // BPC), 0, 0, 0))],
         out_specs=pl.BlockSpec((HD, BS), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((HD, BS), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
@@ -160,4 +169,9 @@ def main():
 
 
 if __name__ == "__main__":
+    import argparse
+
+    argparse.ArgumentParser(
+        description="raw gather-DMA layout microbench (no options; "
+                    "requires a TPU)").parse_args()
     main()

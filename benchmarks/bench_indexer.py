@@ -49,6 +49,11 @@ def bench(ix, n_workers=16, n_events=20000, blocks_per_event=16,
 
 
 def main():
+    import argparse
+
+    argparse.ArgumentParser(
+        description="KV indexer microbenchmark (no options; compares the "
+                    "python and native indexers)").parse_args()
     rows = [("python", PyKvIndexer())]
     try:
         from dynamo_tpu.router.native_indexer import NativeKvIndexer

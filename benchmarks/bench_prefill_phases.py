@@ -1,0 +1,264 @@
+"""Per-phase prefill profiler: localize where the prefill phase's MXU
+time goes on the bench geometry (llama-3b, bf16), the prefill analogue
+of bench_decode_phases.py.
+
+Round-5 verdict: prefill MFU is 0.098 and p50 TTFT flat at ~2.9s —
+prefill ran as one jitted program per padded-length bucket per sequence,
+mostly padding and serial dispatch.  This script times each phase of the
+chunked-prefill pipeline separately on the real chip:
+
+  packed      ONE packed program: S prompts' chunks concatenated into a
+              padding-free stream with segment ids (the serving path,
+              ops/packed_prefill.py)
+  batched     the legacy padded multi-row program (every row padded to
+              the packed total — what packing replaces)
+  single      S serial B=1 bucket programs (the pre-round-6 path)
+  attn        the packed causal-within-segment attention op alone
+  kv_write    the packed K/V scatter alone
+  weights     projection/MLP matmuls only (attention stubbed) — the
+              MXU-streaming bound for the packed stream
+
+and prints tokens/s plus achieved model FLOPs utilisation (MFU) per
+phase against the v5e bf16 pin.
+
+Run on the chip:  python benchmarks/bench_prefill_phases.py
+CPU smoke:        python benchmarks/bench_prefill_phases.py --model tiny \
+                      --tokens 64 --seqs 2 --ctx-blocks 4
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dynamo_tpu.models import llama            # noqa: E402
+from dynamo_tpu.ops import packed_prefill as pp  # noqa: E402
+
+PEAK_TFLOPS = 197.0  # v5e dense bf16
+
+
+def _sync(r):
+    """Close timing with a device FETCH (see bench_decode_phases)."""
+    leaf = jax.tree_util.tree_leaves(r)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+
+
+def timeit(fn, n=4, warm=1):
+    for _ in range(warm):
+        r = fn()
+    _sync(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    _sync(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="per-phase prefill profiler (see module docstring)")
+    p.add_argument("phases", nargs="*",
+                   help="phase tags: packed batched single attn kv_write "
+                        "weights (default: all)")
+    p.add_argument("--model", default="llama-3b")
+    p.add_argument("--tokens", type=int, default=2048,
+                   help="packed chunk budget (total stream tokens)")
+    p.add_argument("--seqs", type=int, default=4,
+                   help="co-scheduled prompts packed per dispatch")
+    p.add_argument("--ctx-blocks", type=int, default=16,
+                   help="block-table width per sequence")
+    p.add_argument("--block", type=int, default=128)
+    args = p.parse_args()
+    if args.seqs > args.tokens:
+        p.error(f"--seqs ({args.seqs}) must be <= --tokens "
+                f"({args.tokens})")
+    if args.tokens % args.seqs:
+        rounded = args.tokens - args.tokens % args.seqs
+        print(f"note: rounding --tokens {args.tokens} -> {rounded} "
+              f"(whole {rounded // args.seqs}-token chunks per sequence)")
+        args.tokens = rounded
+    cap = args.ctx_blocks * args.block
+    if args.tokens // args.seqs > cap:
+        # JAX clamps out-of-bounds table indices, so overflowing the
+        # per-sequence KV capacity would silently time the wrong
+        # computation instead of erroring
+        p.error(f"per-sequence chunk ({args.tokens // args.seqs} tokens) "
+                f"exceeds KV capacity --ctx-blocks*--block = {cap}")
+    sel = set(args.phases)
+
+    def want(tag):
+        return not sel or tag in sel
+
+    cfg = llama.PRESETS[args.model]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    # exclude the embedding lookup and an untied lm_head (logits run on
+    # last-token rows only) — the engine's _flops_per_token convention,
+    # so bench MFU and the FPM-stream MFU are comparable
+    skip = sum(params[k].size for k in ("embedding", "lm_head")
+               if k in params)
+    flops_per_tok = 2 * (n_params - skip)
+
+    S, T, BLOCK, MB = args.seqs, args.tokens, args.block, args.ctx_blocks
+    chunk = T // S
+    num_blocks = 1 + S * MB
+    kv = tuple(
+        jnp.zeros((cfg.n_layers, cfg.n_kv_heads, num_blocks,
+                   cfg.head_dim, BLOCK), cfg.dtype)
+        for _ in range(2)
+    )
+    rng = np.random.default_rng(0)
+    toks = rng.integers(3, cfg.vocab_size, T).astype(np.int32)
+    seg_ids = np.repeat(np.arange(S, dtype=np.int32), chunk)
+    positions = np.tile(np.arange(chunk, dtype=np.int32), S)
+    valid = np.ones(T, bool)
+    tables = np.zeros((S, MB), np.int32)
+    for s in range(S):
+        tables[s] = 1 + s * MB + np.arange(MB)
+    last_idx = (np.arange(S, dtype=np.int32) + 1) * chunk - 1
+
+    gf = flops_per_tok * T / 1e9
+    print(f"{args.model}: {S} x {chunk}-token prompts packed to T={T}; "
+          f"~{gf:.1f} GF matmul per dispatch")
+    dev = {k: jnp.asarray(v) for k, v in dict(
+        toks=toks, seg_ids=seg_ids, positions=positions, valid=valid,
+        tables=tables, last_idx=last_idx).items()}
+
+    def report(name, t, tokens, flops):
+        mfu = flops / t / (PEAK_TFLOPS * 1e12)
+        print(f"  {name:10s} {t*1e3:8.2f} ms   {tokens/t/1e3:8.1f} ktok/s"
+              f"   MFU {mfu:5.3f}")
+
+    state = {"kv": kv}
+
+    # --- packed: the serving path --------------------------------------
+    if want("packed"):
+        @jax.jit
+        def packed(params, kv, toks, positions, seg_ids, tables, last_idx,
+                   valid):
+            lg, kv = llama.prefill_packed(
+                params, cfg, kv, toks, positions, seg_ids, tables,
+                last_idx, valid)
+            return lg, kv
+
+        def run_packed():
+            lg, state["kv"] = packed(
+                params, state["kv"], dev["toks"], dev["positions"],
+                dev["seg_ids"], dev["tables"], dev["last_idx"],
+                dev["valid"])
+            return lg
+        report("packed", timeit(run_packed), T, flops_per_tok * T)
+
+    # --- batched: every row padded to the packed total -----------------
+    if want("batched"):
+        btoks = np.zeros((S, T), np.int32)
+        bpos = np.zeros((S, T), np.int32)
+        for s in range(S):
+            btoks[s, :chunk] = toks[s * chunk:(s + 1) * chunk]
+            bpos[s] = np.arange(T)
+        true_lens = np.full(S, chunk, np.int32)
+
+        @jax.jit
+        def batched(params, kv, toks, pos, tables, ctx, tl):
+            return llama.prefill_batched(params, cfg, kv, toks, pos,
+                                         tables, ctx, tl)
+
+        dd = (jnp.asarray(btoks), jnp.asarray(bpos), dev["tables"],
+              jnp.zeros(S, jnp.int32), jnp.asarray(true_lens))
+
+        def run_batched():
+            lg, state["kv"] = batched(params, state["kv"], *dd)
+            return lg
+        # padded program computes S*T token rows for T real tokens
+        report("batched", timeit(run_batched), T, flops_per_tok * T)
+
+    # --- single: serial B=1 dispatches ---------------------------------
+    if want("single"):
+        @jax.jit
+        def single(params, kv, toks, pos, table):
+            return llama.prefill(params, cfg, kv, toks, pos, table,
+                                 jnp.int32(0), jnp.int32(chunk))
+
+        sd = [(jnp.asarray(toks[s * chunk:(s + 1) * chunk]),
+               jnp.asarray(np.arange(chunk, dtype=np.int32)),
+               jnp.asarray(tables[s])) for s in range(S)]
+
+        def run_single():
+            lg = None
+            for s in range(S):
+                lg, state["kv"] = single(params, state["kv"], *sd[s])
+            return lg
+        report("single", timeit(run_single), T, flops_per_tok * T)
+
+    # --- packed attention op alone -------------------------------------
+    if want("attn"):
+        q0 = jnp.asarray(
+            rng.standard_normal((T, cfg.n_heads, cfg.head_dim)), cfg.dtype)
+
+        @jax.jit
+        def attn(q, kc, vc, tables, seg_ids, positions, valid):
+            for li in range(cfg.n_layers):
+                o = pp.packed_prefill_attention(
+                    q, kc, vc, li, tables, seg_ids, positions, valid)
+                q = (o.astype(jnp.float32) * 0.999).astype(q.dtype)
+            return q
+        # attention flops: per token ~ 2 matmuls over its own context
+        afl = 4 * cfg.n_layers * cfg.n_heads * cfg.head_dim \
+            * float(np.sum(positions + 1))
+        report("attn", timeit(lambda: attn(
+            q0, state["kv"][0], state["kv"][1], dev["tables"],
+            dev["seg_ids"], dev["positions"], dev["valid"])), T, afl)
+
+    # --- packed kv scatter alone ---------------------------------------
+    if want("kv_write"):
+        kvec = jnp.asarray(
+            rng.standard_normal((T, cfg.n_kv_heads, cfg.head_dim)),
+            cfg.dtype)
+
+        @jax.jit
+        def wr(kv, kvec, tables, seg_ids, positions, valid):
+            kc, vc = kv
+            for li in range(cfg.n_layers):
+                kc, vc = pp.write_packed_kv(kc, vc, li, kvec, kvec,
+                                            tables, seg_ids, positions,
+                                            valid)
+            return kc, vc
+
+        def run_wr():
+            state["kv"] = wr(state["kv"], kvec, dev["tables"],
+                             dev["seg_ids"], dev["positions"],
+                             dev["valid"])
+            return state["kv"][0]
+        wfl = 2 * cfg.n_layers * T * cfg.n_kv_heads * cfg.head_dim * 2
+        report("kv_write", timeit(run_wr), T, wfl)
+
+    # --- weights only (attention stubbed) ------------------------------
+    if want("weights"):
+        @jax.jit
+        def wonly(params, toks, positions):
+            x = params["embedding"][toks].astype(cfg.dtype)
+            for layer in params["layers"]:
+                h = llama.rms_norm(x, layer["attn_norm"]["norm"],
+                                   cfg.rms_eps)
+                q, k, v = llama._qkv(layer, cfg, h, positions)
+                a = q + k.repeat(cfg.n_heads // cfg.n_kv_heads, 1)
+                x = x + llama._attn_out(layer, a.reshape(T, cfg.q_dim))
+                h = llama.rms_norm(x, layer["mlp_norm"]["norm"],
+                                   cfg.rms_eps)
+                x = x + llama._mlp(layer, h)
+            return llama._logits(params, cfg, x[-1])
+        report("weights",
+               timeit(lambda: wonly(params, dev["toks"],
+                                    dev["positions"])),
+               T, flops_per_tok * T)
+
+
+if __name__ == "__main__":
+    main()

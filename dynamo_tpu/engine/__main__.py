@@ -38,6 +38,17 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--no-prefix-caching", action="store_true")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                   help="chunked-prefill token budget per scheduler step "
+                        "(bounds decode ITL during prefill bursts); "
+                        "0 = max_batch_tokens")
+    p.add_argument("--no-packed-prefill", action="store_true",
+                   help="disable packed chunked prefill (use the padded "
+                        "per-row programs)")
+    p.add_argument("--peak-tflops", type=float,
+                   default=float(os.environ.get("DYN_PEAK_TFLOPS", "0")),
+                   help="accelerator dense-bf16 peak, for prefill-phase "
+                        "MFU in the FPM stream (v5e: 197); 0 = unknown")
     p.add_argument("--host-cache-blocks", type=int, default=0,
                    help="G2 host-DRAM KV cache capacity (blocks); 0 off")
     p.add_argument("--disk-cache-dir", default="",
@@ -76,6 +87,9 @@ async def main() -> None:
         tp=args.tp,
         dp=args.dp,
         enable_prefix_caching=not args.no_prefix_caching,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
+        prefill_packed=not args.no_packed_prefill,
+        peak_tflops=args.peak_tflops,
         host_cache_blocks=args.host_cache_blocks,
         disk_cache_dir=args.disk_cache_dir or None,
         disk_cache_blocks=args.disk_cache_blocks,

@@ -59,6 +59,21 @@ class EngineConfig:
     # most of max_batch_tokens fill it together, so TTFT under queue depth
     # does not serialize.  1 disables batching (always the B=1 program).
     max_prefill_seqs: int = 4
+    # packed chunked prefill (engine/prefill.py + ops/packed_prefill.py):
+    # co-scheduled prompts/chunks concatenate into one padding-free token
+    # stream with segment ids instead of padding each row to a bucket.
+    # Auto-falls back to the padded paths for families without
+    # prefill_packed (MLA) and for capacity-dispatch MoE (segments must
+    # not share an expert-capacity pool).
+    prefill_packed: bool = True
+    # chunk budget for one packed prefill dispatch (the chunk-budget knob:
+    # bounds how long a prefill program can hold decode back, so decode
+    # ITL during a prefill burst is capped by one chunk's compute).
+    # 0 = use max_batch_tokens.
+    prefill_chunk_tokens: int = 0
+    # accelerator peak (dense bf16) TFLOP/s, for prefill-phase MFU in the
+    # FPM stream (v5e: 197).  0 = unknown; MFU omitted from records.
+    peak_tflops: float = 0.0
 
     # KVBM tiers (kvbm/): 0 disables the G2 host cache.  When enabled, the
     # scheduler offloads the coldest evictable HBM blocks to host DRAM once
@@ -135,6 +150,11 @@ class EngineConfig:
     @property
     def max_context(self) -> int:
         return self.block_size * self.max_blocks_per_seq
+
+    @property
+    def chunk_budget(self) -> int:
+        """Effective per-step prefill token budget."""
+        return self.prefill_chunk_tokens or self.max_batch_tokens
 
     def resolve_eos_ids(self) -> Tuple[int, ...]:
         """Stop-token set: explicit override > checkpoint config > default.

@@ -267,6 +267,42 @@ class JaxEngine:
             partial(self._prefill_batched_impl, self.family, self.model_cfg),
             donate_argnums=(1,),
         )
+        # packed chunked prefill (engine/prefill.py planner +
+        # ops/packed_prefill.py): the padding-free multi-sequence path.
+        # Gated off for families without prefill_packed (MLA) and for
+        # capacity-dispatch MoE, whose per-sequence expert-capacity pools
+        # a packed stream would merge (the batched path vmaps per row).
+        self._packed_prefill_ok = (
+            config.prefill_packed
+            and hasattr(self.family, "prefill_packed")
+            and not (getattr(self.model_cfg, "n_experts", 0) > 0
+                     and getattr(self.model_cfg, "moe_dispatch", "dense")
+                     == "capacity")
+        )
+        # the jit must exist whenever the FAMILY supports packing, even
+        # with packing config-disabled on this worker: a multi-host
+        # follower replays whatever step kinds its leader broadcasts,
+        # including prefill_packed
+        self._jit_prefill_packed = None
+        if hasattr(self.family, "prefill_packed"):
+            self._jit_prefill_packed = jax.jit(
+                partial(self._prefill_packed_impl, self.family,
+                        self.model_cfg),
+                donate_argnums=(1,),
+            )
+        # prefill-phase MFU bookkeeping for the FPM stream: dense matmul
+        # FLOPs per prompt token ~ 2 x params, excluding the embedding
+        # (a lookup) and an untied lm_head (logits run only on the few
+        # last-token rows, not the whole stream).  Attention FLOPs are
+        # also excluded — a lower bound that understates long-context
+        # chunks.
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree_util.tree_leaves(self.params))
+        skip = (sum(int(np.prod(self.params[k].shape))
+                    for k in ("embedding", "lm_head")
+                    if k in self.params)
+                if isinstance(self.params, dict) else 0)
+        self._flops_per_token = 2.0 * max(n_params - skip, 1)
         # sequence-parallel ring prefill: long-context path for prompts
         # beyond the largest bucket when the mesh has an sp axis
         self._jit_prefill_ring = None
@@ -335,6 +371,11 @@ class JaxEngine:
         # planner regresses its perf model on it online.
         self.fpm: deque = deque(maxlen=4096)
         self._fpm_last_decode_t = 0.0
+        self._fpm_last_prefill_t = 0.0
+        # time of the last BLOCKING device fetch (np.asarray round trip):
+        # dispatch-gap MFU is only meaningful when a sync landed inside
+        # the gap — pure async enqueues measure host time, not compute
+        self._fpm_sync_t = 0.0
 
     # -- cache ------------------------------------------------------------
     def _init_kv_cache(self):
@@ -500,6 +541,28 @@ class JaxEngine:
         )
         return tok, kv
 
+    @staticmethod
+    def _prefill_packed_impl(family, model_cfg, params, kv, toks,
+                             positions, seg_ids, tables, last_idx, valid,
+                             seeds, temps, top_ks, top_ps,
+                             lora_bank=None, lidx=None):
+        """Packed multi-sequence chunked prefill (family prefill_packed):
+        co-scheduled prompts/chunks run as ONE padding-free token stream
+        with segment ids.  First tokens are sampled per segment row; rows
+        whose prompt is not finished this chunk have their sample
+        discarded by the host."""
+        lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
+                   if lora_bank is not None else {})
+        logits, kv = family.prefill_packed(
+            params, model_cfg, kv, toks, positions, seg_ids, tables,
+            last_idx, valid, **lora_kw,
+        )
+        tok = sample_tokens(
+            logits, seeds, jnp.zeros(seeds.shape, jnp.int32), temps,
+            top_ks, top_ps,
+        )
+        return tok, kv
+
     def apply_step(self, kind: str, a: Dict[str, np.ndarray]) -> None:
         """Multi-host follower: execute one broadcast step descriptor —
         the exact jit call the leader ran, on this process's local shards
@@ -519,6 +582,17 @@ class JaxEngine:
                 jnp.asarray(a["true_lens"]), jnp.asarray(a["seeds"]),
                 jnp.asarray(a["temps"]), jnp.asarray(a["top_ks"]),
                 jnp.asarray(a["top_ps"]), *lora,
+            )
+        elif kind == "prefill_packed":
+            lora = ((self.lora_bank, jnp.asarray(a["lidx"]))
+                    if self.lora_bank is not None else (None, None))
+            _, self.kv = self._jit_prefill_packed(
+                self.params, self.kv,
+                jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
+                jnp.asarray(a["seg_ids"]), jnp.asarray(a["tables"]),
+                jnp.asarray(a["last_idx"]), jnp.asarray(a["valid"]),
+                jnp.asarray(a["seeds"]), jnp.asarray(a["temps"]),
+                jnp.asarray(a["top_ks"]), jnp.asarray(a["top_ps"]), *lora,
             )
         elif kind == "prefill":
             lora = ((self.lora_bank, jnp.int32(a["lidx"]))
@@ -1397,11 +1471,13 @@ class JaxEngine:
     def _prefill_step(self) -> None:
         """Run prefill chunks for up to max_prefill_seqs prefilling slots
         (earliest-enqueued first) in ONE program, the step's total token
-        count capped near max_batch_tokens (chunks + one decode token per
-        active slot).  A single prefilling slot takes the B=1 program;
-        concurrent arrivals share a batched program so short prompts fill
-        the budget together instead of serializing (TTFT under queue
-        depth)."""
+        count capped near the chunk budget (chunks + one decode token per
+        active slot).  Default path: PACKED chunked prefill — every
+        co-scheduled chunk concatenates into one padding-free token
+        stream with segment ids (engine/prefill.py planner).  Families
+        without prefill_packed (and capacity-MoE configs) fall back to
+        the padded B=1 / batched programs; cold long prompts on an sp
+        mesh still take the one-shot ring program."""
         pslots = sorted(
             (s for s in self._slots
              if s is not None and s.prefilling and not s.pulling),
@@ -1415,7 +1491,14 @@ class JaxEngine:
         decoding = sum(
             1 for s in self._slots if s is not None and not s.prefilling
         )
-        budget = max(c.max_batch_tokens - decoding, c.prefill_buckets[0])
+        budget = max(c.chunk_budget - decoding, c.prefill_buckets[0])
+        if len(pslots) == 1 and self._ring_eligible(pslots[0]):
+            # long-context path (see _prefill_one's rationale)
+            self._prefill_ring_one(pslots[0])
+            return
+        if self._packed_prefill_ok:
+            self._prefill_packed_step(pslots, budget)
+            return
         if len(pslots) == 1:
             self._prefill_one(pslots[0], budget)
             return
@@ -1478,10 +1561,10 @@ class JaxEngine:
             jnp.asarray(top_ps), self.lora_bank,
             jnp.asarray(lidx) if self.lora_bank is not None else None,
         )
-        self.fpm.append({
-            "t": time.monotonic(), "kind": "prefill", "rows": n,
-            "tokens": int(sum(chunks)), "bucket": bucket,
-        })
+        self._fpm_prefill(
+            rows=n, tokens=int(sum(chunks)), bucket=bucket,
+            completing=sum(1 for s, ch in zip(pslots, chunks)
+                           if s.prefill_pos + ch >= s.prompt_len))
         # fetch the sampled tokens ONLY when some row completes its
         # prompt this chunk: np.asarray is a blocking device round trip
         # (~35-100ms through the tunnel), and intermediate chunks discard
@@ -1491,18 +1574,131 @@ class JaxEngine:
         if any(s.prefill_pos + ch >= s.prompt_len
                for s, ch in zip(pslots, chunks)):
             firsts = np.asarray(tok)
+            self._fpm_sync_t = time.monotonic()
         for i, (slot, chunk) in enumerate(zip(pslots, chunks)):
             self._finish_prefill_chunk(
                 slot, chunk,
                 int(firsts[i]) if firsts is not None else -1)
 
+    def _fpm_prefill(self, rows: int, tokens: int, bucket: int,
+                     packed: bool = False, completing: int = 0) -> None:
+        """One FPM record per prefill program — the inputs the SLA
+        planner's FpmObserver turns into prefill-phase MFU and pressure.
+
+        Beyond (rows, tokens, bucket) the record carries:
+
+        - gap_s: dispatch-to-dispatch gap (the decode records'
+          convention).  The gap spans everything between two prefill
+          dispatches — interleaved decode steps included — and jit
+          dispatch is async, so it only reflects device time when a
+          blocking fetch landed inside it.
+        - flops: dense-matmul estimate for the chunk.  When the config
+          pins the platform peak (peak_tflops) AND a device sync fell
+          inside the gap, the record carries the derived mfu directly,
+          clamped to 1.0; it is an approximation biased LOW by
+          interleaved decode work and absent entirely on sync-free
+          intervals (timing each chunk exactly would need a blocking
+          fetch per dispatch, the round trip this path exists to
+          avoid — bench_prefill_phases.py measures the unbiased
+          number).
+        - queue_depth: waiting + still-prefilling slots, MINUS the
+          `completing` slots whose prompt this very dispatch finishes —
+          the burst's final record must read 0, or the observer reports
+          phantom pressure for a full window after the fleet goes
+          idle."""
+        now = time.monotonic()
+        gap = (now - self._fpm_last_prefill_t
+               if self._fpm_last_prefill_t else 0.0)
+        if gap > 1.0:
+            gap = 0.0  # idle stretch, not prefill latency: mark unknown
+        # len() of a list is an atomic read; the exact depth is advisory
+        # (this runs before _finish_prefill_chunk flips .prefilling, so
+        # completing slots still count — subtract them)
+        depth = max(0, len(self.waiting) + sum(
+            1 for s in self._slots if s is not None and s.prefilling)
+            - completing)
+        flops = tokens * self._flops_per_token
+        synced = self._fpm_sync_t >= self._fpm_last_prefill_t
+        rec = {
+            "t": now, "kind": "prefill", "rows": rows, "tokens": tokens,
+            "bucket": bucket, "packed": packed, "gap_s": gap,
+            "flops": flops, "queue_depth": depth, "synced": synced,
+        }
+        if gap > 0.0 and self.config.peak_tflops > 0.0 and synced:
+            # only when a blocking device fetch landed inside the gap:
+            # jit dispatch is async, so a sync-free gap measures host
+            # enqueue time, not chunk compute, and flops/gap would
+            # overstate MFU without bound.  Clamped at 1.0 — a sync near
+            # the interval's start can still leave gap short of the full
+            # device time.
+            rec["mfu"] = min(
+                flops / gap / (self.config.peak_tflops * 1e12), 1.0)
+        self.fpm.append(rec)
+        self._fpm_last_prefill_t = now
+
+    def _prefill_packed_step(self, pslots, budget: int) -> None:
+        """One packed prefill dispatch: the planner water-fills the token
+        budget across the prefilling slots and concatenates their chunks
+        (including prefix-cache-hit tails, which start at prefill_pos >
+        0) into a single padding-free stream — one program, one shape
+        family, no per-row bucket padding (the round-5 0.098-MFU fix)."""
+        from .prefill import plan_packed_prefill
+
+        c = self.config
+        plan = plan_packed_prefill(
+            pslots, budget, block_size=c.block_size,
+            max_blocks_per_seq=c.max_blocks_per_seq,
+            min_bucket=c.prefill_buckets[0],
+            with_lora=self.lora_bank is not None,
+        )
+        if plan is None:
+            return
+        a = plan.arrays
+        if self.step_sink is not None:
+            self.step_sink("prefill_packed", dict(a))
+        tok, self.kv = self._jit_prefill_packed(
+            self.params, self.kv,
+            jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
+            jnp.asarray(a["seg_ids"]), jnp.asarray(a["tables"]),
+            jnp.asarray(a["last_idx"]), jnp.asarray(a["valid"]),
+            jnp.asarray(a["seeds"]), jnp.asarray(a["temps"]),
+            jnp.asarray(a["top_ks"]), jnp.asarray(a["top_ps"]),
+            self.lora_bank,
+            jnp.asarray(a["lidx"]) if self.lora_bank is not None else None,
+        )
+        self._fpm_prefill(
+            rows=len(plan.slots), tokens=plan.tokens, bucket=plan.bucket,
+            packed=True,
+            completing=sum(1 for s, ch in zip(plan.slots, plan.chunks)
+                           if s.prefill_pos + ch >= s.prompt_len))
+        # blocking token fetch only when some segment completes its
+        # prompt this chunk (see _prefill_step: intermediate chunks
+        # discard the sample)
+        firsts = None
+        if any(s.prefill_pos + ch >= s.prompt_len
+               for s, ch in zip(plan.slots, plan.chunks)):
+            firsts = np.asarray(tok)
+            self._fpm_sync_t = time.monotonic()
+        for i, (slot, chunk) in enumerate(zip(plan.slots, plan.chunks)):
+            self._finish_prefill_chunk(
+                slot, chunk,
+                int(firsts[i]) if firsts is not None else -1)
+
+    def _ring_eligible(self, slot: "_Slot") -> bool:
+        """A cold (prefill_pos == 0), non-LoRA prompt longer than the
+        largest bucket takes the one-shot sequence-parallel ring program
+        when the mesh has one — one predicate for both the packed
+        scheduler and the padded fallback, so they can never route the
+        same slot differently."""
+        return (self._jit_prefill_ring is not None
+                and slot.prefill_pos == 0
+                and slot.prompt_len > self.config.prefill_buckets[-1]
+                and slot.lora_idx == 0)
+
     def _prefill_one(self, slot: "_Slot", budget: int) -> None:
         """The B=1 chunk program (single prefilling slot)."""
         c = self.config
-        if (self._jit_prefill_ring is not None
-                and slot.prefill_pos == 0
-                and slot.prompt_len > c.prefill_buckets[-1]
-                and slot.lora_idx == 0):
+        if self._ring_eligible(slot):
             # long-context path: one sequence-parallel program computes
             # the whole prompt with ring attention — the O(T^2) FLOPs
             # shard over sp devices instead of chunk-serializing on each.
@@ -1541,14 +1737,16 @@ class JaxEngine:
             jnp.int32(slot.lora_idx) if self.lora_bank is not None
             else None,
         )
-        self.fpm.append({
-            "t": time.monotonic(), "kind": "prefill", "rows": 1,
-            "tokens": int(chunk), "bucket": bucket,
-        })
+        self._fpm_prefill(
+            rows=1, tokens=int(chunk), bucket=bucket,
+            completing=int(slot.prefill_pos + chunk >= slot.prompt_len))
         # blocking token fetch only on the completing chunk (see
         # _prefill_step: intermediate chunks discard the sample)
-        first = int(np.asarray(tok)) \
-            if pos + chunk >= slot.prompt_len else -1
+        if pos + chunk >= slot.prompt_len:
+            first = int(np.asarray(tok))
+            self._fpm_sync_t = time.monotonic()
+        else:
+            first = -1
         self._finish_prefill_chunk(slot, chunk, first)
 
     def _prefill_ring_one(self, slot: "_Slot") -> None:
@@ -1677,12 +1875,27 @@ class JaxEngine:
                             nbytes)
                     pulled += n
             finally:
-                if nxt is not None and not nxt.done():
-                    nxt.cancel()
                 if nxt is not None:
+                    nxt.cancel()  # no-op if already done
                     try:
                         await nxt
-                    except (asyncio.CancelledError, Exception):
+                    except asyncio.CancelledError:
+                        # suppress only the prefetch future's OWN
+                        # cancellation; re-raise when the pull TASK is
+                        # being externally cancelled — either the
+                        # prefetch ended uncancelled (the error must be
+                        # ours), or (py3.11+) current_task reports a
+                        # cancel that arrived while we awaited the
+                        # self-cancelled prefetch — so the metrics/
+                        # finish code below stops running after cancel
+                        # instead of racing the teardown
+                        cur = asyncio.current_task()
+                        if not nxt.cancelled() or (
+                                cur is not None
+                                and getattr(cur, "cancelling",
+                                            lambda: 0)() > 0):
+                            raise
+                    except Exception:
                         pass
             self.metrics["pull_blocks"] = (
                 self.metrics.get("pull_blocks", 0) + pulled)
@@ -2374,6 +2587,7 @@ class JaxEngine:
         guarantees were overwritten only by later dispatches)."""
         e = self._inflight.popleft()
         arr = np.asarray(e["burst"])  # [k, B]
+        self._fpm_sync_t = time.monotonic()
         for i, ident in e["lanes"].items():
             s = self._slots[i] if i < len(self._slots) else None
             if s is None or (self._seq_id(s), s.epoch) != ident \

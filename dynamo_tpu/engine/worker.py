@@ -104,6 +104,10 @@ class JaxEngineWorker:
                 "tp": self.config.tp,
                 "dp": self.config.dp,
                 "role": self.config.role,
+                # chunked-prefill scheduling knobs (engine/prefill.py):
+                # routers/planners can see each worker's chunk budget
+                "prefill_chunk_tokens": self.config.chunk_budget,
+                "prefill_packed": self.config.prefill_packed,
                 **({"reasoning_parser": self.config.reasoning_parser}
                    if self.config.reasoning_parser else {}),
             },

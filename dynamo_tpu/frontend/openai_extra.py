@@ -71,16 +71,78 @@ async def _response_payload(resp: web.StreamResponse) -> Tuple[int, Any]:
 # ---------------------------------------------------------------------------
 
 
+class UploadTooLarge(Exception):
+    """A streamed upload crossed the store's size cap (HTTP 413)."""
+
+    def __init__(self, cap: int):
+        super().__init__(f"upload exceeds the {cap}-byte limit")
+        self.cap = cap
+
+
 class FileStore:
     """Directory-backed /v1/files objects: bytes + a JSON metadata
     sidecar, ids are `file-<hex>`.  Safe ids only — names never leave the
-    store directory."""
+    store directory.  Uploads are size-capped (DYN_FILES_MAX_BYTES,
+    default 256 MiB) and multipart payloads stream to disk in bounded
+    chunks — a multi-GB body must never buffer in process memory."""
 
-    def __init__(self, root: Optional[str] = None):
+    UPLOAD_CHUNK = 64 * 1024
+
+    def __init__(self, root: Optional[str] = None,
+                 max_upload_bytes: Optional[int] = None):
         self.root = root or os.environ.get("DYN_FILES_PATH") or \
             os.path.join(tempfile.gettempdir(),
                          f"dyn-files-{os.getpid()}")
+        self.max_upload_bytes = max_upload_bytes if max_upload_bytes \
+            is not None else int(os.environ.get(
+                "DYN_FILES_MAX_BYTES", str(256 * 1024 * 1024)))
         os.makedirs(self.root, exist_ok=True)
+
+    async def stage_part(self, part) -> Tuple[str, int]:
+        """Stream one multipart body part into a temp file inside the
+        store directory (same filesystem as its final home, so adoption
+        is a rename).  Raises UploadTooLarge past the cap, removing the
+        partial file.  Disk writes run in the default executor so a
+        cap-sized upload onto a slow disk never stalls the event loop's
+        other coroutines (in-flight generate streams, health checks)."""
+        tmp = os.path.join(self.root, f".upload-{secrets.token_hex(8)}.tmp")
+        loop = asyncio.get_running_loop()
+        n = 0
+        try:
+            with open(tmp, "wb") as f:
+                while True:
+                    chunk = await part.read_chunk(self.UPLOAD_CHUNK)
+                    if not chunk:
+                        break
+                    n += len(chunk)
+                    if n > self.max_upload_bytes:
+                        raise UploadTooLarge(self.max_upload_bytes)
+                    await loop.run_in_executor(None, f.write, chunk)
+        except BaseException:
+            self.discard_staged(tmp)
+            raise
+        return tmp, n
+
+    def discard_staged(self, tmp_path: str) -> None:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+
+    def put_staged(self, tmp_path: str, nbytes: int, filename: str,
+                   purpose: str) -> Dict:
+        """Adopt a staged payload: rename into place + metadata sidecar."""
+        file_id = f"file-{secrets.token_hex(12)}"
+        bin_p, meta_p = self._paths(file_id)
+        meta = {
+            "id": file_id, "object": "file", "bytes": nbytes,
+            "created_at": int(time.time()), "filename": filename,
+            "purpose": purpose,
+        }
+        os.replace(tmp_path, bin_p)
+        with open(meta_p, "w") as f:
+            json.dump(meta, f)
+        return meta
 
     def _paths(self, file_id: str) -> Tuple[str, str]:
         if not _ID_RE.match(file_id):
@@ -458,16 +520,56 @@ class ExtraRoutes:
     # -- files ------------------------------------------------------------
 
     async def h_upload_file(self, request: web.Request) -> web.Response:
+        staged = []  # tmp paths of streamed multipart parts
+        try:
+            return await self._upload_file(request, staged.append)
+        except BaseException:
+            # the multipart stream failed (client abort, malformed
+            # boundary) AFTER the 'file' part was staged — drop the
+            # orphans before unwinding, or aborted uploads accumulate
+            # cap-sized .tmp files in the store root (adopted/discarded
+            # paths unlink as a no-op)
+            for tmp in staged:
+                self.files.discard_staged(tmp)
+            raise
+
+    async def _upload_file(self, request: web.Request,
+                           track) -> web.Response:
         purpose, filename, data = "", "upload", None
+        staged = None  # (tmp_path, nbytes) of a streamed multipart part
         ctype = request.content_type or ""
         if ctype.startswith("multipart/"):
             reader = await request.multipart()
             async for part in reader:
                 if part.name == "purpose":
-                    purpose = (await part.text()).strip()
+                    # bounded read: part.text() would buffer an
+                    # arbitrarily large part in memory, the same hole
+                    # stage_part closes for the file part
+                    raw = b""
+                    while len(raw) <= 4096:
+                        chunk = await part.read_chunk(4096)
+                        if not chunk:
+                            break
+                        raw += chunk
+                    else:
+                        if staged is not None:
+                            self.files.discard_staged(staged[0])
+                        return self.service._error(
+                            400, "'purpose' part too large")
+                    purpose = raw.decode(errors="replace").strip()
                 elif part.name == "file":
                     filename = part.filename or "upload"
-                    data = await part.read(decode=False)
+                    if staged is not None:  # duplicate 'file' part
+                        self.files.discard_staged(staged[0])
+                    # stream to disk in bounded chunks with a hard size
+                    # cap — part.read() would buffer an unbounded body
+                    # in memory (ADVICE r5, medium)
+                    try:
+                        staged = await self.files.stage_part(part)
+                        track(staged[0])
+                    except UploadTooLarge as e:
+                        return self.service._error(
+                            413, str(e), "request_too_large")
         else:
             # JSON convenience shape: {"purpose": ..., "filename": ...,
             # "content": "<jsonl text>"} — curl-able without multipart
@@ -480,11 +582,22 @@ class ExtraRoutes:
             filename = body.get("filename", "upload")
             content = body.get("content")
             data = content.encode() if isinstance(content, str) else None
-        if data is None:
+            if data is not None and len(data) > self.files.max_upload_bytes:
+                return self.service._error(
+                    413, str(UploadTooLarge(self.files.max_upload_bytes)),
+                    "request_too_large")
+        if staged is None and data is None:
             return self.service._error(400, "no file content provided")
         if not purpose:
+            if staged is not None:
+                self.files.discard_staged(staged[0])
             return self.service._error(400, "'purpose' is required")
-        return web.json_response(self.files.put(data, filename, purpose))
+        if staged is not None:
+            meta = self.files.put_staged(staged[0], staged[1], filename,
+                                         purpose)
+        else:
+            meta = self.files.put(data, filename, purpose)
+        return web.json_response(meta)
 
     async def h_list_files(self, request: web.Request) -> web.Response:
         return web.json_response(

@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional, Tuple  # noqa: F401 (Tuple in cfg)
 import jax
 import jax.numpy as jnp
 
+from ..ops.packed_prefill import packed_prefill_attention, write_packed_kv
 from ..ops.paged_attention import (
     paged_attention_decode,
     paged_prefill_attention,
@@ -47,6 +48,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # decode attention path: "auto" | "pallas" | "pallas_interpret" | "jnp"
     attn_impl: str = "auto"
+    # packed-prefill attention path: "auto"/"xla" (the reference path;
+    # "pallas" reserved for a future hand-tiled kernel)
+    packed_attn_impl: str = "auto"
     # stop-token set (instruct checkpoints often declare several, e.g.
     # llama-3's <|end_of_text|> and <|eot_id|>)
     eos_token_ids: Tuple[int, ...] = (2,)
@@ -538,6 +542,56 @@ def prefill_batched(
             x = x + _ffn(layer, cfg, h, valid=valid)
     last = jnp.maximum(true_lens - 1, 0)
     xl = x[jnp.arange(Bp), last]  # [Bp, d]
+    logits = _logits(params, cfg, xl)
+    return logits, (k_cache, v_cache)
+
+
+def prefill_packed(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    kv_cache: Tuple[jax.Array, jax.Array],
+    token_ids: jax.Array,      # [T] int32 packed stream (tail padded)
+    positions: jax.Array,      # [T] int32 absolute position per token
+    seg_ids: jax.Array,        # [T] int32 segment row per token
+    block_tables: jax.Array,   # [S, mb] int32 per-segment block tables
+    last_idx: jax.Array,       # [S] int32 packed index of each segment's
+    #                            last token this chunk (0 for unused rows)
+    valid: jax.Array,          # [T] bool: False on the padded tail
+    lora_bank=None,            # stacked adapter bank (lora/bank.py)
+    adapter_idx=None,          # [T] int32: bank slot PER TOKEN
+):
+    """Packed multi-sequence prefill: several prompts' chunks (or
+    prefix-hit tails) run as ONE padding-free token stream with segment
+    ids (ops/packed_prefill.py) — the MFU path that replaces the padded
+    per-row batched program.  Semantically identical to running `prefill`
+    per sequence: K/V scatter into each token's own blocks, attention is
+    causal-within-segment over each segment's paged context.
+
+    NOTE: capacity-dispatch MoE is NOT packed-safe (segments would share
+    one expert-capacity pool and capacity-drop each other's tokens); the
+    engine routes those configs to the per-row batched program instead.
+
+    Returns (logits [S, vocab] at each segment's last packed token,
+    updated kv_cache)."""
+    k_cache, v_cache = kv_cache
+    T = token_ids.shape[0]
+    x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
+    for li, layer in enumerate(params["layers"]):
+        lctx = _lora_ctx(lora_bank, adapter_idx, li)
+        h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
+        q, k, v = _qkv(layer, cfg, h, positions, lora=lctx)  # [T, nh, hd]
+        k_cache, v_cache = write_packed_kv(
+            k_cache, v_cache, li, k, v, block_tables, seg_ids, positions,
+            valid,
+        )
+        attn = packed_prefill_attention(
+            q, k_cache, v_cache, li, block_tables, seg_ids, positions,
+            valid, impl=cfg.packed_attn_impl,
+        )
+        x = x + _attn_out(layer, attn.reshape(T, cfg.q_dim), lora=lctx)
+        h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
+        x = x + _ffn(layer, cfg, h, valid=valid)
+    xl = x[last_idx]  # [S, d]
     logits = _logits(params, cfg, xl)
     return logits, (k_cache, v_cache)
 

@@ -1,3 +1,4 @@
+from .packed_prefill import packed_prefill_attention, write_packed_kv
 from .paged_attention import (
     paged_attention_decode,
     paged_prefill_attention,
@@ -6,8 +7,10 @@ from .paged_attention import (
 )
 
 __all__ = [
+    "packed_prefill_attention",
     "paged_attention_decode",
     "paged_prefill_attention",
+    "write_packed_kv",
     "write_prompt_kv",
     "write_token_kv",
 ]

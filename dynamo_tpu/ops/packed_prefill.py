@@ -1,0 +1,156 @@
+"""Packed multi-sequence prefill over the paged KV cache.
+
+The padding killer for the prefill phase (round-5 verdict: prefill MFU
+0.098 while decode sits at 0.76 of its roofline).  The batched prefill
+path pads EVERY co-scheduled row to the largest chunk's bucket, so a
+(100, 500, 37, 1800)-token admission wave computes 4x2048 padded tokens
+for 2437 real ones — and the B=1 path serializes one jit dispatch per
+sequence per bucket on top.  Here multiple prompts (and prompt TAILS
+after prefix-cache hits) concatenate into ONE padding-free token stream
+with segment ids:
+
+    tokens    [T]      packed stream (chunks back to back, tail padded)
+    seg_ids   [T]      which segment row each token belongs to
+    positions [T]      each token's ABSOLUTE position in its sequence
+    tables    [S, mb]  per-segment block tables (mb sliced+bucketed to
+                       the blocks this dispatch actually touches)
+    valid     [T]      False for the padded tail (writes -> garbage)
+
+KV writes scatter each token into its own segment's paged block first;
+attention then reads everything — cached prefix AND this chunk — back
+through the block table, masked causal-within-segment by absolute
+position (token t sees its segment's cache positions [0, positions[t]]).
+Because the chunk's K/V are in the cache before attention runs, chunk
+boundaries need no special casing: later chunks of the same prompt (even
+co-packed in one dispatch at consecutive positions) attend to earlier
+ones exactly like a prefix-cache hit.
+
+The attention is flash-style: an online-softmax (running max / sum)
+lax.scan over block-column chunks of the gathered context, so the score
+matrix never materializes beyond [T, nh, chunk].  One pass runs per
+segment row (S is small — max_prefill_seqs); each pass computes scores
+for the whole packed stream and masks foreign tokens out, an S-fold
+attention-FLOP overhead traded for zero padding on the projection/MLP
+FLOPs that dominate prefill at serving context lengths.  `impl` selects
+the implementation: "xla"/"auto" is this reference path; a hand-tiled
+Pallas kernel (per-token-block segment-aware iteration, no S-fold
+overhead) can slot in behind impl="pallas" when written.
+
+Shape/layout conventions match ops/paged_attention.py: cache
+[L, nkv, nb, hd, bs] head-major transposed blocks, physical block 0 is
+garbage, all shapes static.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .paged_attention import NEG_INF, _gather_ctx, _gqa_out, _gqa_scores
+
+
+def write_packed_kv(
+    k_cache: jax.Array,       # [L, nkv, nblocks, hd, bs]
+    v_cache: jax.Array,
+    layer: int,
+    k: jax.Array,             # [T, nkv, hd] packed-stream keys
+    v: jax.Array,
+    block_tables: jax.Array,  # [S, mb] int32
+    seg_ids: jax.Array,       # [T] int32 segment row per token
+    positions: jax.Array,     # [T] int32 absolute position per token
+    valid: jax.Array,         # [T] bool (False = padded tail)
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter a packed chunk's K/V into each token's own sequence blocks
+    (one flat scatter; sequences own disjoint blocks, padding tokens land
+    in the garbage block)."""
+    bs = k_cache.shape[4]
+    blocks = block_tables[seg_ids, positions // bs]  # [T]
+    offsets = positions % bs
+    blocks = jnp.where(valid, blocks, 0)
+    k_cache = k_cache.at[layer, :, blocks, :, offsets].set(
+        k.astype(k_cache.dtype), mode="drop"
+    )
+    v_cache = v_cache.at[layer, :, blocks, :, offsets].set(
+        v.astype(v_cache.dtype), mode="drop"
+    )
+    return k_cache, v_cache
+
+
+def _segment_flash(q, k_cache, v_cache, layer, table, token_mask,
+                   positions, chunk_cols):
+    """One segment row's flash pass: online-softmax scan over chunks of
+    `chunk_cols` block columns of the segment's paged context.  Returns
+    fp32 attention output [T, nh, hd] for every packed token (foreign
+    tokens produce junk the caller masks out)."""
+    T, nh, hd = q.shape
+    bs = k_cache.shape[4]
+    mb = table.shape[0]
+    n_chunks = -(-mb // chunk_cols)
+    pad = n_chunks * chunk_cols - mb
+    if pad:
+        table = jnp.pad(table, (0, pad))  # padded columns hit garbage
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+
+    def body(carry, jc):
+        m, l, acc = carry
+        cols = jax.lax.dynamic_slice(table, (jc * chunk_cols,),
+                                     (chunk_cols,))
+        k_c = _gather_ctx(k_cache, layer, cols)  # [nkv, C, hd]
+        v_c = _gather_ctx(v_cache, layer, cols)
+        C = chunk_cols * bs
+        s = _gqa_scores(q, k_c) * scale          # [T, nh, C] fp32
+        span = jc * C + jnp.arange(C)
+        mask = token_mask[:, None, None] \
+            & (span[None, None, :] <= positions[:, None, None])
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(-1)
+        acc = acc * alpha[..., None] + _gqa_out(p, v_c)
+        return (m_new, l_new, acc), None
+
+    init = (
+        jnp.full((T, nh), NEG_INF, jnp.float32),
+        jnp.zeros((T, nh), jnp.float32),
+        jnp.zeros((T, nh, hd), jnp.float32),
+    )
+    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    return acc / jnp.maximum(l, 1e-20)[..., None]
+
+
+def packed_prefill_attention(
+    q: jax.Array,             # [T, nh, hd] packed-stream queries (rope'd)
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    layer: int,
+    block_tables: jax.Array,  # [S, mb]
+    seg_ids: jax.Array,       # [T]
+    positions: jax.Array,     # [T]
+    valid: jax.Array,         # [T]
+    impl: str = "auto",
+    chunk_cols: int = 8,      # block columns per flash step
+) -> jax.Array:
+    """Causal-within-segment attention for a packed prefill chunk.
+
+    Every token attends to its OWN segment's paged cache over absolute
+    positions [0, positions[t]] — cached prefix plus the chunk itself,
+    whose K/V write_packed_kv already scattered in.  impl: "auto"/"xla"
+    (this XLA reference); "pallas" is reserved for a future hand-tiled
+    kernel.
+    """
+    if impl not in ("auto", "xla"):
+        raise ValueError(
+            f"unknown packed-prefill impl {impl!r}; expected auto | xla "
+            "(pallas path not yet implemented)"
+        )
+    S = block_tables.shape[0]
+    out = jnp.zeros(q.shape, jnp.float32)
+    for s in range(S):  # static unroll: S = co-scheduled segment rows
+        seg_mask = (seg_ids == s) & valid
+        o_s = _segment_flash(q, k_cache, v_cache, layer, block_tables[s],
+                             seg_mask, positions, chunk_cols)
+        out = jnp.where(seg_mask[:, None, None], o_s, out)
+    return out.astype(q.dtype)

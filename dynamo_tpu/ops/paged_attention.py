@@ -21,10 +21,15 @@ Conventions:
     scalars and enforced with masks, so XLA compiles one program per bucket.
 
 These are the jnp reference implementations — numerically exact, fully
-fused-able by XLA.  ops/pallas_paged_attention.py is the hand-tiled fast
-path for decode; the two are interchangeable and cross-checked in
-tests/test_paged_attention.py.  `paged_attention_decode` dispatches between
-them ("auto" = Pallas on TPU, jnp elsewhere).
+fused-able by XLA.  ops/pallas_paged_attention.py is the hand-tiled
+Pallas decode kernel; the two are interchangeable and cross-checked in
+tests/test_paged_attention.py.  `paged_attention_decode` dispatches
+between them: "auto" selects the jnp/XLA gather path (measured FASTER
+than the Pallas kernel on this platform — see the impl="auto" rationale
+in paged_attention_decode; the kernel stays available via
+impl="pallas"), and "jnp_bf16" keeps matmul operands in the cache dtype
+with fp32 accumulation (the serving fast path; "jnp" upcasts to fp32
+for exact test numerics).
 """
 
 from __future__ import annotations
@@ -295,9 +300,12 @@ def paged_attention_decode(
 ) -> jax.Array:
     """Single-token batched paged attention (the decode hot loop).
 
-    impl: "auto" (Pallas kernel on TPU, jnp elsewhere), "pallas",
+    impl: "auto" (the jnp/XLA gather path — measured faster than the
+    Pallas kernel on this platform, see below), "pallas",
     "pallas_interpret" (kernel under the interpreter — CPU testing),
-    or "jnp".
+    "jnp" (fp32-upcast operands: exact reference numerics for tests), or
+    "jnp_bf16" (operands stay in the cache dtype, fp32 accumulation —
+    the bandwidth-friendly serving variant of the jnp path).
 
     mesh: required for the Pallas path when the kv cache is tensor-parallel
     (kv_heads sharded over a "tp" axis) — the kernel then runs under

@@ -57,6 +57,20 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def tpu_compiler_params(**kwargs):
+    """pltpu compiler-params across jax versions: the class was named
+    TPUCompilerParams before jax 0.5.x and CompilerParams after (found
+    by the tier-1 interpreter cross-checks when the toolchain moved)."""
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+    if cls is None:
+        raise AttributeError(
+            "jax.experimental.pallas.tpu exposes neither CompilerParams "
+            "nor TPUCompilerParams; unsupported jax version"
+        )
+    return cls(**kwargs)
+
+
 def _decode_kernel(
     # scalar prefetch
     tables_ref,   # [B, n_chunks * bpc] int32 physical block ids
@@ -252,7 +266,7 @@ def paged_attention_decode_pallas(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=64 * 1024 * 1024,
         ),

@@ -235,17 +235,76 @@ class FpmObserver:
         Spans use each record's OWN engine timestamp ("t", monotonic on
         that worker) per worker — a publish batches many records under
         one receive time, and monotonic clocks do not compare across
-        workers — then per-worker rates sum."""
+        workers — then per-worker rates sum.  A first-to-last dispatch
+        span excludes the LAST program's own duration, so it is scaled by
+        n/(n-1) (the mean inter-dispatch gap stands in for the missing
+        tail); a single-record window falls back to tokens/window_s
+        instead of reporting 0.0."""
         total_rate = 0.0
         for dq in self._window().values():
-            toks, t0, t1 = 0, None, None
+            toks, n, t0, t1 = 0, 0, None, None
             for _recv_t, rec in dq:
                 if rec.get("kind") != "prefill":
                     continue
                 toks += int(rec.get("tokens", 0))
+                n += 1
                 t = float(rec.get("t", 0.0))
                 t0 = t if t0 is None else min(t0, t)
                 t1 = t if t1 is None else max(t1, t)
-            if toks and t0 is not None and t1 > t0:
-                total_rate += toks / (t1 - t0)
+            if not toks:
+                continue
+            if n >= 2 and t1 > t0:
+                span = (t1 - t0) * n / (n - 1)
+            else:
+                span = self.window_s  # one dispatch: rate is a floor
+            total_rate += toks / span
         return total_rate
+
+    def prefill_mfu(self, peak_tflops: float = 0.0) -> float:
+        """Window-mean prefill-phase MFU, token-weighted across workers.
+
+        Records carrying their own `mfu` field (workers whose config
+        pins peak_tflops compute it at dispatch) always count; records
+        with only `flops` + a plausible `gap_s` fold in against the
+        caller's peak_tflops, token-weighted alongside the rest — but
+        only records marked `synced` (a blocking device fetch landed in
+        the gap; jit dispatch is async, so a sync-free gap measures host
+        enqueue time and flops/gap would overstate MFU without bound —
+        the same gate the engine applies at dispatch), and the result is
+        clamped to 1.0 like the engine's own records.  With
+        peak_tflops=0 (the planner's default: it cannot know a
+        heterogeneous fleet's peaks) fallback workers are ignored.  0.0
+        when nothing in the window carries enough to tell."""
+        w_mfu, w_tok = 0.0, 0
+        flops_total, gap_total, fb_tok = 0.0, 0.0, 0
+        for dq in self._window().values():
+            for _, rec in dq:
+                if rec.get("kind") != "prefill":
+                    continue
+                toks = int(rec.get("tokens", 0))
+                if "mfu" in rec:
+                    w_mfu += float(rec["mfu"]) * toks
+                    w_tok += toks
+                elif rec.get("flops") and rec.get("synced") \
+                        and 0.0 < float(rec.get("gap_s", 0.0)) < 1.0:
+                    flops_total += float(rec["flops"])
+                    gap_total += float(rec["gap_s"])
+                    fb_tok += toks
+        if peak_tflops > 0.0 and gap_total > 0.0 and fb_tok:
+            w_mfu += min(flops_total / gap_total
+                         / (peak_tflops * 1e12), 1.0) * fb_tok
+            w_tok += fb_tok
+        return w_mfu / w_tok if w_tok else 0.0
+
+    def prefill_queue_depth(self) -> float:
+        """Fleet chunk-queue depth: each worker's most recent prefill
+        record's `queue_depth` (waiting + still-prefilling slots at that
+        dispatch), summed across workers — the prefill-pressure signal
+        the SLA planner reads next to TTFT.  0.0 with no records."""
+        total = 0.0
+        for dq in self._window().values():
+            for _, rec in reversed(dq):
+                if rec.get("kind") == "prefill" and "queue_depth" in rec:
+                    total += float(rec["queue_depth"])
+                    break
+        return total

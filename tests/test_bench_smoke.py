@@ -1,0 +1,36 @@
+"""Importability + argparse smoke for every benchmarks/bench_*.py.
+
+The benchmarks run only on real TPU hardware, so nothing in CI executed
+them and import-time drift (renamed ops, moved modules, jax API skew)
+went unnoticed until someone sat down at a chip.  `--help` forces the
+full module import plus argument parsing and must exit 0 in a few
+seconds on CPU — cheap enough for tier-1, and it catches exactly the
+drift class that cost round 5 a bench session."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCHES = sorted(glob.glob(os.path.join(REPO, "benchmarks",
+                                        "bench_*.py")))
+
+
+def test_benchmarks_discovered():
+    # the glob must see the suite; an empty list would vacuously pass
+    assert len(BENCHES) >= 5, BENCHES
+
+
+@pytest.mark.parametrize(
+    "path", BENCHES, ids=[os.path.basename(p) for p in BENCHES])
+def test_bench_help_exits_zero(path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, path, "--help"],
+        capture_output=True, text=True, env=env, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    assert "usage" in r.stdout.lower()

@@ -322,6 +322,65 @@ async def test_fpm_observer_derives_itl_and_prefill_rate():
     await rt.shutdown()
 
 
+async def test_fpm_prefill_mfu_queue_depth_and_single_record_rate():
+    """The chunked-prefill FPM fields flow end-to-end: records produced
+    by the ENGINE's own _fpm_prefill (gap/flops/mfu/queue_depth) publish
+    onto the event plane and aggregate through the FpmObserver into
+    prefill-phase MFU and chunk-queue depth; and a window holding a
+    SINGLE prefill record reports a nonzero token rate (tokens/window_s
+    floor) instead of 0.0."""
+    import time as _time
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.planner.metrics import FpmObserver
+
+    import jax.numpy as jnp
+    tiny = LlamaConfig(name="tiny32", vocab_size=64, d_model=16,
+                       n_layers=1, n_heads=2, n_kv_heads=1, head_dim=8,
+                       ffn_dim=32, dtype=jnp.float32)
+    eng = JaxEngine(EngineConfig(model_config=tiny, block_size=4,
+                                 num_blocks=8, max_blocks_per_seq=4,
+                                 max_num_seqs=2, prefill_buckets=(8,),
+                                 peak_tflops=1e-6))
+    # two dispatch records in quick succession: the second carries a real
+    # gap, a FLOPs estimate, and (peak_tflops pinned + a device sync
+    # inside the gap) the MFU itself
+    eng._fpm_prefill(rows=1, tokens=8, bucket=8, packed=True)
+    _time.sleep(0.01)
+    eng._fpm_sync_t = _time.monotonic()  # blocking fetch inside the gap
+    eng._fpm_prefill(rows=2, tokens=16, bucket=16, packed=True)
+    recs = [r for r in eng.fpm if r["kind"] == "prefill"]
+    await eng.close()
+    assert recs[-1]["gap_s"] > 0.0 and recs[-1]["flops"] > 0
+    assert recs[-1]["mfu"] > 0.0
+    assert "queue_depth" in recs[-1]
+
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    rt = await DistributedRuntime(
+        config=cfg, cluster_id=uuid.uuid4().hex).start()
+    obs = await FpmObserver(rt, "dynamo", "backend",
+                            window_s=20.0).start()
+    await asyncio.sleep(0.05)
+    subj = "fpm.dynamo.backend"
+    await rt.event_plane.publish(subj, {"worker_id": 1, "steps": recs})
+    # a second worker that does NOT know its peak publishes flops+gap
+    # plus a single-record window for the rate fallback
+    await rt.event_plane.publish(subj, {"worker_id": 2, "steps": [
+        {"t": 5.0, "kind": "prefill", "rows": 1, "tokens": 4096,
+         "gap_s": 0.5, "flops": 1e9, "queue_depth": 3},
+    ]})
+    await asyncio.sleep(0.05)
+    assert obs.prefill_mfu() > 0.0          # from worker 1's mfu records
+    # worker 2's single record: rate floors at tokens/window_s, not 0.0
+    assert obs.prefill_tokens_per_s() > 4096 / 20.0 - 1e-6
+    # fleet chunk-queue depth sums each worker's latest record
+    depth = obs.prefill_queue_depth()
+    assert depth == recs[-1]["queue_depth"] + 3
+    await obs.close()
+    await rt.shutdown()
+
+
 async def test_sla_planner_consumes_live_fpm_stream():
     """End-to-end: FPM records published on the event plane reach the SLA
     planner's perf-model regression (the correction moves toward the
