@@ -1,0 +1,165 @@
+"""Speculative decoding: accepted tokens/s vs plain decode.
+
+Serves identical greedy workloads through two JaxEngines — spec_decode
+off and on — and reports tokens/s, the acceptance rate, and the speedup,
+across acceptance regimes:
+
+  repeat   repetition-friendly prompts (greedy streams cycle; n-gram
+           drafts from the sequence's own tail get accepted).  The
+           acceptance target is >= 1.3x accepted tokens/s over plain
+           decode here.
+  random   adversarial prompts with non-repeating continuations: the
+           per-sequence acceptance EMA must collapse draft length to 0
+           (plain pipelined decode) and hold the regression under 2%.
+
+Greedy speculative output is token-identical to plain decode by
+construction (engine/sampler.py spec_accept_tokens), and this bench
+asserts it on every run — a speedup that changes tokens is a bug, not
+a result.
+
+CPU smoke:  python benchmarks/bench_speculative.py --model tiny --tokens 96
+On a chip:  python benchmarks/bench_speculative.py --model llama-3b
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine  # noqa: E402
+from dynamo_tpu.protocols import (  # noqa: E402
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+def build_engine(args, spec: bool) -> JaxEngine:
+    cfg = EngineConfig(
+        model=args.model,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        max_blocks_per_seq=args.max_blocks_per_seq,
+        max_num_seqs=args.seqs,
+        decode_fused_steps=args.fused,
+        spec_decode=args.proposer if spec else "off",
+        spec_k=args.k,
+        # --draft-model defaults to self-drafting (same preset): an
+        # upper-bound acceptance measurement, not a deployment config
+        spec_draft_model=(args.draft_model or args.model)
+        if spec and args.proposer == "draft" else "",
+        seed=3,
+    )
+    return JaxEngine(cfg)
+
+
+def make_prompts(args, regime: str):
+    rng = np.random.default_rng(17)
+    prompts = []
+    for i in range(args.seqs):
+        if regime == "repeat":
+            phrase = list(map(int, rng.integers(5, 99, 4 + i)))
+            reps = -(-args.prompt_len // len(phrase))
+            prompts.append((phrase * reps)[: args.prompt_len])
+        else:
+            prompts.append(
+                list(map(int, rng.integers(1, 30000, args.prompt_len))))
+    return prompts
+
+
+async def serve(eng: JaxEngine, prompts, max_tokens: int):
+    async def one(i, p):
+        req = PreprocessedRequest(
+            token_ids=p, request_id=f"r{i}",
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        )
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids)
+        return toks
+
+    t0 = time.perf_counter()
+    outs = await asyncio.gather(*[one(i, p) for i, p in enumerate(prompts)])
+    dt = time.perf_counter() - t0
+    return outs, sum(len(t) for t in outs) / dt
+
+
+async def run_regime(args, regime: str):
+    prompts = make_prompts(args, regime)
+    base = build_engine(args, spec=False)
+    base_out, base_tps = await serve(base, prompts, args.tokens)
+    await base.close()
+
+    spec = build_engine(args, spec=True)
+    spec_out, spec_tps = await serve(spec, prompts, args.tokens)
+    m = spec.metrics
+    proposed = m.get("spec_proposed", 0)
+    accepted = m.get("spec_accepted", 0)
+    await spec.close()
+
+    assert spec_out == base_out, (
+        f"{regime}: speculative greedy output diverged from baseline")
+    acc = accepted / proposed if proposed else 0.0
+    speedup = spec_tps / base_tps if base_tps else 0.0
+    print(f"{regime:8s} plain {base_tps:9.1f} tok/s | spec "
+          f"{spec_tps:9.1f} tok/s | speedup {speedup:5.2f}x | "
+          f"acceptance {acc:5.2f} ({accepted}/{proposed}) | "
+          f"verify dispatches {m.get('spec_steps', 0)}")
+    return {"regime": regime, "plain_tps": base_tps, "spec_tps": spec_tps,
+            "speedup": speedup, "acceptance": acc}
+
+
+async def amain(args):
+    print(f"model={args.model} proposer={args.proposer} k={args.k} "
+          f"seqs={args.seqs} prompt={args.prompt_len} "
+          f"tokens={args.tokens} fused={args.fused}")
+    results = [await run_regime(args, r) for r in args.regimes]
+    rep = next((r for r in results if r["regime"] == "repeat"), None)
+    rnd = next((r for r in results if r["regime"] == "random"), None)
+    if rep is not None:
+        print(f"repeat-regime speedup {rep['speedup']:.2f}x "
+              f"(target >= 1.30x)")
+    if rnd is not None:
+        reg = 1.0 - rnd["speedup"]
+        print(f"random-regime regression {reg * 100:+.1f}% "
+              f"(target < 2%: adaptive k collapses to plain decode)")
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="speculative decoding: accepted tokens/s vs plain "
+                    "decode across acceptance regimes")
+    ap.add_argument("--model", default="tiny",
+                    help="model preset (tiny for CPU smoke, llama-3b on "
+                         "a chip)")
+    ap.add_argument("--proposer", default="ngram",
+                    choices=["ngram", "draft"])
+    ap.add_argument("--draft-model", default="",
+                    help="draft preset for --proposer draft (default: "
+                         "the target preset, i.e. self-drafting)")
+    ap.add_argument("--k", type=int, default=4, help="max draft tokens")
+    ap.add_argument("--seqs", type=int, default=4,
+                    help="concurrent sequences")
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--tokens", type=int, default=256,
+                    help="decode tokens per sequence")
+    ap.add_argument("--fused", type=int, default=8,
+                    help="decode_fused_steps for the plain-decode burst")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=2048)
+    ap.add_argument("--max-blocks-per-seq", type=int, default=64)
+    ap.add_argument("--regimes", nargs="+", default=["repeat", "random"],
+                    choices=["repeat", "random"])
+    args = ap.parse_args()
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

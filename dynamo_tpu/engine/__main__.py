@@ -70,6 +70,18 @@ def build_args() -> argparse.ArgumentParser:
                    help="PEFT adapter tree (lora/source.py); empty = off")
     p.add_argument("--lora-max-adapters", type=int, default=4)
     p.add_argument("--lora-rank", type=int, default=16)
+    p.add_argument("--spec-decode", default="off",
+                   choices=["off", "ngram", "draft"],
+                   help="speculative decoding proposer (spec/): ngram = "
+                        "zero-weight prompt lookup; draft = a second "
+                        "model on the same mesh (single-host v1)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="max draft tokens per speculation round "
+                        "(per-sequence acceptance EMA adapts below this)")
+    p.add_argument("--spec-draft-model", default="",
+                   help="draft model preset for --spec-decode draft")
+    p.add_argument("--spec-draft-model-path", default="",
+                   help="draft HF checkpoint dir (overrides the preset)")
     return p
 
 
@@ -101,6 +113,10 @@ async def main() -> None:
         lora_dir=args.lora_dir or None,
         lora_max_adapters=(args.lora_max_adapters if args.lora_dir else 0),
         lora_rank=args.lora_rank,
+        spec_decode=args.spec_decode,
+        spec_k=args.spec_k,
+        spec_draft_model=args.spec_draft_model,
+        spec_draft_model_path=args.spec_draft_model_path,
     )
     rt = await DistributedRuntime.detached().start()
     worker = await JaxEngineWorker(

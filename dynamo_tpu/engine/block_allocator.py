@@ -169,6 +169,39 @@ class BlockAllocator:
         res.block_id = bid
         return res
 
+    def trim_blocks(self, seq_id: str, keep: int) -> GrowResult:
+        """Free a sequence's trailing blocks beyond its first `keep`
+        (speculative-decode rollback: blocks grown to hold rejected draft
+        tokens' KV return to the free list, so the accounting matches
+        plain decode).  Trailing blocks are partial and unregistered by
+        construction — registered/shared blocks only ever sit in the
+        committed prefix, which the engine never trims past — but the
+        release mirrors free()'s full handling for safety."""
+        res = GrowResult()
+        blocks = self._seq_blocks.get(seq_id)
+        if blocks is None:
+            return res
+        while len(blocks) > max(keep, 0):
+            bid = blocks.pop()
+            rc = self._block_ref.get(bid, 1) - 1
+            if rc > 0:
+                self._block_ref[bid] = rc
+                continue
+            h = self._block_hash.get(bid)
+            if h is not None and self._hash_to_block.get(h) == bid \
+                    and self.enable_prefix_caching:
+                self._block_ref[bid] = 0
+                self._lru[h] = None
+                self._lru.move_to_end(h)
+            else:
+                self._block_ref.pop(bid, None)
+                self._block_hash.pop(bid, None)
+                self._free.append(bid)
+                if h is not None and self._hash_to_block.get(h) == bid:
+                    del self._hash_to_block[h]
+                    res.removed.append(h)
+        return res
+
     def commit_block(self, seq_id: str, block_index: int, h: int) -> GrowResult:
         """A sequence's partial block became full: register its PLH."""
         res = GrowResult()

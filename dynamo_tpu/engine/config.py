@@ -75,6 +75,42 @@ class EngineConfig:
     # FPM stream (v5e: 197).  0 = unknown; MFU omitted from records.
     peak_tflops: float = 0.0
 
+    # speculative decoding (spec/): emit more than one ACCEPTED token per
+    # weight/KV pass once decode is memory-bandwidth-bound.  "ngram" is
+    # the zero-weight prompt-lookup proposer (drafts from the sequence's
+    # own history; free when it doesn't match); "draft" runs a second,
+    # smaller model on the same mesh (greedy k-step drafts via fused
+    # decode_multi; single-host slices only in v1).  Verification scores
+    # all speculating sequences' drafts in ONE packed segment-id program
+    # (spec_verify, reusing ops/packed_prefill.py attention) and accepts
+    # via rejection sampling that provably preserves the decode sampler's
+    # distribution — greedy output is token-identical to plain decode.
+    # Guided/JSON-constrained requests, LoRA sequences, and MLA families
+    # always fall back to plain decode.  "off" disables.
+    spec_decode: str = "off"
+    # max draft tokens per speculation round.  The effective per-sequence
+    # draft length adapts BELOW this via an acceptance-rate EMA — down to
+    # 0 (= plain pipelined decode) when speculation stops paying, with a
+    # probe every spec_probe_interval generated tokens to re-engage.
+    spec_k: int = 4
+    # n-gram proposer: suffix lengths tried for the history match,
+    # longest (strongest signal) first
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
+    # draft model, first match wins: explicit config object (tests) >
+    # HF checkpoint dir > preset name.  Vocab must equal the target's.
+    spec_draft_config: Optional[object] = None
+    spec_draft_model_path: str = ""
+    spec_draft_model: str = ""
+    # acceptance EMA below this collapses the sequence to plain decode
+    spec_accept_min: float = 0.15
+    # MAX probe distance (generated tokens) for collapsed/missing slots:
+    # failed probes back off exponentially from 8 up to this cap.  Each
+    # probe on a pipelined slot costs one pipeline drain + one proposer
+    # attempt, so the cap bounds the near-zero-acceptance regression
+    # (< 2%) while mid-stream repetition is still discovered quickly.
+    spec_probe_interval: int = 64
+
     # KVBM tiers (kvbm/): 0 disables the G2 host cache.  When enabled, the
     # scheduler offloads the coldest evictable HBM blocks to host DRAM once
     # free blocks fall below offload_watermark_blocks (one batched

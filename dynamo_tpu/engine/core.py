@@ -114,6 +114,17 @@ class _Slot:
     # joining fused batch bursts
     guide: Optional[Any] = None
     guided_out: List[int] = field(default_factory=list)
+    # speculative decoding (spec/): adaptive draft length (-1 = take the
+    # engine default on first attempt; 0 = collapsed to plain decode),
+    # acceptance-rate EMA (seeded with a neutral 0.5 prior on first
+    # attempt), the generated-token count at which a collapsed/pipelined
+    # slot next probes, and the number of leading positions whose
+    # DRAFT-model KV matches the real sequence
+    spec_k_cur: int = -1
+    spec_accept_ema: float = -1.0
+    spec_probe_at: int = 0
+    spec_backoff: int = 0
+    draft_pos: int = 0
 
 
 @dataclass
@@ -137,8 +148,11 @@ class JaxEngine:
         step_sink: optional callable(kind, {name: np.ndarray}) invoked with
         every compute step's host inputs BEFORE the jit call — the
         multi-host leader broadcasts these so follower processes replay an
-        identical jit sequence (parallel/multihost.py).  v1 scope: prefill
-        and decode steps (followers require kvbm/disagg off)."""
+        identical jit sequence (parallel/multihost.py).  Covers prefill
+        (single/batched/packed/ring), decode (full/multi/continuation),
+        guided top-M, spec_verify, gather/inject, lora_write, and embed;
+        followers require kvbm/disagg off and the n-gram proposer only
+        (draft-model speculation is single-host in v1)."""
         self.config = config
         self.model_cfg = config.resolve_model()
         self.family = get_family(self.model_cfg)
@@ -290,6 +304,45 @@ class JaxEngine:
                         self.model_cfg),
                 donate_argnums=(1,),
             )
+        # speculative decoding (spec/): like prefill_packed, the verify
+        # jit exists whenever the FAMILY supports it — a multi-host
+        # follower replays whatever step kinds its leader broadcasts,
+        # spec_verify included, regardless of this worker's own config
+        self._jit_spec_verify = None
+        if hasattr(self.family, "spec_verify_packed"):
+            self._jit_spec_verify = jax.jit(
+                partial(self._spec_verify_impl, self.family,
+                        self.model_cfg),
+                donate_argnums=(1,),
+            )
+        self.proposer = None
+        self._spec_ok = False
+        if config.spec_decode != "off":
+            if config.spec_decode not in ("ngram", "draft"):
+                raise ValueError(
+                    f"spec_decode must be 'off' | 'ngram' | 'draft', "
+                    f"got {config.spec_decode!r}")
+            if self._jit_spec_verify is None:
+                # MLA families have no packed verify path in v1: serve
+                # plain decode instead of failing the worker
+                logger.warning(
+                    "model family %r has no spec_verify_packed; "
+                    "speculative decoding disabled (plain decode)",
+                    self.model_cfg.name)
+            else:
+                if config.spec_decode == "draft" and step_sink is not None:
+                    raise ValueError(
+                        "draft-model speculation is single-host in v1 "
+                        "(draft programs do not ride the step stream); "
+                        "use spec_decode='ngram' on multi-host slices")
+                from ..spec import make_proposer
+
+                self.proposer = make_proposer(config, self.mesh)
+                self._spec_ok = True
+        # slot indexes that speculated this scheduler step (they emitted
+        # synchronously and must skip the pipelined decode dispatch)
+        self._specced: frozenset = frozenset()
+        self._fpm_last_spec_t = 0.0
         # prefill-phase MFU bookkeeping for the FPM stream: dense matmul
         # FLOPs per prompt token ~ 2 x params, excluding the embedding
         # (a lookup) and an untied lm_head (logits run only on the few
@@ -563,6 +616,29 @@ class JaxEngine:
         )
         return tok, kv
 
+    @staticmethod
+    def _spec_verify_impl(family, model_cfg, params, kv, toks, positions,
+                          seg_ids, tables, valid, temps_t):
+        """Packed multi-token verification (spec/): every speculating
+        sequence's row [last_token, d1..dk] scored in ONE padding-free
+        segment-id program (family spec_verify_packed over
+        ops/packed_prefill.py), draft-position KV written in place.
+        Returns per-position top-CAP candidate ids + temperature-scaled
+        logits and the full-vocab logsumexp of the scaled logits — the
+        exact ingredients of sampler.py's masked-window categorical, so
+        the host-side acceptance test (sampler.spec_accept_tokens) draws
+        against the true target distribution."""
+        from .sampler import CAP
+
+        logits, kv = family.spec_verify_packed(
+            params, model_cfg, kv, toks, positions, seg_ids, tables,
+            valid,
+        )
+        scaled = logits / jnp.maximum(temps_t, 1e-6)[:, None]
+        vals, ids = jax.lax.top_k(scaled, CAP)
+        lse = jax.scipy.special.logsumexp(scaled, axis=-1)
+        return ids, vals, lse, kv
+
     def apply_step(self, kind: str, a: Dict[str, np.ndarray]) -> None:
         """Multi-host follower: execute one broadcast step descriptor —
         the exact jit call the leader ran, on this process's local shards
@@ -619,6 +695,15 @@ class JaxEngine:
                 self.params, self.kv, jnp.asarray(a["tokens"]),
                 jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
                 jnp.asarray(a["ctx_lens"]), jnp.asarray(a["valid"]),
+            )
+        elif kind == "spec_verify":
+            # speculative verification: the acceptance decision is the
+            # leader's; followers only need the identical KV evolution
+            _, _, _, self.kv = self._jit_spec_verify(
+                self.params, self.kv,
+                jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
+                jnp.asarray(a["seg_ids"]), jnp.asarray(a["tables"]),
+                jnp.asarray(a["valid"]), jnp.asarray(a["temps_t"]),
             )
         elif kind == "prefill_ring":
             _, self.kv = self._jit_prefill_ring(
@@ -759,6 +844,14 @@ class JaxEngine:
 
     def kv_usage(self) -> float:
         return self.allocator.usage()
+
+    @property
+    def spec_enabled(self) -> bool:
+        """Speculative decoding actually active: the config asked for it
+        AND the family supports packed verification (MLA falls back to
+        plain decode in v1) — what the worker should advertise, which
+        the raw config value alone cannot tell."""
+        return self._spec_ok
 
     async def generate(
         self, request: PreprocessedRequest, token=None
@@ -1270,6 +1363,7 @@ class JaxEngine:
             self._admit_waiting()
             self._prefill_step()
             self._guided_step()
+            self._spec_step()
             if any(s is not None and not s.prefilling for s in self._slots):
                 self._decode_step()
             elif self._inflight:
@@ -2054,6 +2148,231 @@ class JaxEngine:
         else:
             slot.out_q.put_nowait(out)
 
+    # -- speculative decoding (spec/) --------------------------------------
+    def _spec_step(self) -> None:
+        """One speculation round: propose up to k draft tokens per
+        eligible slot (n-gram prompt lookup or the draft model), score
+        all speculating slots' rows in ONE packed spec_verify program
+        (segment-id causal attention over the paged cache — the chunked
+        prefill machinery re-aimed at decode), then accept the longest
+        distribution-preserving prefix host-side (sampler.py
+        spec_accept_tokens) and roll the rejected tail's block growth
+        back through the allocator.
+
+        Slots that speculate this step skip the pipelined decode
+        dispatch (their emission is synchronous — the verify fetch IS
+        the step); everything else decodes as usual, so speculating and
+        plain sequences mix freely in one scheduler step under the same
+        token budget.  Guided/JSON-constrained slots, LoRA slots, and
+        mid-pull disagg slots never speculate.  A slot whose acceptance
+        EMA collapsed to k=0 rides the (faster, pipelined) plain decode
+        path and re-probes every spec_probe_interval generated tokens —
+        a probe is the only time the pipeline is drained on its behalf,
+        which is what bounds the near-zero-acceptance regression."""
+        self._specced = frozenset()
+        if not self._spec_ok:
+            return
+        c = self.config
+        cands = [s for s in self._slots
+                 if s is not None and not s.prefilling and not s.pulling
+                 and not s.finished and s.guide is None
+                 and s.lora_idx == 0]
+        if not cands:
+            return
+        rows = []
+        budget = c.chunk_budget
+        for s in cands:
+            # an earlier candidate's probe drain can finish/preempt LATER
+            # slots of this stale snapshot (same hazard as _decode_step's
+            # grow loop): re-check before touching the allocator
+            if s.finished or self._slots[s.index] is not s:
+                continue
+            if s.spec_k_cur < 0:
+                s.spec_k_cur = c.spec_k
+                s.spec_backoff = min(self.SPEC_PROBE_MIN,
+                                     c.spec_probe_interval)
+                # neutral prior: collapse needs a few rounds of real
+                # rejection evidence, not one unlucky first verify
+                s.spec_accept_ema = 0.5
+            if (s.spec_k_cur == 0 or s.inflight > 0) \
+                    and s.generated < s.spec_probe_at:
+                continue
+            if budget <= 1:
+                # budget exhausted BEFORE the drain below: a probe
+                # skipped here costs nothing and stays due next step —
+                # draining first would flush the decode pipeline every
+                # step for a probe that then never runs
+                break
+            if s.inflight > 0:
+                # probe of a slot sitting in the pipelined decode path:
+                # its latest tokens are device-side, so the proposer
+                # would see a stale tail — drain first
+                self._drain_inflight()
+                if s.finished or self._slots[s.index] is not s \
+                        or s.inflight:
+                    continue
+            k = max(1, s.spec_k_cur)
+            # cap by table capacity (verify touches positions
+            # [ctx, ctx+k]) and the step's remaining token budget
+            k = min(k, c.max_context - 1 - s.ctx_len, budget - 1)
+            k = self._spec_grow(s, k) if k > 0 else 0
+            if k <= 0:
+                self._spec_feedback(s, 0, 0)
+                continue
+            drafts = list(self.proposer.propose(
+                s.seq.tokens, k, ctx=s.ctx_len, draft_pos=s.draft_pos,
+                block_table=s.block_table))[:k]
+            if not drafts:
+                # nothing to try: a miss for the EMA; trim the
+                # speculative growth and let plain decode take the slot
+                self._spec_feedback(s, 0, 0)
+                self._spec_trim(s)
+                continue
+            budget -= len(drafts) + 1
+            rows.append((s, drafts))
+        if not rows:
+            return
+        from ..spec import plan_spec_verify
+
+        plan = plan_spec_verify(
+            rows, block_size=c.block_size,
+            max_blocks_per_seq=c.max_blocks_per_seq,
+        )
+        a = plan.arrays
+        if self.step_sink is not None:
+            self.step_sink("spec_verify", dict(a))
+        ids, vals, lse, self.kv = self._jit_spec_verify(
+            self.params, self.kv,
+            jnp.asarray(a["toks"]), jnp.asarray(a["positions"]),
+            jnp.asarray(a["seg_ids"]), jnp.asarray(a["tables"]),
+            jnp.asarray(a["valid"]), jnp.asarray(a["temps_t"]),
+        )
+        ids = np.asarray(ids)
+        vals = np.asarray(vals)
+        lse = np.asarray(lse)
+        self._fpm_sync_t = time.monotonic()
+        from .sampler import spec_accept_tokens
+
+        proposed_total = accepted_total = 0
+        specced = set()
+        for (s, drafts), off in zip(plan.rows, plan.offsets):
+            n = len(drafts) + 1
+            sm = s.request.sampling
+            # host-side rng stream keyed (seed, position): replayed or
+            # migrated requests re-draw identically, like the device
+            # sampler's fold_in(seed, step)
+            rng = np.random.default_rng(
+                (s.sampling_seed * 0x9E3779B1 + s.generated + 1)
+                & 0xFFFFFFFF)
+            accepted, emitted = spec_accept_tokens(
+                ids[off:off + n], vals[off:off + n], lse[off:off + n],
+                drafts, greedy=sm.temperature <= 0.0, top_k=sm.top_k,
+                top_p=sm.top_p, rng=rng)
+            proposed_total += len(drafts)
+            accepted_total += accepted
+            self._spec_feedback(s, accepted, len(drafts))
+            specced.add(s.index)
+            # the device token chain no longer feeds this lane: its true
+            # last_token is now a host-side spec emission, so a later
+            # decode burst must neither chain it nor treat the lane as a
+            # pure continuation of the pre-spec descriptor
+            self._chain_owner[s.index] = None
+            ctx0 = s.ctx_len
+            for tok in emitted:
+                s.ctx_len += 1
+                self.metrics["decode_tokens"] += 1
+                self._push_token(s, int(tok))
+                if s.finished:
+                    break
+            # the draft cache matches the real sequence through the
+            # accepted prefix (the propose pass wrote draft KV for its k
+            # INPUT positions [ctx0, ctx0+k-1]; the rejected tail is
+            # overwritten on the next round).  Capped at ctx0+k: after
+            # FULL acceptance the last draft token's own KV was never a
+            # decode input, so that position must be re-prefilled
+            s.draft_pos = min(s.ctx_len, ctx0 + len(drafts))
+            if not s.finished:
+                self._spec_trim(s)
+        self._specced = frozenset(specced)
+        self.metrics["spec_steps"] = self.metrics.get("spec_steps", 0) + 1
+        self.metrics["spec_proposed"] = \
+            self.metrics.get("spec_proposed", 0) + proposed_total
+        self.metrics["spec_accepted"] = \
+            self.metrics.get("spec_accepted", 0) + accepted_total
+        now = time.monotonic()
+        gap = (now - self._fpm_last_spec_t
+               if self._fpm_last_spec_t else 0.0)
+        if gap > 1.0:
+            gap = 0.0  # idle stretch, not verify latency: mark unknown
+        # one FPM record per verify dispatch: the acceptance-rate input
+        # FpmObserver.spec_acceptance aggregates for the SLA planner
+        self.fpm.append({
+            "t": now, "kind": "spec_verify", "lanes": len(plan.rows),
+            "proposed": proposed_total, "accepted": accepted_total,
+            "tokens": plan.tokens, "gap_s": gap,
+        })
+        self._fpm_last_spec_t = now
+
+    def _spec_grow(self, s: _Slot, k: int) -> int:
+        """Grow s's block table to cover verify positions [ctx, ctx+k];
+        under allocation pressure shrink k to what the table already
+        covers (0 = no speculation this step — plain decode handles the
+        base position, preempting if even that fails)."""
+        c = self.config
+        bs = c.block_size
+        nblocks = int(np.count_nonzero(s.block_table))
+        while nblocks * bs <= s.ctx_len + k:
+            if nblocks >= c.max_blocks_per_seq:
+                break
+            grow = self.allocator.append_block(self._seq_id(s))
+            self._emit_events(grow)
+            if grow.block_id is None:
+                break
+            s.block_table[nblocks] = grow.block_id
+            nblocks += 1
+        return min(k, nblocks * bs - 1 - s.ctx_len)
+
+    def _spec_trim(self, s: _Slot) -> None:
+        """Roll back speculative block growth: trailing blocks beyond the
+        materialized context — the rejected drafts' KV slots — return to
+        the allocator, so free-block accounting matches plain decode."""
+        keep = max(-(-s.ctx_len // self.config.block_size), 1)
+        res = self.allocator.trim_blocks(self._seq_id(s), keep)
+        self._emit_events(res)
+        s.block_table[keep:] = 0
+
+    #: first re-probe distance (generated tokens); failed probes back
+    #: off exponentially up to spec_probe_interval, so repetition that
+    #: emerges mid-stream is discovered within ~8 tokens while a
+    #: hopeless stream pays a pipeline drain only at 8/16/32/... marks
+    SPEC_PROBE_MIN = 8
+
+    def _spec_feedback(self, s: _Slot, accepted: int,
+                       proposed: int) -> None:
+        """Fold one speculation outcome into the slot's adaptivity
+        state.  A proposer MISS (proposed == 0) carries no acceptance
+        evidence — it was free if the slot wasn't pipelined — but
+        re-attempting on a pipelined slot costs a drain, so misses only
+        push the probe clock with exponential backoff.  VERIFIED rounds
+        update the acceptance EMA: high acceptance runs the full spec_k,
+        middling halves it, and an EMA below spec_accept_min collapses
+        the slot to 0 (plain pipelined decode) until a probe fires."""
+        c = self.config
+        if proposed <= 0:
+            s.spec_probe_at = s.generated + s.spec_backoff
+            s.spec_backoff = min(s.spec_backoff * 2, c.spec_probe_interval)
+            return
+        rate = accepted / proposed
+        s.spec_accept_ema = 0.7 * s.spec_accept_ema + 0.3 * rate
+        if s.spec_accept_ema < c.spec_accept_min:
+            s.spec_k_cur = 0
+            s.spec_probe_at = s.generated + s.spec_backoff
+            s.spec_backoff = min(s.spec_backoff * 2, c.spec_probe_interval)
+        else:
+            s.spec_backoff = min(self.SPEC_PROBE_MIN, c.spec_probe_interval)
+            s.spec_k_cur = c.spec_k if s.spec_accept_ema >= 0.5 \
+                else max(1, c.spec_k // 2)
+
     # -- decode -----------------------------------------------------------
     # decode burst size while prefill/admission work is pending: single
     # stepping bounds how long a chunk waits behind decode, but on this
@@ -2086,9 +2405,11 @@ class JaxEngine:
         while len(self._inflight) >= depth:
             self._process_oldest_burst()
         k = self._fused_k()
+        # slots that speculated this step already emitted synchronously
+        # (engine/_spec_step); dispatching them again would double-step
         active = [s for s in self._slots
                   if s is not None and not s.prefilling
-                  and s.guide is None]
+                  and s.guide is None and s.index not in self._specced]
         if not active:
             return
         # Every active slot MUST have a block for its next device position
@@ -2142,7 +2463,7 @@ class JaxEngine:
 
         active = [s for s in self._slots
                   if s is not None and not s.prefilling
-                  and s.guide is None]
+                  and s.guide is None and s.index not in self._specced]
         if not active:
             return
 
@@ -2675,6 +2996,9 @@ class JaxEngine:
         # processing (its lanes are keyed by (seq_id, epoch))
         slot.epoch += 1
         slot.inflight = 0
+        # the draft-model cache for the freed blocks is stale: replay
+        # re-prefills the draft from position 0 (spec/draft.py)
+        slot.draft_pos = 0
         with self._qlock:
             self.waiting.insert(0, slot)
 

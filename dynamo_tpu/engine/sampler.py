@@ -16,8 +16,11 @@ near-uniform distribution feels the cap).
 
 from __future__ import annotations
 
+from typing import List, Tuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30
 
@@ -59,6 +62,97 @@ def greedy_tokens(logits: jax.Array) -> jax.Array:
     every slot in the batch is greedy (temperature <= 0), skipping the
     sampling machinery entirely."""
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: host-side rejection sampling (spec/)
+#
+# The verify program (engine/core.py _spec_verify_impl) returns, per packed
+# position, the top-CAP candidate ids + temperature-scaled logits and the
+# full-vocab logsumexp of the scaled logits.  From those three arrays the
+# host reconstructs EXACTLY the masked-window categorical `sample_tokens`
+# draws from (same CAP window, same top-k clamp, same true-softmax top-p
+# nucleus), so acceptance decisions are made against the real target
+# distribution, not an approximation of it.
+#
+# Proposals are point masses (greedy n-gram / greedy draft model), so the
+# Leviathan rejection rule specializes to: accept draft d with probability
+# p(d); on rejection, sample from p with d's mass removed, renormalized.
+# The emitted marginal is p(d)*1[x=d] + (1-p(d)) * p(x)*1[x!=d]/(1-p(d))
+# = p(x) — the target distribution exactly, per position.  Greedy
+# (temperature <= 0) degenerates to exact argmax-prefix matching, so the
+# speculative stream is token-identical to plain greedy decode.
+# ---------------------------------------------------------------------------
+
+
+def spec_window_weights(vals: np.ndarray, lse: float, top_k: int,
+                        top_p: float) -> np.ndarray:
+    """Normalized target weights over the CAP candidate window — the same
+    masking sample_tokens applies on device.  vals: [CAP] scaled logits
+    sorted descending; lse: logsumexp of the full scaled logits."""
+    probs = np.exp(vals.astype(np.float64) - float(lse))
+    k_eff = int(np.clip(top_k if top_k > 0 else CAP, 1, CAP))
+    keep = np.arange(CAP) < k_eff
+    cum = np.cumsum(probs)
+    keep &= np.concatenate(([True], cum[:-1] < top_p))
+    w = np.where(keep, probs, 0.0)
+    s = w.sum()
+    if s <= 0.0:  # fp underflow corner: the argmax candidate stands alone
+        w = np.zeros(CAP)
+        w[0] = 1.0
+        return w
+    return w / s
+
+
+def spec_accept_tokens(
+    ids: np.ndarray,      # [n, CAP] candidate ids per position, sorted
+    vals: np.ndarray,     # [n, CAP] scaled logits per position
+    lse: np.ndarray,      # [n] full-vocab logsumexp of scaled logits
+    drafts: List[int],    # k point-mass proposals (n == k + 1)
+    *,
+    greedy: bool,
+    top_k: int,
+    top_p: float,
+    rng: np.random.Generator,
+) -> Tuple[int, List[int]]:
+    """Verify k drafted tokens against the target's per-position window
+    distributions.  Returns (accepted_count, emitted_tokens): the
+    accepted draft prefix plus exactly ONE more token — the corrected
+    sample at the first rejection, or the bonus token from the position
+    after the last draft when everything was accepted."""
+    emitted: List[int] = []
+    for i, d in enumerate(drafts):
+        if greedy:
+            t = int(ids[i, 0])
+            if t == d:
+                emitted.append(d)
+                continue
+            emitted.append(t)
+            return i, emitted
+        w = spec_window_weights(vals[i], lse[i], top_k, top_p)
+        j = np.nonzero(ids[i] == d)[0]
+        p_d = float(w[j[0]]) if len(j) else 0.0
+        if rng.random() < p_d:
+            emitted.append(d)
+            continue
+        if len(j):
+            w[j[0]] = 0.0
+        s = w.sum()
+        if s <= 0.0:
+            # the target was itself a point mass at d and the float
+            # comparison still rejected: d IS the sample
+            emitted.append(d)
+            continue
+        emitted.append(int(ids[i, rng.choice(CAP, p=w / s)]))
+        return i, emitted
+    # every draft accepted: bonus token from the last scored position
+    i = len(drafts)
+    if greedy:
+        emitted.append(int(ids[i, 0]))
+    else:
+        w = spec_window_weights(vals[i], lse[i], top_k, top_p)
+        emitted.append(int(ids[i, rng.choice(CAP, p=w)]))
+    return len(drafts), emitted
 
 
 def apply_penalties(

@@ -108,6 +108,16 @@ class JaxEngineWorker:
                 # routers/planners can see each worker's chunk budget
                 "prefill_chunk_tokens": self.config.chunk_budget,
                 "prefill_packed": self.config.prefill_packed,
+                # speculative decoding (spec/): planners/routers see the
+                # proposer and max draft length; live acceptance rides
+                # the FPM stream (spec_verify records).  Gated on the
+                # ENGINE's state, not the raw config: an MLA family
+                # silently falls back to plain decode and must not
+                # advertise a capability it doesn't serve
+                **({"speculative": {"proposer": self.config.spec_decode,
+                                    "k": self.config.spec_k}}
+                   if self.engine is not None and self.engine.spec_enabled
+                   else {}),
                 **({"reasoning_parser": self.config.reasoning_parser}
                    if self.config.reasoning_parser else {}),
             },

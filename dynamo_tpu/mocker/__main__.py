@@ -27,6 +27,11 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--num-workers", type=int, default=1)
     p.add_argument("--migration-limit", type=int, default=0)
     p.add_argument("--role", default="both", choices=["both", "prefill", "decode"])
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="simulated speculative decoding: draft tokens "
+                        "per step (0 = off)")
+    p.add_argument("--spec-acceptance", type=float, default=0.5,
+                   help="simulated per-draft acceptance probability")
     return p
 
 
@@ -42,6 +47,8 @@ async def main() -> None:
         speedup_ratio=args.speedup_ratio,
         enable_prefix_caching=not args.no_prefix_caching,
         role=args.role,
+        speculative=({"k": args.spec_k, "acceptance": args.spec_acceptance}
+                     if args.spec_k > 0 else None),
     )
     rt = await DistributedRuntime.detached().start()
     workers = []

@@ -50,6 +50,13 @@ class MockEngineArgs:
     # engines (disjoint KV caches) and exposes each as a routing target
     # (ref WorkerWithDpRank; per-rank publishers, vllm/main.py:379-425)
     dp_size: int = 1
+    # simulated speculative decoding (mirrors engine/config.py spec_*):
+    # {"k": int, "acceptance": float} — each decode step emits
+    # 1 + (geometric draft-acceptance run, capped at k) tokens per
+    # sequence and records spec_verify FPM entries, so planner/router
+    # tests exercise the acceptance plumbing without a real model.
+    # None disables.
+    speculative: Optional[dict] = None
 
 
 @dataclass
@@ -90,7 +97,16 @@ class MockEngine:
             "preemptions": 0, "cache_hit_blocks": 0, "cache_lookup_blocks": 0,
             "requests": 0, "prompt_tokens": 0,
         }
+        if args.speculative is not None:
+            self.metrics["spec_proposed"] = 0
+            self.metrics["spec_accepted"] = 0
         self.itl_ema_s = 0.0  # simulated inter-token latency (SLA planner)
+        # forward-pass-metrics ring (the JAX engine's fpm analogue): the
+        # worker drains it onto the event plane; with `speculative` set it
+        # carries spec_verify acceptance records for FpmObserver
+        from collections import deque
+
+        self.fpm: deque = deque(maxlen=4096)
 
     # -- public API -------------------------------------------------------
     def start(self) -> None:
@@ -281,40 +297,61 @@ class MockEngine:
                 self.running.remove(seq)
                 self._publish(self.cache.free(seq.request_id))
                 continue
-            tok = self._next_token(seq)
-            completed = seq.blocks.append(tok)
-            partial = seq.blocks.partial_len()
-            res = self.cache.grow(
-                seq.request_id, completed, need_new_block=(partial == 1)
-            )
-            if res is None:
-                # OOM: preempt back to waiting, replay prefill later
-                self.metrics["preemptions"] += 1
-                self.running.remove(seq)
-                free_res = self.cache.free(seq.request_id)
-                self._publish(free_res)
-                seq.prefill_pos = 0
-                self.waiting.insert(0, seq)
-                continue
-            self._publish(res)
-            seq.generated += 1
-            self.metrics["decode_tokens"] += 1
-
-            finish = self._finish_reason(seq, tok)
-            out = LLMEngineOutput(
-                token_ids=[tok],
-                finish_reason=finish,
-                metrics={
-                    "kv_usage": self.kv_usage(),
-                    "active_seqs": len(self.running),
-                } if finish else None,
-            )
-            seq.out_queue.put_nowait(out)
-            if finish is not None:
-                seq.finished = True
-                self.running.remove(seq)
-                res = self.cache.free(seq.request_id)
+            # simulated speculative decoding: 1 base token + a draft
+            # acceptance run (Bernoulli chain truncated at the first
+            # rejection, capped at k — the same longest-accepted-prefix
+            # shape the real verify step produces)
+            emit = 1
+            spec = self.args.speculative
+            if spec is not None:
+                k = max(1, int(spec.get("k", 4)))
+                acc = float(spec.get("acceptance", 0.5))
+                a = 0
+                while a < k and seq.rng.random() < acc:
+                    a += 1
+                self.metrics["spec_proposed"] += k
+                self.metrics["spec_accepted"] += a
+                self.fpm.append({
+                    "t": time.monotonic(), "kind": "spec_verify",
+                    "lanes": 1, "proposed": k, "accepted": a,
+                })
+                emit = 1 + a
+            for _ in range(emit):
+                tok = self._next_token(seq)
+                completed = seq.blocks.append(tok)
+                partial = seq.blocks.partial_len()
+                res = self.cache.grow(
+                    seq.request_id, completed, need_new_block=(partial == 1)
+                )
+                if res is None:
+                    # OOM: preempt back to waiting, replay prefill later
+                    self.metrics["preemptions"] += 1
+                    self.running.remove(seq)
+                    free_res = self.cache.free(seq.request_id)
+                    self._publish(free_res)
+                    seq.prefill_pos = 0
+                    self.waiting.insert(0, seq)
+                    break
                 self._publish(res)
+                seq.generated += 1
+                self.metrics["decode_tokens"] += 1
+
+                finish = self._finish_reason(seq, tok)
+                out = LLMEngineOutput(
+                    token_ids=[tok],
+                    finish_reason=finish,
+                    metrics={
+                        "kv_usage": self.kv_usage(),
+                        "active_seqs": len(self.running),
+                    } if finish else None,
+                )
+                seq.out_queue.put_nowait(out)
+                if finish is not None:
+                    seq.finished = True
+                    self.running.remove(seq)
+                    res = self.cache.free(seq.request_id)
+                    self._publish(res)
+                    break
 
     def _next_token(self, seq: _Seq) -> int:
         canned = self.args.canned_text

@@ -52,6 +52,11 @@ class MockerWorker:
                 "total_kv_blocks": self.args.num_blocks,
                 "max_num_seqs": self.args.max_num_seqs,
                 "role": self.args.role,
+                # simulated speculative decoding knobs (same shape the
+                # JAX worker advertises: planners/routers can see the
+                # configured draft length)
+                **({"speculative": dict(self.args.speculative)}
+                   if self.args.speculative is not None else {}),
                 **({"reasoning_parser": self.reasoning_parser}
                    if self.reasoning_parser else {}),
             },
@@ -150,10 +155,26 @@ class MockerWorker:
     async def _load_loop(self) -> None:
         """Periodic load metrics for least-loaded / KV routing cost inputs."""
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"
+        fpm_subject = f"fpm.{self.namespace}.{self.component}"
         while True:
             await asyncio.sleep(0.25)
             if self.engine is None or self.served is None:
                 continue
+            # drain the simulated FPM rings (spec_verify acceptance
+            # records) onto the same subject the JAX worker uses, so
+            # FpmObserver.spec_acceptance works against the mocker
+            steps = []
+            for eng in self.engines:
+                while eng.fpm and len(steps) < 512:
+                    steps.append(eng.fpm.popleft())
+            if steps:
+                try:
+                    await self.runtime.event_plane.publish(fpm_subject, {
+                        "worker_id": self.served.instance_id,
+                        "steps": steps,
+                    })
+                except Exception:
+                    logger.warning("fpm publish failed", exc_info=True)
             # cross-rank ITL: weight each engine's EMA by its active
             # sequences (an idle rank's stale EMA must not drag the
             # worker-level signal the SLA planner consumes); totals SUM

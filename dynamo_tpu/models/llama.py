@@ -573,6 +573,31 @@ def prefill_packed(
 
     Returns (logits [S, vocab] at each segment's last packed token,
     updated kv_cache)."""
+    x, kv_cache = _packed_forward(
+        params, cfg, kv_cache, token_ids, positions, seg_ids,
+        block_tables, valid, lora_bank, adapter_idx,
+    )
+    xl = x[last_idx]  # [S, d]
+    logits = _logits(params, cfg, xl)
+    return logits, kv_cache
+
+
+def _packed_forward(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    kv_cache: Tuple[jax.Array, jax.Array],
+    token_ids: jax.Array,      # [T] int32 packed stream (tail padded)
+    positions: jax.Array,      # [T] int32 absolute position per token
+    seg_ids: jax.Array,        # [T] int32 segment row per token
+    block_tables: jax.Array,   # [S, mb] int32 per-segment block tables
+    valid: jax.Array,          # [T] bool: False on the padded tail
+    lora_bank=None,
+    adapter_idx=None,
+):
+    """Shared packed-stream transformer body (prefill_packed and
+    spec_verify_packed): K/V scatter into each token's own blocks, then
+    causal-within-segment attention over each segment's paged context.
+    Returns (final hidden states [T, d], updated kv_cache)."""
     k_cache, v_cache = kv_cache
     T = token_ids.shape[0]
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
@@ -591,9 +616,32 @@ def prefill_packed(
         x = x + _attn_out(layer, attn.reshape(T, cfg.q_dim), lora=lctx)
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
         x = x + _ffn(layer, cfg, h, valid=valid)
-    xl = x[last_idx]  # [S, d]
-    logits = _logits(params, cfg, xl)
-    return logits, (k_cache, v_cache)
+    return x, (k_cache, v_cache)
+
+
+def spec_verify_packed(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    kv_cache: Tuple[jax.Array, jax.Array],
+    token_ids: jax.Array,      # [T] int32 packed verify stream
+    positions: jax.Array,      # [T] int32 absolute position per token
+    seg_ids: jax.Array,        # [T] int32 segment row per token
+    block_tables: jax.Array,   # [S, mb] int32 per-segment block tables
+    valid: jax.Array,          # [T] bool: False on the padded tail
+):
+    """Speculative-decoding verification (spec/): each speculating
+    sequence's row [last_token, d1..dk] runs through the SAME packed
+    segment-id path as chunked prefill — K/V for every draft position is
+    written in place (accepted prefixes keep theirs; rejected tails are
+    overwritten when the sequence actually reaches those positions) —
+    but logits come back for EVERY packed position, since verification
+    needs the target's next-token distribution after each draft prefix.
+    Returns (logits [T, vocab], updated kv_cache)."""
+    x, kv_cache = _packed_forward(
+        params, cfg, kv_cache, token_ids, positions, seg_ids,
+        block_tables, valid,
+    )
+    return _logits(params, cfg, x), kv_cache
 
 
 def embed_text(

@@ -39,6 +39,14 @@ overhead) can slot in behind impl="pallas" when written.
 Shape/layout conventions match ops/paged_attention.py: cache
 [L, nkv, nb, hd, bs] head-major transposed blocks, physical block 0 is
 garbage, all shapes static.
+
+Second consumer: speculative decoding's multi-token verification
+(spec/, models/*.spec_verify_packed) runs each speculating sequence's
+[last_token, d1..dk] row through this exact path — the draft positions'
+KV scatters in place and every row scores against its own paged context
+causally, which is precisely the k-token verify step.  Rows there are
+short (k+1 tokens), so the S-fold attention overhead is negligible
+against the weight pass the verify amortizes.
 """
 
 from __future__ import annotations

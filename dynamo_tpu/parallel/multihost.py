@@ -16,7 +16,11 @@ part 3).  This module maps that model onto the worker contract:
   * StepBroadcaster / StepFollower — the leader's scheduler publishes an
     ordered stream of step descriptors (kind + host batch arrays) on the
     event plane; followers replay them call-for-call, keeping every
-    process's jit sequence identical.  Sequence numbers make gaps loud:
+    process's jit sequence identical.  Step kinds span the whole compute
+    surface: prefill (single/batched/packed/ring), decode (full/multi/
+    continuation), guided top-M, speculative verification (spec_verify),
+    KV gather/inject, lora_write, and embed — see engine/core.py
+    apply_step.  Sequence numbers make gaps loud:
     a follower that misses a step CANNOT continue (its next collective
     would deadlock or corrupt), so it raises instead of resubscribing.
 

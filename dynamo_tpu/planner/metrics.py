@@ -296,6 +296,26 @@ class FpmObserver:
             w_tok += fb_tok
         return w_mfu / w_tok if w_tok else 0.0
 
+    def spec_acceptance(self) -> Optional[float]:
+        """Fleet speculative-decoding acceptance rate over the window:
+        Σ accepted / Σ proposed across spec_verify records (one per
+        packed verify dispatch, engine/core.py _spec_step; the mocker
+        emits the same shape from its simulated acceptance).  The SLA
+        planner surfaces it per tick so acceptance regressions — a
+        proposer gone stale, a workload shift away from repetition —
+        are visible next to ITL/MFU.  None when nothing speculated in
+        the window — a REAL 0.0 (every draft rejected) is exactly the
+        regression this metric exists to expose and must not be
+        conflated with idle."""
+        proposed, accepted = 0, 0
+        for dq in self._window().values():
+            for _, rec in dq:
+                if rec.get("kind") != "spec_verify":
+                    continue
+                proposed += int(rec.get("proposed", 0))
+                accepted += int(rec.get("accepted", 0))
+        return accepted / proposed if proposed else None
+
     def prefill_queue_depth(self) -> float:
         """Fleet chunk-queue depth: each worker's most recent prefill
         record's `queue_depth` (waiting + still-prefilling slots at that

@@ -212,6 +212,14 @@ class Planner:
                 diag["prefill_mfu"] = mfu
             if depth:
                 diag["prefill_queue_depth"] = depth
+            # speculative-decoding acceptance off the same stream: a
+            # fleet whose acceptance sags decodes more passes per token,
+            # which shows up here before it shows up in ITL.  None =
+            # idle; a real 0.0 (total rejection) IS the regression and
+            # must appear in the tick
+            spec = self.fpm.spec_acceptance()
+            if spec is not None:
+                diag["spec_acceptance"] = spec
 
         # decode bound: ITL capacity when targeted, else the load-mode
         # constant — an arrival lull must never scale away a fleet that is

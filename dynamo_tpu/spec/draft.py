@@ -1,0 +1,133 @@
+"""Draft-model proposer: a second, smaller model on the target's mesh.
+
+The draft holds its own params and its own KV cache arrays, but the
+cache is ADDRESSED BY THE TARGET'S BLOCK TABLES: same block_size, same
+num_blocks, same garbage block 0.  That makes the whole proposer
+allocator-free — wherever the engine's allocator put a sequence's
+target KV, the draft KV for the same positions lives at the same block
+ids in the draft arrays.  Shared prefix blocks are safe by the same
+hash argument as the target cache (one hash = one token run = one KV
+content), and a block id recycled to a new sequence is overwritten by
+that sequence's catch-up prefill before it is ever read.
+
+Per speculation round for one slot:
+
+  1. catch-up: prefill the draft over tokens[draft_pos:ctx] (bucketed
+     B=1 chunks).  draft_pos is engine bookkeeping on the slot — after a
+     verify it equals the new ctx, so steady-state catch-up is EMPTY
+     (the accepted drafts' KV was already written by step 2, and the
+     rejected tail is overwritten by the next round's step 2).
+  2. propose: ONE fused decode_multi program runs k greedy draft steps
+     from last_token at position ctx, chaining sampled ids on device —
+     k tokens for one dispatch, exactly the program shape the target
+     engine uses for its own fused decode.
+
+v1 scope: greedy drafts (the proposal is a point mass, which is what
+engine/sampler.py spec_accept_tokens assumes), single-host slices only
+(draft programs do not ride the multihost step stream; engine/core.py
+rejects the combination at init).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import get_family
+
+
+class DraftModelProposer:
+    name = "draft"
+
+    def __init__(self, model_cfg, mesh, *, num_blocks: int,
+                 block_size: int, prefill_buckets, model_path: str = "",
+                 max_k: int = 4, seed: int = 0):
+        from ..parallel.mesh import shard_params
+
+        self.cfg = model_cfg
+        self.family = get_family(model_cfg)
+        self.mesh = mesh
+        self.block_size = block_size
+        self.buckets = tuple(prefill_buckets)
+        self.max_k = max_k
+        with mesh:
+            if model_path:
+                from ..models.loader import load_params
+
+                self.params = load_params(model_path, model_cfg, mesh=mesh)
+            else:
+                self.params = shard_params(
+                    self.family.init_params(model_cfg,
+                                            jax.random.PRNGKey(seed)),
+                    mesh)
+            k_shape, v_shape = self.family.kv_cache_shapes(
+                model_cfg, num_blocks, block_size)
+            k_spec, v_spec = self.family.kv_cache_specs()
+            from jax.sharding import NamedSharding
+
+            self.kv = (
+                jax.jit(partial(jnp.zeros, k_shape, model_cfg.dtype),
+                        out_shardings=NamedSharding(mesh, k_spec))(),
+                jax.jit(partial(jnp.zeros, v_shape, model_cfg.dtype),
+                        out_shardings=NamedSharding(mesh, v_spec))(),
+            )
+        self._jit_prefill = jax.jit(
+            partial(self._prefill_impl, self.family, self.cfg),
+            donate_argnums=(1,))
+        self._jit_propose = {}  # k -> jitted k-step greedy draft program
+
+    @staticmethod
+    def _prefill_impl(family, cfg, params, kv, toks, positions, table,
+                      ctx_len, true_len):
+        _, kv = family.prefill(params, cfg, kv, toks, positions, table,
+                               ctx_len, true_len)
+        return kv
+
+    @staticmethod
+    def _propose_impl(family, cfg, mesh, k, params, kv, token, position,
+                      table, ctx_len):
+        toks, kv = family.decode_multi(
+            params, cfg, kv, token[None], position[None], table[None],
+            ctx_len[None], k, None, valid=jnp.ones((1,), bool), mesh=mesh,
+        )
+        return toks[:, 0], kv
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def propose(self, tokens: Sequence[int], k: int, *, ctx: int,
+                draft_pos: int, block_table) -> List[int]:
+        """k greedy draft tokens continuing tokens[:ctx+1] (last_token is
+        tokens[ctx]).  Catch-up prefill covers [draft_pos, ctx); the
+        caller advances draft_pos to the new ctx after verification."""
+        table = jnp.asarray(block_table)
+        pos = draft_pos
+        while pos < ctx:
+            chunk = min(ctx - pos, self.buckets[-1])
+            bucket = self._bucket_for(chunk)
+            toks = np.zeros(bucket, np.int32)
+            toks[:chunk] = tokens[pos:pos + chunk]
+            positions = pos + np.arange(bucket, dtype=np.int32)
+            self.kv = self._jit_prefill(
+                self.params, self.kv, jnp.asarray(toks),
+                jnp.asarray(positions), table, jnp.int32(pos),
+                jnp.int32(chunk))
+            pos += chunk
+        k = min(k, self.max_k)
+        jit = self._jit_propose.get(k)
+        if jit is None:
+            jit = self._jit_propose[k] = jax.jit(
+                partial(self._propose_impl, self.family, self.cfg,
+                        self.mesh, k),
+                donate_argnums=(1,))
+        burst, self.kv = jit(
+            self.params, self.kv, jnp.int32(tokens[ctx]), jnp.int32(ctx),
+            table, jnp.int32(ctx))
+        return [int(t) for t in np.asarray(burst)]

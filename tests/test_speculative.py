@@ -1,0 +1,532 @@
+"""Speculative decoding (spec/): proposers, packed multi-token
+verification, distribution preservation, KV rollback, adaptivity, the
+guided-decoding guard, multihost replay, and the mocker simulation."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.engine.sampler import CAP, spec_accept_tokens, \
+    spec_window_weights
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.spec import NgramProposer
+
+FP32 = LlamaConfig(name="tiny32", vocab_size=256, d_model=64, n_layers=2,
+                   n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,
+                   dtype=jnp.float32)
+
+# repetition-friendly prompt: greedy streams on the tiny model cycle, so
+# the n-gram proposer's history matches get accepted
+REPEAT_PROMPT = [5, 9, 13, 2] * 6
+
+
+def engine(**kw):
+    defaults = dict(model_config=FP32, block_size=4, num_blocks=256,
+                    max_blocks_per_seq=64, max_num_seqs=4,
+                    prefill_buckets=(8, 16, 32, 64), seed=7)
+    defaults.update(kw)
+    return JaxEngine(EngineConfig(**defaults))
+
+
+def req(tokens, n, rid, temp=0.0, seed=0, top_k=0, top_p=1.0,
+        guided_json=None):
+    return PreprocessedRequest(
+        token_ids=tokens, request_id=rid,
+        sampling=SamplingOptions(temperature=temp, seed=seed, top_k=top_k,
+                                 top_p=top_p, guided_json=guided_json),
+        stop=StopConditions(max_tokens=n, ignore_eos=True),
+    )
+
+
+async def collect(eng, r):
+    toks = []
+    async for out in eng.generate(r):
+        toks.extend(out.token_ids)
+    return toks
+
+
+# -- proposers -------------------------------------------------------------
+
+
+def test_ngram_proposer_matches_history():
+    p = NgramProposer(max_ngram=3, min_ngram=1)
+    # suffix [7, 8] recurred earlier; the continuation there was [9, 10]
+    assert p.propose([1, 7, 8, 9, 10, 5, 7, 8], 2) == [9, 10]
+    # longest n-gram wins over a more recent shorter match
+    toks = [1, 2, 3, 40, 9, 2, 3, 50, 1, 2, 3]
+    assert p.propose(toks, 1) == [40]
+    # draft truncated to k and to available continuation
+    assert p.propose([4, 4, 4], 5) == [4, 4]  # only 2 tokens follow
+    # a recurrence immediately adjacent to the suffix (onset of
+    # token-level repetition) is a legitimate candidate
+    assert p.propose([1, 2, 2], 4) == [2]
+    # no recurrence -> no proposal
+    assert p.propose([1, 2, 3, 4, 5], 4) == []
+    # min_ngram=2 refuses single-token evidence
+    assert NgramProposer(max_ngram=3, min_ngram=2).propose(
+        [9, 1, 2, 9], 2) == []
+
+
+def test_spec_verify_packed_matches_prefill_packed():
+    """The verify program is prefill_packed minus the last-token gather:
+    its last-position logits per segment must match prefill_packed's, and
+    the KV it writes must be identical."""
+    from dynamo_tpu.models.llama import prefill_packed, spec_verify_packed
+
+    cfg = FP32
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    bs, nb, mb = 4, 64, 8
+    shape = (cfg.n_layers, cfg.n_kv_heads, nb, cfg.head_dim, bs)
+    kv_a = (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+    kv_b = (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+    rng = np.random.default_rng(3)
+    lens = [9, 6]
+    prompts = [rng.integers(1, cfg.vocab_size, n).astype(np.int32)
+               for n in lens]
+    tables = np.zeros((2, mb), np.int32)
+    for i, n in enumerate(lens):
+        used = -(-n // bs)
+        tables[i, :used] = 1 + i * mb + np.arange(used)
+
+    T = 16
+    toks = np.zeros(T, np.int32)
+    pos = np.zeros(T, np.int32)
+    seg = np.zeros(T, np.int32)
+    val = np.zeros(T, bool)
+    last = np.zeros(2, np.int32)
+    off = 0
+    for i, p in enumerate(prompts):
+        n = len(p)
+        toks[off:off + n] = p
+        pos[off:off + n] = np.arange(n)
+        seg[off:off + n] = i
+        val[off:off + n] = True
+        last[i] = off + n - 1
+        off += n
+
+    lg_a, kv_a = prefill_packed(
+        params, cfg, kv_a, jnp.asarray(toks), jnp.asarray(pos),
+        jnp.asarray(seg), jnp.asarray(tables), jnp.asarray(last),
+        jnp.asarray(val))
+    lg_b, kv_b = spec_verify_packed(
+        params, cfg, kv_b, jnp.asarray(toks), jnp.asarray(pos),
+        jnp.asarray(seg), jnp.asarray(tables), jnp.asarray(val))
+    for i in range(2):
+        np.testing.assert_allclose(
+            np.asarray(lg_b[last[i]]), np.asarray(lg_a[i]),
+            rtol=1e-5, atol=1e-5)
+    for ca, cb in zip(kv_a, kv_b):
+        np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+
+
+# -- greedy: token-identical to plain decode -------------------------------
+
+
+async def test_ngram_greedy_token_identical_and_engages():
+    base = engine()
+    expect = await collect(base, req(REPEAT_PROMPT, 96, "b"))
+    await base.close()
+
+    spec = engine(spec_decode="ngram", spec_k=4)
+    got = await collect(spec, req(REPEAT_PROMPT, 96, "s"))
+    m = dict(spec.metrics)
+    recs = [r for r in spec.fpm if r.get("kind") == "spec_verify"]
+    await spec.close()
+    assert got == expect, "speculative greedy output diverged"
+    assert m.get("spec_accepted", 0) > 0, "speculation never accepted"
+    assert recs, "no spec_verify FPM records emitted"
+    for r in recs:
+        assert {"proposed", "accepted", "lanes", "gap_s"} <= set(r)
+
+
+async def test_draft_model_greedy_token_identical():
+    """Draft == target (same config, same seed => identical params):
+    greedy drafts are the target's own argmax chain, so acceptance is
+    high and output stays token-identical."""
+    base = engine()
+    expect = await collect(base, req(REPEAT_PROMPT, 48, "b"))
+    await base.close()
+
+    spec = engine(spec_decode="draft", spec_draft_config=FP32, spec_k=4)
+    got = await collect(spec, req(REPEAT_PROMPT, 48, "s"))
+    m = dict(spec.metrics)
+    await spec.close()
+    assert got == expect
+    assert m.get("spec_proposed", 0) > 0
+    # identical draft/target disagree only on float near-ties between
+    # the decode and packed-verify program shapes
+    assert m["spec_accepted"] >= m["spec_proposed"] // 2
+
+
+async def test_random_workload_stays_token_identical():
+    """Adversarial (non-crafted) workloads: whatever the proposer does,
+    greedy output is token-identical to plain decode."""
+    rng = np.random.default_rng(11)
+    prompt = list(map(int, rng.integers(1, 250, 24)))
+    base = engine()
+    expect = await collect(base, req(prompt, 64, "b"))
+    await base.close()
+
+    spec = engine(spec_decode="ngram", spec_k=4)
+    got = await collect(spec, req(prompt, 64, "s"))
+    await spec.close()
+    assert got == expect
+
+
+async def test_adaptive_k_collapses_under_persistent_rejection():
+    """Near-zero acceptance must fall back to plain decode: with a
+    proposer that only ever drafts garbage, the acceptance EMA collapses
+    k to 0 after a few rounds and exponentially backed-off probes bound
+    further verify dispatches — the zero-regression criterion's
+    mechanics (benchmarks/bench_speculative.py measures the throughput
+    half).  Output stays token-identical throughout."""
+    rng = np.random.default_rng(11)
+    prompt = list(map(int, rng.integers(1, 250, 24)))
+    base = engine()
+    expect = await collect(base, req(prompt, 64, "b"))
+    await base.close()
+
+    spec = engine(spec_decode="ngram", spec_k=4, spec_probe_interval=64)
+
+    class HostileProposer:
+        def propose(self, tokens, k, **kw):
+            return [251] * k  # 251 never appears in any greedy stream
+
+    spec.proposer = HostileProposer()
+    got = await collect(spec, req(prompt, 64, "s"))
+    m = dict(spec.metrics)
+    await spec.close()
+    assert got == expect
+    # 251 could coincide with a rare argmax; near-zero, not exactly zero
+    assert m.get("spec_accepted", 0) <= 2
+    # EMA (0.5 prior, alpha 0.3, min 0.15) collapses after ~4 rejected
+    # rounds; afterwards probes at 8/16/32/64-token backoff add only a
+    # handful more dispatches across a 64-token stream
+    assert m.get("spec_steps", 0) <= 12, \
+        f"adaptive k failed to collapse: {m.get('spec_steps')} dispatches"
+
+
+async def test_spec_then_plain_decode_does_not_chain_stale_tokens():
+    """Regression: after a slot speculates, the device token chain no
+    longer feeds its lane — a later decode burst whose descriptor
+    happens to line up as a 'continuation' must re-upload the true
+    (spec-emitted) last token instead of chaining the stale device one.
+    Mirrors the bench shape that caught it: concurrent sequences,
+    fused bursts, intermittent speculation, long greedy streams."""
+    rng = np.random.default_rng(17)
+    prompts = [list(map(int, rng.integers(1, 250, 32))) for _ in range(2)]
+
+    async def run(spec):
+        eng = engine(max_num_seqs=2, decode_fused_steps=8,
+                     block_size=16, num_blocks=64, max_blocks_per_seq=16,
+                     prefill_buckets=(16, 32),
+                     **({"spec_decode": "ngram", "spec_k": 4} if spec
+                        else {}))
+        outs = await asyncio.gather(*[
+            collect(eng, req(p, 96, f"ch{spec}-{i}"))
+            for i, p in enumerate(prompts)])
+        m = dict(eng.metrics)
+        await eng.close()
+        return list(outs), m
+
+    expect, _ = await run(False)
+    got, m = await run(True)
+    assert got == expect, "post-speculation decode chained a stale token"
+
+
+# -- distribution preservation ---------------------------------------------
+
+
+def _fake_rows(rng, n, peaked=2.0):
+    """Synthetic verify outputs: [n, CAP] sorted scaled logits with ids,
+    plus the exact full-vocab logsumexp (vocab == CAP here, so the
+    window holds the whole distribution)."""
+    logits = rng.normal(0.0, peaked, size=(n, CAP))
+    order = np.argsort(-logits, axis=1)
+    vals = np.take_along_axis(logits, order, axis=1)
+    lse = np.log(np.exp(logits).sum(axis=1))
+    return order.astype(np.int64), vals, lse
+
+
+def test_rejection_sampling_preserves_target_distribution():
+    """Point-mass rejection sampling must emit position-1 tokens with
+    EXACTLY the target's window distribution, whatever the draft was:
+    empirical TV distance over many trials stays small for both a
+    high-probability and a low-probability draft."""
+    rng = np.random.default_rng(0)
+    ids, vals, lse = _fake_rows(rng, 2)
+    target = spec_window_weights(vals[0], lse[0], top_k=0, top_p=1.0)
+    for draft in (int(ids[0, 0]), int(ids[0, CAP - 1])):
+        counts = np.zeros(CAP)
+        trials = 20000
+        sampler_rng = np.random.default_rng(123)
+        for _ in range(trials):
+            _, emitted = spec_accept_tokens(
+                ids, vals, lse, [draft], greedy=False, top_k=0,
+                top_p=1.0, rng=sampler_rng)
+            counts[np.nonzero(ids[0] == emitted[0])[0][0]] += 1
+        tv = 0.5 * np.abs(counts / trials - target).sum()
+        assert tv < 0.02, f"TV {tv:.4f} for draft {draft}"
+
+
+def test_rejection_sampling_respects_top_k_top_p():
+    """Acceptance decisions must use the SAME masked window the decode
+    sampler draws from: a draft outside top-k is never accepted, and the
+    emitted token always lies inside the mask."""
+    rng = np.random.default_rng(4)
+    ids, vals, lse = _fake_rows(rng, 2)
+    w = spec_window_weights(vals[0], lse[0], top_k=4, top_p=1.0)
+    assert np.count_nonzero(w) <= 4
+    outside = int(ids[0, 10])  # rank 10 > top_k=4
+    sampler_rng = np.random.default_rng(9)
+    for _ in range(200):
+        accepted, emitted = spec_accept_tokens(
+            ids, vals, lse, [outside], greedy=False, top_k=4, top_p=1.0,
+            rng=sampler_rng)
+        assert accepted == 0
+        assert emitted[0] in set(int(t) for t in ids[0, :4])
+
+
+async def test_sampled_spec_deterministic_by_seed():
+    e1 = engine(spec_decode="ngram")
+    a = await collect(e1, req(REPEAT_PROMPT, 24, "t1", temp=0.8, seed=42))
+    await e1.close()
+    e2 = engine(spec_decode="ngram")
+    b = await collect(e2, req(REPEAT_PROMPT, 24, "t2", temp=0.8, seed=42))
+    await e2.close()
+    e3 = engine(spec_decode="ngram")
+    c = await collect(e3, req(REPEAT_PROMPT, 24, "t3", temp=0.8, seed=9))
+    await e3.close()
+    assert a == b
+    assert a != c
+
+
+# -- KV rollback -----------------------------------------------------------
+
+
+def test_allocator_trim_blocks_rollback():
+    from dynamo_tpu.engine.block_allocator import BlockAllocator
+
+    alloc = BlockAllocator(num_blocks=16)
+    res = alloc.allocate("s", [], 2)
+    free0 = alloc.num_free
+    for _ in range(3):  # speculative growth for a k=3 verify
+        g = alloc.append_block("s")
+        assert g.block_id is not None
+    assert alloc.num_free == free0 - 3
+    alloc.trim_blocks("s", 2)  # everything rejected: back to 2 blocks
+    assert alloc.num_free == free0
+    assert alloc.seq_block_ids("s") == res.block_ids
+    # freeing after a trim releases exactly the retained blocks
+    alloc.free("s")
+    assert alloc.num_free == 15  # all but the garbage block
+
+
+async def test_kv_rollback_accounting_matches_plain_decode():
+    """After serving the same workload, the allocator's free/evictable
+    accounting with speculation (including its rejected-draft block
+    growth) must equal plain decode's — rollback leaks nothing and frees
+    nothing it shouldn't."""
+    plain = engine()
+    await collect(plain, req(REPEAT_PROMPT, 96, "p"))
+    spec = engine(spec_decode="ngram", spec_k=4)
+    await collect(spec, req(REPEAT_PROMPT, 96, "s"))
+    assert spec.metrics.get("spec_proposed", 0) \
+        > spec.metrics.get("spec_accepted", 0), \
+        "workload produced no rejections; rollback not exercised"
+    assert spec.allocator.num_free == plain.allocator.num_free
+    assert spec.allocator.num_evictable == plain.allocator.num_evictable
+    await plain.close()
+    await spec.close()
+
+
+# -- guided decoding guard -------------------------------------------------
+
+
+async def test_guided_requests_bypass_speculation():
+    """Constrained (guided_json) requests must force plain decode even
+    with speculation globally enabled: byte-identical output, and no
+    speculative token ever enters the constrained stream."""
+    schema = {"type": "object", "properties": {
+        "city": {"type": "string"}, "days": {"type": "integer"}}}
+    base = engine()
+    expect = await collect(
+        base, req(REPEAT_PROMPT, 64, "g1", guided_json=schema))
+    await base.close()
+
+    spec = engine(spec_decode="ngram", spec_k=4)
+    got = await collect(
+        spec, req(REPEAT_PROMPT, 64, "g2", guided_json=schema))
+    m = dict(spec.metrics)
+    await spec.close()
+    assert got == expect, "guided output changed under speculation"
+    assert m.get("spec_steps", 0) == 0, \
+        "a guided request entered the speculative path"
+
+
+# -- multihost replay ------------------------------------------------------
+
+
+async def test_spec_verify_rides_step_stream_and_replays():
+    """The leader's spec_verify dispatches ride the step stream like
+    prefill/decode; a follower replaying the captured stream must end
+    with a bit-identical KV cache."""
+    steps = []
+    kw = dict(model_config=FP32, block_size=4, num_blocks=128,
+              max_blocks_per_seq=32, max_num_seqs=2,
+              prefill_buckets=(8, 16, 32), seed=5,
+              spec_decode="ngram", spec_k=4)
+    leader = JaxEngine(EngineConfig(**kw),
+                       step_sink=lambda kind, a: steps.append((kind, a)))
+    toks = await collect(leader, req(REPEAT_PROMPT, 64, "mh"))
+    assert len(toks) == 64
+    kinds = {k for k, _ in steps}
+    assert "spec_verify" in kinds, f"no spec_verify step published: {kinds}"
+
+    follower = JaxEngine(EngineConfig(**kw))
+    for kind, a in steps:
+        follower.apply_step(kind, a)
+    for lc, fc in zip(leader.kv, follower.kv):
+        np.testing.assert_array_equal(np.asarray(lc), np.asarray(fc))
+    await leader.close()
+    await follower.close()
+
+
+async def test_draft_proposer_rejected_on_multihost():
+    with pytest.raises(ValueError, match="single-host"):
+        JaxEngine(
+            EngineConfig(model_config=FP32, block_size=4, num_blocks=64,
+                         max_blocks_per_seq=16, max_num_seqs=2,
+                         spec_decode="draft", spec_draft_config=FP32),
+            step_sink=lambda kind, a: None,
+        )
+
+
+# -- MLA / config fallbacks ------------------------------------------------
+
+
+async def test_mla_family_falls_back_to_plain_decode():
+    """DeepSeek (MLA) has no packed verify path in v1: the engine must
+    serve plain decode instead of failing."""
+    from dynamo_tpu.models.deepseek import PRESETS as DS_PRESETS
+
+    eng = JaxEngine(EngineConfig(
+        model_config=DS_PRESETS["tiny-mla"], block_size=4, num_blocks=64,
+        max_blocks_per_seq=16, max_num_seqs=2, prefill_buckets=(8, 16),
+        seed=3, spec_decode="ngram"))
+    # the worker gates its MDC `speculative` advertisement on this
+    assert not eng.spec_enabled
+    toks = await collect(eng, req(list(range(1, 11)), 6, "mla"))
+    assert len(toks) == 6
+    assert eng.metrics.get("spec_steps", 0) == 0
+    await eng.close()
+
+
+def test_unknown_spec_decode_rejected():
+    with pytest.raises(ValueError, match="spec_decode"):
+        JaxEngine(EngineConfig(model_config=FP32, num_blocks=16,
+                               spec_decode="medusa"))
+
+
+# -- mocker + FPM plumbing -------------------------------------------------
+
+
+async def test_mocker_simulated_acceptance_and_fpm():
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+
+    args = MockEngineArgs(block_size=4, num_blocks=256, speedup_ratio=100,
+                          speculative={"k": 4, "acceptance": 1.0})
+    eng = MockEngine(args)
+    r = req(list(range(1, 9)), 40, "m1")
+    toks = await collect(eng, r)
+    assert len(toks) == 40
+    m = eng.metrics
+    assert m["spec_proposed"] > 0
+    # acceptance 1.0: every draft accepted
+    assert m["spec_accepted"] == m["spec_proposed"]
+    recs = [rec for rec in eng.fpm if rec["kind"] == "spec_verify"]
+    assert recs and all(
+        {"proposed", "accepted", "lanes"} <= set(rec) for rec in recs)
+    # 5 tokens per engine step (1 + 4 accepted): far fewer steps than
+    # tokens proves multi-token emission actually happened
+    assert m["steps"] < len(toks)
+    await eng.close()
+
+
+async def test_mocker_zero_acceptance_is_plain_decode():
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+
+    eng = MockEngine(MockEngineArgs(
+        block_size=4, num_blocks=256, speedup_ratio=100,
+        speculative={"k": 4, "acceptance": 0.0}))
+    toks = await collect(eng, req(list(range(1, 9)), 20, "m0"))
+    assert len(toks) == 20
+    assert eng.metrics["spec_accepted"] == 0
+    await eng.close()
+
+
+def test_fpm_observer_spec_acceptance():
+    from collections import deque
+
+    from dynamo_tpu.planner.metrics import FpmObserver
+
+    obs = FpmObserver(runtime=None, namespace="ns", component="c")
+    now = __import__("time").monotonic()
+    obs._steps[1] = deque([
+        (now, {"kind": "spec_verify", "proposed": 8, "accepted": 6}),
+        (now, {"kind": "decode", "k": 8, "gap_s": 0.01}),
+        (now, {"kind": "spec_verify", "proposed": 4, "accepted": 3}),
+    ])
+    assert obs.spec_acceptance() == pytest.approx(9 / 12)
+    # None = idle; a real 0.0 (total rejection) must stay distinguishable
+    assert FpmObserver(None, "ns", "c").spec_acceptance() is None
+    obs._steps[1] = deque([
+        (now, {"kind": "spec_verify", "proposed": 8, "accepted": 0})])
+    assert obs.spec_acceptance() == 0.0
+
+
+async def test_mocker_worker_advertises_and_publishes_acceptance():
+    """End-to-end satellite: a mocker worker with `speculative` set
+    advertises the knobs in its MDC and its FPM records aggregate to the
+    configured acceptance through FpmObserver — the planner-visible
+    path, no real model involved."""
+    import uuid
+
+    from dynamo_tpu.mocker.engine import MockEngineArgs
+    from dynamo_tpu.mocker.worker import MockerWorker
+    from dynamo_tpu.planner.metrics import FpmObserver
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem", event_plane="inproc"),
+        cluster_id=uuid.uuid4().hex).start()
+    worker = await MockerWorker(
+        rt, MockEngineArgs(block_size=4, num_blocks=256, speedup_ratio=100,
+                           speculative={"k": 4, "acceptance": 1.0}),
+        namespace="dynamo", component="mocker").start()
+    assert worker.card.runtime_config["speculative"] == {
+        "k": 4, "acceptance": 1.0}
+    obs = await FpmObserver(rt, "dynamo", "mocker").start()
+    toks = []
+    async for out in worker.engine.generate(req(list(range(1, 9)), 40,
+                                                "w1")):
+        toks.extend(out.token_ids)
+    assert len(toks) == 40
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if obs.spec_acceptance() is not None:
+            break
+    assert obs.spec_acceptance() == pytest.approx(1.0)
+    await obs.close()
+    await worker.close()
+    await rt.shutdown()
