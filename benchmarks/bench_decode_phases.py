@@ -45,6 +45,9 @@ MODEL = "llama-3b"
 # numbers are mush unless each call carries ~1s of on-chip work
 B, CTX, BLOCK, K = 8, 2048, 128, 64
 HBM_GBPS = 819.0
+# KV storage dtype (--kv-dtype): "int8" stores quantized K/V + fp32
+# scale planes (quant/kv.py) — half the KV bytes the decode read streams
+KV_DTYPE = "bf16"
 
 
 def _sync(r):
@@ -75,11 +78,17 @@ def main():
 
     max_blocks = CTX // BLOCK + 2
     num_blocks = B * max_blocks + 1
-    kv = tuple(
+    quant = KV_DTYPE == "int8"
+    kv = [
         jnp.zeros((cfg.n_layers, cfg.n_kv_heads, num_blocks,
-                   cfg.head_dim, BLOCK), cfg.dtype)
+                   cfg.head_dim, BLOCK),
+                  jnp.int8 if quant else cfg.dtype)
         for _ in range(2)
-    )
+    ]
+    if quant:
+        kv += [jnp.zeros((cfg.n_layers, cfg.n_kv_heads, num_blocks,
+                          BLOCK), jnp.float32) for _ in range(2)]
+    kv = tuple(kv)
     tables = np.zeros((B, max_blocks), np.int32)
     for b in range(B):
         tables[b] = 1 + b * max_blocks + np.arange(max_blocks)
@@ -91,9 +100,12 @@ def main():
         rng.standard_normal((B, cfg.n_heads, cfg.head_dim)), cfg.dtype)
 
     L = cfg.n_layers
-    kv_gb = 2 * L * CTX * cfg.n_kv_heads * cfg.head_dim * 2 * B / 1e9
+    # bytes/token/layer/head: 2*hd at bf16; hd int8 + 4B fp32 scale at int8
+    per_head = (cfg.head_dim + 4) if quant else 2 * cfg.head_dim
+    kv_gb = 2 * L * CTX * cfg.n_kv_heads * per_head * B / 1e9
     w_gb = (n_params - emb) * 2 / 1e9
-    print(f"per-step traffic: weights {w_gb:.2f} GB + KV {kv_gb:.2f} GB")
+    print(f"per-step traffic: weights {w_gb:.2f} GB + KV {kv_gb:.2f} GB"
+          f" (kv dtype {KV_DTYPE})")
     rows = []
 
     def report(name, t_burst, gb_per_step):
@@ -162,11 +174,14 @@ def main():
 
     # --- attention only: pallas bpc sweep + debug splits + jnp ---------
     def attn_burst_fn(impl_bpc, debug=""):
+        scales = kv[2:] if quant else (None, None)
+
         def one_step(q, kc, vc):
             for li in range(L):
                 if impl_bpc == "jnp":
                     o = pa.paged_attention_decode_jnp(
-                        q, kc, vc, li, tables, lens)
+                        q, kc, vc, li, tables, lens,
+                        k_scale=scales[0], v_scale=scales[1])
                 else:
                     o = paged_attention_decode_pallas(
                         q, kc, vc, li, tables, lens,
@@ -182,7 +197,12 @@ def main():
             return q
         return aburst
 
-    if want("attn"):
+    if want("attn") and quant:
+        # the Pallas kernel has no int8 lane layout (see
+        # ops/paged_attention.py): the quantized cache serves via the
+        # jnp gather path — measure attn_jnp instead
+        print("  attn_pallas      skipped: int8 cache has no pallas path")
+    if want("attn") and not quant:
         for bpc in (4, 8):
             f = attn_burst_fn(bpc)
             report(f"attn_pallas[{bpc}]",
@@ -237,17 +257,22 @@ def main():
 
         @partial(jax.jit, donate_argnums=(0,))
         def wr_burst(kv, kvec):
-            kc, vc = kv
-
             def body(carry, _):
-                kc, vc = carry
                 for li in range(L):
-                    kc, vc = pa.write_token_kv(kc, vc, li, kvec, kvec,
-                                               tables, lens)
-                return (kc, vc), None
-            (kc, vc), _ = jax.lax.scan(body, (kc, vc), None, length=K)
-            return kc, vc
-        wr_gb = 2 * L * B * cfg.n_kv_heads * cfg.head_dim * 2 / 1e9
+                    if len(carry) == 4:
+                        kc, vc, ks, vs = carry
+                        carry = pa.write_token_kv(
+                            kc, vc, li, kvec, kvec, tables, lens,
+                            k_scale=ks, v_scale=vs)
+                    else:
+                        kc, vc = carry
+                        carry = pa.write_token_kv(kc, vc, li, kvec, kvec,
+                                                  tables, lens)
+                return carry, None
+
+            out, _ = jax.lax.scan(body, kv, None, length=K)
+            return out
+        wr_gb = 2 * L * B * cfg.n_kv_heads * per_head / 1e9
         state2 = {"kv": kv}
 
         def run_wr():
@@ -278,5 +303,11 @@ if __name__ == "__main__":
                    help="phase tags to run: full full_jnp weights attn "
                         "attn_debug attn_jnp attn_jaxlib kv_write sample "
                         "(default: all)")
-    _SEL = set(p.parse_args().phases)
+    p.add_argument("--kv-dtype", default="bf16", choices=["bf16", "int8"],
+                   help="KV storage dtype: int8 streams half the KV "
+                        "bytes per decode step (quant/kv.py); the pallas "
+                        "attn phases are skipped (no int8 kernel)")
+    args = p.parse_args()
+    _SEL = set(args.phases)
+    KV_DTYPE = args.kv_dtype
     main()

@@ -1,0 +1,199 @@
+"""KV-cache quantization bench: bf16 vs int8 end to end.
+
+Three measurements, each against the acceptance bar of the int8 KV
+subsystem (quant/kv.py):
+
+  capacity  bytes/block and bytes/token at bf16 vs int8 for the chosen
+            model geometry, and the block count a fixed HBM budget
+            (--hbm-gb) holds at each — asserts the int8 pool is >= 1.8x
+            the bf16 pool (the per-position fp32 scales cost
+            4/head_dim of the win; 1.94x at head_dim 128).
+  parity    greedy decode through two real engines (same weights, same
+            prompts) with kv_cache_dtype bf16 vs int8 — asserts the
+            matching-token fraction >= --parity-min (measured 1.0 on
+            the CPU test geometry: per-token scales bound the error at
+            absmax/254 per element, far under the argmax margins).
+  decode    fused decode_multi tok/s at each dtype on the bench
+            geometry — on HBM-bound hardware the int8 read's halved KV
+            traffic is the headline; on CPU the numbers are relative
+            only.
+
+CPU-runnable by default (tiny geometry); pass --model llama-3b
+--ctx 2048 --block 128 on a chip for the roofline-relevant numbers.
+"""
+
+import argparse
+import asyncio
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.quant.kv import kv_cache_bytes_per_block
+
+
+def capacity_report(cfg, block_size: int, hbm_gb: float,
+                    min_ratio: float) -> None:
+    budget = int(hbm_gb * 1e9)
+    rows = {}
+    for dt in ("bf16", "int8"):
+        per_block = kv_cache_bytes_per_block(llama, cfg, block_size, dt)
+        rows[dt] = (per_block, per_block / block_size, budget // per_block)
+    ratio = rows["int8"][2] / max(1, rows["bf16"][2])
+    print(f"capacity @ {cfg.name} block_size={block_size} "
+          f"budget={hbm_gb:g} GB")
+    for dt, (pb, pt, nb) in rows.items():
+        print(f"  {dt:5s} {pb:>10d} B/block  {pt:>8.1f} B/token  "
+              f"{nb:>8d} blocks")
+    print(f"  int8/bf16 blocks ratio: {ratio:.2f}x")
+    assert ratio >= min_ratio, (
+        f"int8 capacity ratio {ratio:.2f} < required {min_ratio}")
+    assert rows["int8"][1] < rows["bf16"][1], "int8 must cut bytes/token"
+
+
+async def _greedy(engine_cfg, prompts, n_out):
+    from dynamo_tpu.engine import JaxEngine
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    eng = JaxEngine(engine_cfg)
+    outs = []
+    for i, prompt in enumerate(prompts):
+        toks = []
+        async for out in eng.generate(PreprocessedRequest(
+                token_ids=prompt, request_id=f"q{i}",
+                sampling=SamplingOptions(temperature=0.0, seed=0),
+                stop=StopConditions(max_tokens=n_out, ignore_eos=True))):
+            toks.extend(out.token_ids)
+        outs.append(toks)
+    await eng.close()
+    return outs
+
+
+def parity_report(args) -> None:
+    from dynamo_tpu.engine import EngineConfig
+
+    cfg = llama.LlamaConfig(
+        name="quant-parity", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,
+        dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = [list(map(int, rng.integers(3, 500, 24)))
+               for _ in range(args.parity_seqs)]
+
+    def ecfg(dt):
+        return EngineConfig(
+            model_config=cfg, block_size=8, num_blocks=128,
+            max_blocks_per_seq=16, max_num_seqs=4,
+            prefill_buckets=(8, 16, 32), seed=3, kv_cache_dtype=dt)
+
+    ref = asyncio.run(_greedy(ecfg("bf16"), prompts, args.parity_tokens))
+    q = asyncio.run(_greedy(ecfg("int8"), prompts, args.parity_tokens))
+    total = sum(len(t) for t in ref)
+    match = sum(a == b for r, s in zip(ref, q) for a, b in zip(r, s))
+    frac = match / max(1, total)
+    print(f"greedy parity: {match}/{total} tokens match "
+          f"({frac * 100:.1f}%)")
+    assert frac >= args.parity_min, (
+        f"greedy parity {frac:.3f} < required {args.parity_min}")
+
+
+def decode_report(args) -> None:
+    cfg = llama.PRESETS[args.model]
+    B, ctx, bs, K = args.batch, args.ctx, args.block, args.steps
+    max_blocks = ctx // bs + 2
+    num_blocks = B * max_blocks + 1
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tables = np.zeros((B, max_blocks), np.int32)
+    for b in range(B):
+        tables[b] = 1 + b * max_blocks + np.arange(max_blocks)
+    tables = jnp.asarray(tables)
+    lens = jnp.full((B,), ctx, jnp.int32)
+    tok0 = jnp.asarray(
+        np.random.default_rng(0).integers(3, cfg.vocab_size, B, np.int32))
+
+    for dt in ("bf16", "int8"):
+        quant = dt == "int8"
+        kv = [jnp.zeros((cfg.n_layers, cfg.n_kv_heads, num_blocks,
+                         cfg.head_dim, bs),
+                        jnp.int8 if quant else cfg.dtype)
+              for _ in range(2)]
+        if quant:
+            kv += [jnp.zeros((cfg.n_layers, cfg.n_kv_heads, num_blocks,
+                              bs), jnp.float32) for _ in range(2)]
+        kv = tuple(kv)
+
+        def burst(params, kv, tokens, positions, tables, ctx_lens):
+            toks, kv = llama.decode_multi(
+                params, cfg, kv, tokens, positions, tables, ctx_lens, K)
+            return toks[-1], kv
+
+        step = jax.jit(burst, donate_argnums=(1,))
+        state = {"kv": kv, "tok": tok0}
+
+        def run():
+            state["tok"], state["kv"] = step(
+                params, state["kv"], state["tok"], lens, tables, lens)
+            return state["tok"]
+
+        for _ in range(args.warmup):
+            r = run()
+        np.asarray(jax.device_get(r.ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            r = run()
+        np.asarray(jax.device_get(r.ravel()[0]))
+        dt_s = (time.perf_counter() - t0) / args.iters / K
+        per_head = (cfg.head_dim + 4) if quant else 2 * cfg.head_dim
+        kv_bytes = 2 * cfg.n_layers * ctx * cfg.n_kv_heads * per_head * B
+        print(f"  {dt:5s} {dt_s * 1e3:8.2f} ms/step  "
+              f"{B / dt_s:8.1f} tok/s  "
+              f"kv read {kv_bytes / 1e9:6.3f} GB/step")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description="bf16 vs int8 KV-cache quantization bench "
+                    "(see module docstring)")
+    p.add_argument("--model", default="tiny", choices=sorted(llama.PRESETS),
+                   help="preset for the capacity + decode phases")
+    p.add_argument("--hbm-gb", type=float, default=16.0,
+                   help="HBM budget for the blocks-per-budget report")
+    p.add_argument("--min-ratio", type=float, default=1.8,
+                   help="required int8/bf16 block-capacity ratio")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--ctx", type=int, default=256)
+    p.add_argument("--block", type=int, default=16)
+    p.add_argument("--steps", type=int, default=16,
+                   help="fused decode steps per dispatch")
+    p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--parity-seqs", type=int, default=2)
+    p.add_argument("--parity-tokens", type=int, default=16)
+    p.add_argument("--parity-min", type=float, default=0.9,
+                   help="required matching-token fraction bf16 vs int8")
+    p.add_argument("--skip-decode", action="store_true",
+                   help="capacity + parity only (fast CPU smoke)")
+    args = p.parse_args()
+
+    capacity_report(llama.PRESETS[args.model], args.block, args.hbm_gb,
+                    args.min_ratio)
+    # the headline config too: the 2x-blocks claim is about serving
+    # geometry (head_dim 128, block 128), not the CPU test shapes
+    if args.model != "llama-3b":
+        capacity_report(llama.PRESETS["llama-3b"], 128, args.hbm_gb,
+                        args.min_ratio)
+    parity_report(args)
+    if not args.skip_decode:
+        print(f"decode tok/s @ {args.model} B={args.batch} "
+              f"ctx={args.ctx} K={args.steps}")
+        decode_report(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,7 +63,9 @@ class LocalEnginePullSource:
         lo = self.src.kv_wire_layout(n_blocks)
         return make_header(prompt_len, lo)
 
-    async def chunk(self, b0: int, n: int) -> Tuple[Any, Any]:
+    async def chunk(self, b0: int, n: int) -> Tuple[Any, ...]:
+        # (kb, vb) — plus (ksb, vsb) scale planes when the sender's cache
+        # is int8-quantized (the payload moves quantized, never dequanted)
         return await self.src.extract_parked_chunk(
             self.request_id, b0, n, to_host=False)
 

@@ -202,13 +202,21 @@ class NegotiatedPullSource(RequestPlanePullSource):
         lo = self.layout
         dt = _np_dtype(lo.dtype)
         sh = jax.sharding.SingleDeviceSharding(self.device)
-        sds_k = jax.ShapeDtypeStruct(
-            (lo.num_layers, n, lo.block_size, lo.kv_heads, lo.head_dim),
-            dt, sharding=sh)
-        sds_v = jax.ShapeDtypeStruct(
-            (lo.num_layers, n, lo.block_size, lo.kv_heads, lo.hd_v),
-            dt, sharding=sh)
+        sds = [
+            jax.ShapeDtypeStruct(
+                (lo.num_layers, n, lo.block_size, lo.kv_heads,
+                 lo.head_dim), dt, sharding=sh),
+            jax.ShapeDtypeStruct(
+                (lo.num_layers, n, lo.block_size, lo.kv_heads, lo.hd_v),
+                dt, sharding=sh),
+        ]
+        if lo.scales:
+            # int8 payload: the sender parked fp32 scale planes too
+            sshape = (lo.num_layers, n, lo.block_size, lo.kv_heads)
+            import numpy as np
+
+            sds += [jax.ShapeDtypeStruct(sshape, np.float32, sharding=sh)
+                    for _ in range(2)]
         # conn.pull blocks on the wire; keep the event loop free
-        kb, vb = await asyncio.to_thread(
-            self._conn.pull, uuid, [sds_k, sds_v])
-        return kb, vb
+        out = await asyncio.to_thread(self._conn.pull, uuid, sds)
+        return tuple(out)

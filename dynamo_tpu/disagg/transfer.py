@@ -59,7 +59,8 @@ try:
 except ImportError:  # pragma: no cover
     _BF16 = None
 
-_DTYPES = {"float32": np.float32, "float16": np.float16}
+_DTYPES = {"float32": np.float32, "float16": np.float16,
+           "int8": np.int8}
 
 # Default chunk bound.  Well under the request plane's 256MB frame cap even
 # after msgpack framing, large enough to amortize per-frame overhead.
@@ -94,6 +95,10 @@ class KvLayout:
     # MLA engines cache an asymmetric pair (latent R vs rope-key dr,
     # models/deepseek.py) — 0 means "v matches k" (the GQA case)
     head_dim_v: int = 0
+    # int8-quantized payload (quant/kv.py): chunks carry fp32 scale
+    # planes [L, n, bs, nkv] alongside k/v — the quantized representation
+    # rides the wire verbatim (half the payload bytes, scales bit-exact)
+    scales: bool = False
 
     @property
     def hd_v(self) -> int:
@@ -105,6 +110,7 @@ class KvLayout:
             "block_size": self.block_size, "kv_heads": self.kv_heads,
             "head_dim": self.head_dim, "dtype": self.dtype,
             "tp": self.tp, "dp": self.dp, "head_dim_v": self.head_dim_v,
+            "scales": self.scales,
         }
 
     @classmethod
@@ -112,21 +118,27 @@ class KvLayout:
         return cls(**{k: d[k] for k in (
             "num_layers", "num_blocks", "block_size", "kv_heads",
             "head_dim", "dtype")}, tp=d.get("tp", 1), dp=d.get("dp", 1),
-            head_dim_v=d.get("head_dim_v", 0))
+            head_dim_v=d.get("head_dim_v", 0),
+            scales=bool(d.get("scales", False)))
 
     @classmethod
-    def of(cls, k, tp: int = 1, dp: int = 1, v=None) -> "KvLayout":
+    def of(cls, k, tp: int = 1, dp: int = 1, v=None,
+           scales: bool = False) -> "KvLayout":
         """From a universal-layout K (and optionally V) array."""
         L, nb, bs, nkv, hd = k.shape
         hd_v = v.shape[4] if v is not None and v.shape[4] != hd else 0
         return cls(num_layers=L, num_blocks=nb, block_size=bs, kv_heads=nkv,
                    head_dim=hd, dtype=np.dtype(k.dtype).name, tp=tp, dp=dp,
-                   head_dim_v=hd_v)
+                   head_dim_v=hd_v, scales=scales)
 
     def check_compatible(self, other: "KvLayout") -> None:
-        """Logical-geometry contract check (tp/dp intentionally excluded)."""
+        """Logical-geometry contract check (tp/dp intentionally excluded).
+        `dtype`/`scales` are part of the contract: an int8 payload cannot
+        scatter into a bf16 cache (or vice versa) without silent
+        corruption — mixed-dtype disagg pairs must fail the pull (the
+        decode side then falls back to local prefill)."""
         for f in ("num_layers", "block_size", "kv_heads", "head_dim",
-                  "dtype"):
+                  "dtype", "scales"):
             a, b = getattr(self, f), getattr(other, f)
             if a != b:
                 raise ValueError(
@@ -141,10 +153,14 @@ class KvLayout:
 
     # -- chunk sizing -----------------------------------------------------
     def block_bytes(self) -> int:
-        """Payload bytes of ONE block across all layers (k + v)."""
+        """Payload bytes of ONE block across all layers (k + v, plus the
+        fp32 scale planes for a quantized payload)."""
         dt = _np_dtype(self.dtype)
         per_tok = self.kv_heads * (self.head_dim + self.hd_v)
-        return self.num_layers * self.block_size * per_tok * dt.itemsize
+        data = self.num_layers * self.block_size * per_tok * dt.itemsize
+        if self.scales:
+            data += self.num_layers * self.block_size * self.kv_heads * 2 * 4
+        return data
 
     def blocks_per_chunk(self, max_bytes: int = DEFAULT_CHUNK_BYTES) -> int:
         """Whole blocks per chunk under the byte bound (always >= 1: the
@@ -162,23 +178,32 @@ def make_header(prompt_len: int, layout: KvLayout,
     return h
 
 
-def encode_chunk_frame(b0: int, kb: np.ndarray,
-                       vb: np.ndarray) -> Dict[str, Any]:
+def encode_chunk_frame(b0: int, kb: np.ndarray, vb: np.ndarray,
+                       ksb: np.ndarray = None,
+                       vsb: np.ndarray = None) -> Dict[str, Any]:
     """Host-staged chunk -> wire frame.  kb/vb are universal-layout
-    [L, n, bs, nkv, hd] for the block range [b0, b0+n)."""
-    return {
+    [L, n, bs, nkv, hd] for the block range [b0, b0+n); a quantized
+    payload adds the fp32 scale planes ksb/vsb [L, n, bs, nkv]."""
+    frame = {
         "block_start": int(b0),
         "block_count": int(kb.shape[1]),
         "k": np.ascontiguousarray(kb).tobytes(),
         "v": np.ascontiguousarray(vb).tobytes(),
     }
+    if ksb is not None:
+        frame["ks"] = np.ascontiguousarray(ksb).tobytes()
+        frame["vs"] = np.ascontiguousarray(vsb).tobytes()
+    return frame
 
 
 def decode_chunk_frame(
     frame: Dict[str, Any], layout: KvLayout
-) -> Tuple[int, int, np.ndarray, np.ndarray]:
-    """Wire frame -> (b0, n, kb, vb) with bounds checked against the
-    header layout (a corrupt frame must not write outside the payload)."""
+) -> Tuple[Any, ...]:
+    """Wire frame -> (b0, n, kb, vb[, ksb, vsb]) with bounds checked
+    against the header layout (a corrupt frame must not write outside the
+    payload).  The scale planes come back only when the layout declares
+    them — and a declaring layout REQUIRES them (a frame without scales
+    for an int8 payload is corrupt)."""
     b0 = int(frame["block_start"])
     n = int(frame["block_count"])
     if not (0 <= b0 and n >= 1 and b0 + n <= layout.num_blocks):
@@ -190,23 +215,31 @@ def decode_chunk_frame(
         (lo.num_layers, n, lo.block_size, lo.kv_heads, lo.head_dim))
     vb = np.frombuffer(frame["v"], dtype=dt).reshape(
         (lo.num_layers, n, lo.block_size, lo.kv_heads, lo.hd_v))
-    return b0, n, kb, vb
+    if not lo.scales:
+        return b0, n, kb, vb
+    if "ks" not in frame or "vs" not in frame:
+        raise ValueError("quantized chunk frame is missing scale planes")
+    sshape = (lo.num_layers, n, lo.block_size, lo.kv_heads)
+    ksb = np.frombuffer(frame["ks"], dtype=np.float32).reshape(sshape)
+    vsb = np.frombuffer(frame["vs"], dtype=np.float32).reshape(sshape)
+    return b0, n, kb, vb, ksb, vsb
 
 
 class PullSource:
     """Receiver-side pull driver interface (the engine paces it).
 
     open()  -> header dict ({"prompt_len", "layout", ...})
-    chunk(b0, n) -> (kb, vb) for blocks [b0, b0+n) — numpy arrays
-        (tier 3) or device arrays (tiers 1-2; the engine device_puts them
-        onto its own sharding before injecting)
+    chunk(b0, n) -> (kb, vb) — plus (ksb, vsb) scale planes for an int8
+        payload — for blocks [b0, b0+n): numpy arrays (tier 3) or device
+        arrays (tiers 1-2; the engine device_puts them onto its own
+        sharding before injecting)
     close() -> release the sender's parked KV.  Idempotent; called on
         success AND failure."""
 
     async def open(self) -> Dict[str, Any]:
         raise NotImplementedError
 
-    async def chunk(self, b0: int, n: int) -> Tuple[Any, Any]:
+    async def chunk(self, b0: int, n: int) -> Tuple[Any, ...]:
         raise NotImplementedError
 
     async def close(self) -> None:
@@ -244,11 +277,12 @@ class RequestPlanePullSource(PullSource):
             "op": "chunk", "request_id": self.params["request_id"],
             "start": int(b0), "count": int(n),
         })
-        fb0, fn, kb, vb = decode_chunk_frame(frame, self.layout)
+        out = decode_chunk_frame(frame, self.layout)
+        fb0, fn, arrs = out[0], out[1], out[2:]
         if fb0 != b0 or fn != n:
             raise ValueError(f"sender returned blocks [{fb0},{fb0 + fn}) "
                              f"for a request of [{b0},{b0 + n})")
-        return kb, vb
+        return arrs
 
     async def close(self) -> None:
         try:

@@ -38,6 +38,15 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--no-prefix-caching", action="store_true")
+    p.add_argument("--kv-cache-dtype", default="bf16",
+                   choices=["bf16", "int8"],
+                   help="KV storage dtype (quant/kv.py): int8 halves KV "
+                        "bytes/token and ~doubles blocks per HBM budget; "
+                        "MLA families fall back to bf16")
+    p.add_argument("--kv-hbm-gb", type=float, default=0.0,
+                   help="KV HBM budget in GB: derive --num-blocks from "
+                        "bytes-per-block at the effective kv dtype "
+                        "(0 = use --num-blocks as given)")
     p.add_argument("--prefill-chunk-tokens", type=int, default=0,
                    help="chunked-prefill token budget per scheduler step "
                         "(bounds decode ITL during prefill bursts); "
@@ -99,6 +108,8 @@ async def main() -> None:
         tp=args.tp,
         dp=args.dp,
         enable_prefix_caching=not args.no_prefix_caching,
+        kv_cache_dtype=args.kv_cache_dtype,
+        kv_hbm_gb=args.kv_hbm_gb,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         prefill_packed=not args.no_packed_prefill,
         peak_tflops=args.peak_tflops,

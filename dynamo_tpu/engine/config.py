@@ -26,6 +26,23 @@ class EngineConfig:
     num_blocks: int = 128         # physical blocks (id 0 is garbage)
     max_blocks_per_seq: int = 64  # max context = block_size * this
     enable_prefix_caching: bool = True
+    # KV cache storage dtype (quant/kv.py): "bf16" stores the model dtype
+    # (the pre-quantization behavior, byte-identical); "int8" stores
+    # symmetric per-(layer, kv_head, block, position) quantized K/V with
+    # fp32 scale planes riding as sibling arrays — roughly half the HBM
+    # bytes per token, so the decode read streams half the traffic and a
+    # fixed budget holds ~1.9x the blocks.  Families without a quantized
+    # path (MLA) auto-fall back to bf16 with a warning, following the
+    # MLA/MoE fallback precedent; the worker MDC advertises the EFFECTIVE
+    # dtype.  Quantized payloads ride disagg transfer and the KVBM tiers
+    # as int8 + scales (half the wire/host bytes too).
+    kv_cache_dtype: str = "bf16"
+    # KV HBM budget in GB: when > 0, num_blocks is DERIVED from the
+    # bytes-per-block of the resolved model at the effective
+    # kv_cache_dtype (quant/kv.py blocks_for_hbm_budget), so switching
+    # bf16 -> int8 at a fixed budget yields ~2x blocks instead of the
+    # same block count at half the memory.  0 keeps num_blocks as given.
+    kv_hbm_gb: float = 0.0
 
     # batching
     max_num_seqs: int = 8

@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models import get_family
 from ..parallel.mesh import MeshConfig, make_mesh, shard_params
 from ..protocols import LLMEngineOutput, PreprocessedRequest
+from ..quant.kv import is_quantized
 from ..tokens import TokenBlockSequence, request_salt
 from .block_allocator import BlockAllocator
 from .config import EngineConfig
@@ -179,6 +180,31 @@ class JaxEngine:
         self.kv_pull_fn = kv_pull_fn
         self.step_sink = step_sink
         self.eos_ids = frozenset(config.resolve_eos_ids())
+        # KV-cache quantization (quant/kv.py): resolve the EFFECTIVE
+        # dtype — families without a quantized path (MLA) fall back to
+        # bf16, the same precedent as the MLA packed-prefill/spec
+        # fallbacks — then size the block pool: with a kv_hbm_gb budget
+        # the block count derives from bytes-per-block, so int8 yields
+        # ~2x blocks for the same HBM instead of the same count at half
+        # the memory.  config.num_blocks is updated in place so the
+        # allocator, block tables, MDC, and load metrics all agree.
+        if config.kv_cache_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'bf16' | 'int8', "
+                f"got {config.kv_cache_dtype!r}")
+        self.kv_dtype = config.kv_cache_dtype
+        if self.kv_dtype == "int8" \
+                and not hasattr(self.family, "kv_cache_scale_shapes"):
+            logger.warning(
+                "model family %r has no quantized KV path; "
+                "kv_cache_dtype falls back to bf16", self.model_cfg.name)
+            self.kv_dtype = "bf16"
+        if config.kv_hbm_gb > 0:
+            from ..quant.kv import blocks_for_hbm_budget
+
+            config.num_blocks = blocks_for_hbm_budget(
+                self.family, self.model_cfg, config.block_size,
+                self.kv_dtype, int(config.kv_hbm_gb * 1e9))
         self.allocator = BlockAllocator(
             config.num_blocks, config.enable_prefix_caching
         )
@@ -435,15 +461,27 @@ class JaxEngine:
         m = self.model_cfg
         c = self.config
         # family-owned layout: GQA (k, v) or MLA (latent, rope-key) pair,
-        # both in the head-major transposed block layout
+        # both in the head-major transposed block layout.  An int8 cache
+        # (self.kv_dtype, quant/kv.py) adds fp32 scale planes as members
+        # 3 and 4 of the tuple, sharded with the same tp split.
+        dtype = jnp.int8 if self.kv_dtype == "int8" else m.dtype
         k_shape, v_shape = self.family.kv_cache_shapes(
             m, c.num_blocks, c.block_size)
         k_spec, v_spec = self.family.kv_cache_specs()
-        k = jax.jit(partial(jnp.zeros, k_shape, m.dtype),
+        k = jax.jit(partial(jnp.zeros, k_shape, dtype),
                     out_shardings=NamedSharding(self.mesh, k_spec))()
-        v = jax.jit(partial(jnp.zeros, v_shape, m.dtype),
+        v = jax.jit(partial(jnp.zeros, v_shape, dtype),
                     out_shardings=NamedSharding(self.mesh, v_spec))()
-        return (k, v)
+        if self.kv_dtype != "int8":
+            return (k, v)
+        ks_shape, vs_shape = self.family.kv_cache_scale_shapes(
+            m, c.num_blocks, c.block_size)
+        ks_spec, vs_spec = self.family.kv_cache_scale_specs()
+        ks = jax.jit(partial(jnp.zeros, ks_shape, jnp.float32),
+                     out_shardings=NamedSharding(self.mesh, ks_spec))()
+        vs = jax.jit(partial(jnp.zeros, vs_shape, jnp.float32),
+                     out_shardings=NamedSharding(self.mesh, vs_spec))()
+        return (k, v, ks, vs)
 
     # -- jitted programs --------------------------------------------------
     @staticmethod
@@ -513,7 +551,7 @@ class JaxEngine:
         return burst, kv, positions, ctx_lens, steps
 
     @staticmethod
-    def _inject_impl(kv, kb, vb, ids):
+    def _inject_impl(kv, kb, vb, ids, ksb=None, vsb=None):
         """Scatter pulled KV blocks into the cache (ids padded with 0 write
         harmlessly into the garbage block).
 
@@ -521,24 +559,47 @@ class JaxEngine:
         (stable on the wire regardless of either engine's physical layout)
         and are permuted into the head-major block layout here — the TPU
         analogue of the reference's universal_to_block kernel
-        (lib/kvbm-kernels/cuda/tensor_kernels.cu:192)."""
-        k, v = kv
+        (lib/kvbm-kernels/cuda/tensor_kernels.cu:192).  For an int8 cache
+        the fp32 scale planes ride as ksb/vsb [L, nb, bs, nkv] and
+        scatter into the sibling scale arrays — the quantized
+        representation moves verbatim (bit-exact scales, half the
+        payload bytes), never dequantizing en route."""
+        if len(kv) == 4:
+            k, v, ks, vs = kv
+        else:
+            k, v = kv
+            ks = vs = None
         kb = jnp.transpose(kb, (0, 3, 1, 4, 2))  # -> [L, nkv, nb, hd, bs]
         vb = jnp.transpose(vb, (0, 3, 1, 4, 2))
         k = k.at[:, :, ids].set(kb.astype(k.dtype))
         v = v.at[:, :, ids].set(vb.astype(v.dtype))
-        return (k, v)
+        if ks is None:
+            return (k, v)
+        ksb = jnp.transpose(ksb, (0, 3, 1, 2))   # -> [L, nkv, nb, bs]
+        vsb = jnp.transpose(vsb, (0, 3, 1, 2))
+        ks = ks.at[:, :, ids].set(ksb.astype(ks.dtype))
+        vs = vs.at[:, :, ids].set(vsb.astype(vs.dtype))
+        return (k, v, ks, vs)
 
     @staticmethod
     def _gather_impl(kv, ids):
         """Gather blocks out of the cache into the universal transfer layout
         [L, nb, bs, nkv, hd] (block_to_universal analogue,
         lib/kvbm-kernels/cuda/tensor_kernels.cu:151).  Padded ids read the
-        garbage block; the host slices them off."""
-        k, v = kv
+        garbage block; the host slices them off.  An int8 cache returns
+        (kb, vb, ksb, vsb) with the scale planes in [L, nb, bs, nkv]."""
+        if len(kv) == 4:
+            k, v, ks, vs = kv
+        else:
+            k, v = kv
+            ks = None
         kb = jnp.transpose(k[:, :, ids], (0, 2, 4, 1, 3))
         vb = jnp.transpose(v[:, :, ids], (0, 2, 4, 1, 3))
-        return kb, vb
+        if ks is None:
+            return kb, vb
+        ksb = jnp.transpose(ks[:, :, ids], (0, 2, 3, 1))
+        vsb = jnp.transpose(vs[:, :, ids], (0, 2, 3, 1))
+        return kb, vb, ksb, vsb
 
     @staticmethod
     def _prefill_impl(family, model_cfg, params, kv, tokens, positions,
@@ -744,10 +805,13 @@ class JaxEngine:
             self._jit_gather(self.kv, jnp.asarray(a["ids"]))
         elif kind == "inject":
             # KVBM onboard or disagg KV pull: payload rides the stream, so
-            # followers need no tiers/transport of their own
+            # followers need no tiers/transport of their own (int8 caches
+            # add the ksb/vsb scale planes to the same descriptor)
+            scales = ([jnp.asarray(a["ksb"]), jnp.asarray(a["vsb"])]
+                      if "ksb" in a else [])
             self.kv = self._jit_inject(
                 self.kv, jnp.asarray(a["kb"]), jnp.asarray(a["vb"]),
-                jnp.asarray(a["ids"]),
+                jnp.asarray(a["ids"]), *scales,
             )
         else:
             raise ValueError(f"unknown step kind {kind!r}")
@@ -1215,30 +1279,36 @@ class JaxEngine:
         latent/rope-key pair with different head dims)."""
         from ..disagg.transfer import KvLayout
 
-        k_cache, v_cache = self.kv
+        k_cache, v_cache = self.kv[0], self.kv[1]
         return KvLayout(
             num_layers=k_cache.shape[0], num_blocks=n_blocks,
             block_size=self.config.block_size,
             kv_heads=k_cache.shape[1], head_dim=k_cache.shape[3],
-            dtype=np.dtype(self.model_cfg.dtype).name,
+            dtype=np.dtype(k_cache.dtype).name,
             tp=self.config.tp, dp=self.config.dp,
             head_dim_v=(v_cache.shape[3]
                         if v_cache.shape[3] != k_cache.shape[3] else 0),
+            scales=is_quantized(self.kv),
         )
 
     def universal_shardings(self):
-        """(k, v) NamedShardings for universal-layout [L, nb, bs, nkv, hd]
-        chunks on this engine's mesh: the cache's head-axis sharding moved
-        to the universal head axis.  Device-resident pulls land chunks
-        here so inject consumes them without a host bounce."""
+        """Per-component NamedShardings for universal-layout chunks on
+        this engine's mesh: the cache's head-axis sharding moved to the
+        universal head axis (data [L, nb, bs, nkv, hd]; int8 scale
+        planes [L, nb, bs, nkv]).  Device-resident pulls land chunks
+        here so inject consumes them without a host bounce.  Tuple arity
+        matches the cache's (2 or 4)."""
         k_spec, v_spec = self.family.kv_cache_specs()
         # cache layout [L, H, NB, HD, BS] -> universal [L, NB, BS, H, HD];
         # MLA families use an empty spec (replicated latent cache)
         kh = k_spec[1] if len(k_spec) > 1 else None
         vh = v_spec[1] if len(v_spec) > 1 else None
-        uk = P(None, None, None, kh, None)
-        uv = P(None, None, None, vh, None)
-        return (NamedSharding(self.mesh, uk), NamedSharding(self.mesh, uv))
+        out = [NamedSharding(self.mesh, P(None, None, None, kh, None)),
+               NamedSharding(self.mesh, P(None, None, None, vh, None))]
+        if is_quantized(self.kv):
+            out += [NamedSharding(self.mesh, P(None, None, None, kh)),
+                    NamedSharding(self.mesh, P(None, None, None, vh))]
+        return tuple(out)
 
     async def parked_info(self, request_id: str):
         """(n_blocks, prompt_len) of a parked prefill (pull 'open' op)."""
@@ -1275,11 +1345,13 @@ class JaxEngine:
                 # reads are collective programs too: every process of the
                 # slice must execute the same gather or it hangs
                 self.step_sink("gather", {"ids": ids})
-            kb, vb = self._jit_gather(self.kv, jnp.asarray(ids))
-            kb, vb = kb[:, :count], vb[:, :count]
+            arrs = self._jit_gather(self.kv, jnp.asarray(ids))
+            # axis 1 is the block axis for every component (data AND the
+            # int8 scale planes): slice the pow2 padding off uniformly
+            arrs = tuple(a[:, :count] for a in arrs)
             if to_host:
-                return np.asarray(kb), np.asarray(vb)
-            return kb, vb
+                return tuple(np.asarray(a) for a in arrs)
+            return arrs
 
         return await self._call_on_scheduler(gather)
 
@@ -1396,10 +1468,16 @@ class JaxEngine:
 
         def stage() -> int:
             n = 0
-            for h, k, v in blocks:
+            arity = len(self.kv)
+            for h, *arrays in blocks:
                 if h in self.kvbm:
                     continue
-                self._emit_tier_events(self.kvbm.offload(h, k, v))
+                if len(arrays) != arity:
+                    # peer runs the other cache dtype (mixed fleet): its
+                    # payload cannot scatter into this cache — skip, the
+                    # leading-run contract makes the tail unusable too
+                    break
+                self._emit_tier_events(self.kvbm.offload(h, *arrays))
                 n += 1
             return n
 
@@ -1423,7 +1501,7 @@ class JaxEngine:
                 self._emit_tier_events(events)
                 if blk is None:
                     break
-                out.append((h, blk[0], blk[1]))
+                out.append((h, *blk))
             return out
 
         return self._call_on_scheduler(read)
@@ -1445,16 +1523,15 @@ class JaxEngine:
         ids = _pow2_ids([bid for _, bid in cands])
         if self.step_sink is not None:
             self.step_sink("gather", {"ids": ids})
-        kb, vb = self._jit_gather(self.kv, jnp.asarray(ids))
-        kb = np.asarray(kb)
-        vb = np.asarray(vb)
+        arrs = [np.asarray(a)
+                for a in self._jit_gather(self.kv, jnp.asarray(ids))]
         for i, (h, _) in enumerate(cands):
             # contiguous copies: a [:, i] view would pin the whole gathered
-            # batch buffer in host RAM for as long as any one block lives
+            # batch buffer in host RAM for as long as any one block lives.
+            # int8 caches offload (k, v, k_scale, v_scale) per block —
+            # half the host-tier bytes, scales bit-exact (kvbm/pools.py)
             self._emit_tier_events(self.kvbm.offload(
-                h, np.ascontiguousarray(kb[:, i]),
-                np.ascontiguousarray(vb[:, i]),
-            ))
+                h, *(np.ascontiguousarray(a[:, i]) for a in arrs)))
 
     def _try_onboard(self, slot: _Slot, hit: int, cap_blocks: int) -> int:
         """Extend a G1 prefix hit with blocks onboarded from G2/G3: scatter
@@ -1467,30 +1544,45 @@ class JaxEngine:
         if run == 0:
             return 0
         block_ids = self.allocator.seq_block_ids(self._seq_id(slot))
-        ks, vs, ids = [], [], []
+        arity = len(self.kv)
+        comps: List[list] = [[] for _ in range(arity)]
+        ids = []
         for i in range(hit, hit + run):
             blk, events = self.kvbm.fetch(hashes[i])
             self._emit_tier_events(events)
             if blk is None:  # dropped from the pool mid-walk
                 break
-            k, v = blk
-            ks.append(k)
-            vs.append(v)
+            if len(blk) != arity:
+                # a block staged from a peer running the OTHER cache
+                # dtype (mixed fleet): scatter-without-scales would be
+                # silent corruption — treat as a miss and recompute
+                logger.warning(
+                    "KVBM block %x has %d payload arrays but the cache "
+                    "expects %d (kv dtype mismatch); recomputing",
+                    hashes[i], len(blk), arity)
+                break
+            for c, arr in zip(comps, blk):
+                c.append(arr)
             ids.append(block_ids[i])
         if not ids:
             return 0
         n = len(ids)
         ids_arr = _pow2_ids(ids)
         bucket = len(ids_arr)
-        pad = [(0, 0), (0, bucket - n)] + [(0, 0)] * (ks[0].ndim - 1)
-        kb = np.pad(np.stack(ks, axis=1), pad)
-        vb = np.pad(np.stack(vs, axis=1), pad)
+        stacked = []
+        for c in comps:
+            pad = [(0, 0), (0, bucket - n)] + [(0, 0)] * (c[0].ndim - 1)
+            stacked.append(np.pad(np.stack(c, axis=1), pad))
         if self.step_sink is not None:
             # onboard payloads ride the wire so followers need no KVBM
             # tiers of their own — their self.kv evolves from the stream
-            self.step_sink("inject", {"kb": kb, "vb": vb, "ids": ids_arr})
+            desc = {"kb": stacked[0], "vb": stacked[1], "ids": ids_arr}
+            if arity == 4:
+                desc["ksb"], desc["vsb"] = stacked[2], stacked[3]
+            self.step_sink("inject", desc)
         self.kv = self._jit_inject(
-            self.kv, jnp.asarray(kb), jnp.asarray(vb), jnp.asarray(ids_arr)
+            self.kv, *(jnp.asarray(a) for a in stacked[:2]),
+            jnp.asarray(ids_arr), *(jnp.asarray(a) for a in stacked[2:])
         )
         return n
 
@@ -1954,15 +2046,15 @@ class JaxEngine:
                 for idx, (b0, n) in enumerate(spans):
                     if slot.finished or slot.cancel_requested:
                         return
-                    kb, vb = await nxt
+                    arrs = await nxt
                     nxt = (asyncio.ensure_future(
                         src.chunk(*spans[idx + 1]))
                         if idx + 1 < len(spans) else None)
                     await self._call_on_scheduler(
                         partial(self._inject_pulled_chunk, slot, b0, n,
-                                kb, vb))
-                    if isinstance(kb, np.ndarray):
-                        nbytes = kb.nbytes + vb.nbytes
+                                arrs))
+                    if isinstance(arrs[0], np.ndarray):
+                        nbytes = sum(a.nbytes for a in arrs)
                         self.metrics["pull_host_chunk_bytes_max"] = max(
                             self.metrics.get("pull_host_chunk_bytes_max",
                                              0),
@@ -2020,37 +2112,47 @@ class JaxEngine:
                     pass
 
     def _inject_pulled_chunk(self, slot: _Slot, b0: int, n: int,
-                             kb, vb) -> None:
+                             arrs) -> None:
         """Scheduler op: scatter one pulled chunk into the slot's blocks.
 
-        kb/vb are numpy (host-staged tier) or device arrays (broker /
+        `arrs` is (kb, vb) — plus (ksb, vsb) scale planes for an int8
+        cache — numpy (host-staged tier) or device arrays (broker /
         transfer-server tiers).  Device chunks are re-laid onto this
         engine's own universal sharding first — with a different source
         mesh that device_put IS the ICI device-to-device move."""
         if slot.finished or slot.cancel_requested:
             return  # blocks may already be freed; drop the chunk
+        if len(arrs) != len(self.kv):
+            raise ValueError(
+                f"pulled chunk has {len(arrs)} payload arrays but the "
+                f"cache expects {len(self.kv)} (kv dtype mismatch)")
         block_ids = self.allocator.seq_block_ids(
             self._seq_id(slot))[b0:b0 + n]
         if len(block_ids) != n:
             raise ValueError(f"slot lost blocks [{b0},{b0 + n}) mid-pull")
         ids = _pow2_ids(block_ids)
         bucket = len(ids)
-        if isinstance(kb, np.ndarray):
-            pad = ((0, 0), (0, bucket - n)) + ((0, 0),) * (kb.ndim - 2)
-            kb_p, vb_p = np.pad(kb, pad), np.pad(vb, pad)
+        if isinstance(arrs[0], np.ndarray):
+            padded = [np.pad(a, ((0, 0), (0, bucket - n))
+                             + ((0, 0),) * (a.ndim - 2)) for a in arrs]
         else:
-            sk, sv = self.universal_shardings()
-            kb, vb = jax.device_put(kb, sk), jax.device_put(vb, sv)
-            pad = ((0, 0), (0, bucket - n)) + ((0, 0),) * (kb.ndim - 2)
-            kb_p, vb_p = jnp.pad(kb, pad), jnp.pad(vb, pad)
+            shardings = self.universal_shardings()
+            arrs = [jax.device_put(a, sh) for a, sh in zip(arrs, shardings)]
+            padded = [jnp.pad(a, ((0, 0), (0, bucket - n))
+                              + ((0, 0),) * (a.ndim - 2)) for a in arrs]
         if self.step_sink is not None:
             # the pulled KV rides the step stream to the slice's followers
             # (device-resident tiers are gated off for multi-host slices,
-            # so kb_p/vb_p are host bytes here)
-            self.step_sink("inject", {"kb": np.asarray(kb_p),
-                                      "vb": np.asarray(vb_p), "ids": ids})
+            # so the padded chunks are host bytes here)
+            desc = {"kb": np.asarray(padded[0]), "vb": np.asarray(padded[1]),
+                    "ids": ids}
+            if len(padded) == 4:
+                desc["ksb"] = np.asarray(padded[2])
+                desc["vsb"] = np.asarray(padded[3])
+            self.step_sink("inject", desc)
         self.kv = self._jit_inject(
-            self.kv, jnp.asarray(kb_p), jnp.asarray(vb_p), jnp.asarray(ids)
+            self.kv, *(jnp.asarray(a) for a in padded[:2]),
+            jnp.asarray(ids), *(jnp.asarray(a) for a in padded[2:])
         )
 
     def _finish_pull(self, slot: _Slot, first: Optional[int]) -> None:

@@ -104,6 +104,15 @@ class JaxEngineWorker:
                 "tp": self.config.tp,
                 "dp": self.config.dp,
                 "role": self.config.role,
+                # EFFECTIVE KV storage dtype (quant/kv.py): the engine
+                # may fall back to bf16 for families without a quantized
+                # path (MLA), and routers/planners must see what is
+                # actually served — e.g. the planner warns when an ITL
+                # profile measured at one dtype steers a worker at the
+                # other (planner/perf_model.py)
+                "kv_cache_dtype": (self.engine.kv_dtype
+                                   if self.engine is not None
+                                   else self.config.kv_cache_dtype),
                 # chunked-prefill scheduling knobs (engine/prefill.py):
                 # routers/planners can see each worker's chunk budget
                 "prefill_chunk_tokens": self.config.chunk_budget,
@@ -266,8 +275,8 @@ class JaxEngineWorker:
 
             hashes = list(payload.get("hashes", []))[:128]
             blocks = await self.engine.read_host_blocks(hashes)
-            for h, k, v in blocks:
-                yield encode_block(h, k, v)
+            for h, *arrays in blocks:
+                yield encode_block(h, *arrays)
             if len(blocks) < len(hashes):
                 yield {"h": None}
 
@@ -295,25 +304,25 @@ class JaxEngineWorker:
                         and self._transfer_addr() is not None:
                     from ..disagg import device_transfer
 
-                    kb, vb = await self.engine.extract_parked_chunk(
+                    arrs = await self.engine.extract_parked_chunk(
                         rid, b0, n, to_host=False)
                     # canonical single-shard wire form (the server needs
                     # identical shard structure on both ends); the
-                    # tp-gather onto one device rides ICI
+                    # tp-gather onto one device rides ICI.  int8 caches
+                    # park 4 arrays (data + scale planes).
                     dev = self.engine.mesh.devices.flat[0]
-                    kb = jax.device_put(kb, dev)
-                    vb = jax.device_put(vb, dev)
+                    arrs = tuple(jax.device_put(a, dev) for a in arrs)
                     uid = device_transfer.next_uuid()
                     device_transfer.get_transfer_server().await_pull(
-                        uid, [kb, vb])
+                        uid, list(arrs))
                     # ref held until the next chunk/close (receiver pacing
                     # proves consumption) so the arrays outlive the pull
-                    self._chunk_refs.park(rid, uid, (kb, vb))
+                    self._chunk_refs.park(rid, uid, arrs)
                     yield {"uuid": uid}
                 else:
-                    kb, vb = await self.engine.extract_parked_chunk(
+                    arrs = await self.engine.extract_parked_chunk(
                         rid, b0, n)
-                    yield encode_chunk_frame(b0, kb, vb)
+                    yield encode_chunk_frame(b0, *arrs)
             elif op == "close":
                 self._chunk_refs.release(rid)
                 await self.engine.release_parked(rid)
@@ -540,6 +549,9 @@ class JaxEngineWorker:
                 "active_seqs": self.engine.num_active_seqs,
                 "kv_usage": self.engine.kv_usage(),
                 "kv_total_blocks": self.config.num_blocks,
+                # effective KV dtype: the planner checks live workers
+                # against the perf profile's dtype tag
+                "kv_cache_dtype": self.engine.kv_dtype,
                 "engine_metrics": dict(self.engine.metrics),
                 # stable SLA-planner contract (planner/metrics.py
                 # differentiates these; engine_metrics above is an

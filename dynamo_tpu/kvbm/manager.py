@@ -84,12 +84,14 @@ class TieredKvManager:
         return (h in self.g2 or (self.g3 is not None and h in self.g3)
                 or (self.g4 is not None and h in self.g4))
 
-    def offload(self, h: int, k: np.ndarray, v: np.ndarray) -> TierEvents:
-        """Place one block into G2; returns tier events."""
+    def offload(self, h: int, *arrays: np.ndarray) -> TierEvents:
+        """Place one block into G2 ((k, v), or (k, v, ks, vs) for an int8
+        cache — the quantized payload moves verbatim); returns tier
+        events."""
         events: TierEvents = [([h], [], "g2")]
         self.stats["offloaded"] += 1
         self._dropped.pop(h, None)
-        for victim_h, blk in self.g2.put(h, k, v):
+        for victim_h, blk in self.g2.put(h, *arrays):
             events.extend(self._demote(victim_h, blk))
         return events
 

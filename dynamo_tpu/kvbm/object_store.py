@@ -26,9 +26,12 @@ from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from .pools import _load_block, _save_block
+
 logger = logging.getLogger(__name__)
 
-Block = Tuple[np.ndarray, np.ndarray]
+# (k, v) — plus (k_scale, v_scale) for int8-quantized blocks (quant/kv.py)
+Block = Tuple[np.ndarray, ...]
 
 
 class ObjectStorePool:
@@ -56,7 +59,7 @@ class ObjectStorePool:
     def __contains__(self, h: int) -> bool:
         return os.path.isfile(self._path(h))
 
-    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> bool:
+    def put(self, h: int, *arrays: np.ndarray) -> bool:
         """Atomic write; returns False if the blob already existed (same
         content by construction — PLH keys commit to the payload)."""
         p = self._path(h)
@@ -68,9 +71,7 @@ class ObjectStorePool:
             with open(tmp, "wb") as f:
                 # npz round-trips ml_dtypes (bfloat16) as raw void; persist
                 # byte views + dtype names (same trick as DiskBlockPool)
-                np.savez(f, k=np.ascontiguousarray(k).view(np.uint8),
-                         v=np.ascontiguousarray(v).view(np.uint8),
-                         kd=str(k.dtype), vd=str(v.dtype))
+                _save_block(f, arrays)
             os.replace(tmp, p)
         except OSError:
             logger.warning("G4 put failed for %032x", h, exc_info=True)
@@ -82,12 +83,9 @@ class ObjectStorePool:
         return True
 
     def get(self, h: int) -> Optional[Block]:
-        from .pools import _np_dtype
-
         try:
             with np.load(self._path(h)) as z:
-                return (z["k"].view(_np_dtype(z["kd"].item())),
-                        z["v"].view(_np_dtype(z["vd"].item())))
+                return _load_block(z)
         except (OSError, KeyError, ValueError, TypeError, AttributeError):
             return None  # concurrent GC / torn write: treat as miss
 

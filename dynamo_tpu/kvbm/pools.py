@@ -9,6 +9,12 @@ move HBM→host→disk→HBM (or across workers) without reinterpretation.
 
 Pools are plain LRU maps keyed by PLH.  They run on the engine's scheduler
 thread only, so no locking.
+
+Int8 caches (quant/kv.py) offload FOUR arrays per block — (k, v) int8
+plus the fp32 scale planes (k_scale, v_scale) [L, bs, nkv] — half the
+host/disk bytes of a bf16 block.  Pools treat the payload tuple
+opaquely and round-trip every member bit-exactly, so a block moves
+HBM→host→disk→object→HBM (or across workers) still quantized.
 """
 
 from __future__ import annotations
@@ -22,7 +28,28 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-Block = Tuple[np.ndarray, np.ndarray]  # (k, v), each [L, bs, nkv, hd]
+# (k, v) each [L, bs, nkv, hd] — plus (k_scale, v_scale) for int8 blocks
+Block = Tuple[np.ndarray, ...]
+
+# npz member names for the payload tuple, in order (scales optional)
+_MEMBERS = ("k", "v", "ks", "vs")
+
+
+def _save_block(path_or_file, arrays: Sequence[np.ndarray]) -> None:
+    """npz round-trips ml_dtypes (bfloat16, the default KV dtype) as raw
+    void ('|V2') — persist byte views + dtype names and view() back."""
+    payload = {}
+    for name, arr in zip(_MEMBERS, arrays):
+        payload[name] = np.ascontiguousarray(arr).view(np.uint8)
+        payload[name + "d"] = str(arr.dtype)
+    np.savez(path_or_file, **payload)
+
+
+def _load_block(z) -> Block:
+    return tuple(
+        z[name].view(_np_dtype(z[name + "d"].item()))
+        for name in _MEMBERS if name in getattr(z, "files", z)
+    )
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -51,12 +78,13 @@ class HostBlockPool:
     def __contains__(self, h: int) -> bool:
         return h in self._blocks
 
-    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> List[Tuple[int, Block]]:
-        """Insert a block; returns LRU-evicted (hash, block) pairs."""
+    def put(self, h: int, *arrays: np.ndarray) -> List[Tuple[int, Block]]:
+        """Insert a block ((k, v) or (k, v, ks, vs)); returns LRU-evicted
+        (hash, block) pairs."""
         if h in self._blocks:
             self._blocks.move_to_end(h)
             return []
-        self._blocks[h] = (k, v)
+        self._blocks[h] = tuple(arrays)
         evicted: List[Tuple[int, Block]] = []
         while len(self._blocks) > self.capacity:
             evicted.append(self._blocks.popitem(last=False))
@@ -128,17 +156,12 @@ class DiskBlockPool:
     def __contains__(self, h: int) -> bool:
         return h in self._order
 
-    def put(self, h: int, k: np.ndarray, v: np.ndarray) -> List[int]:
+    def put(self, h: int, *arrays: np.ndarray) -> List[int]:
         """Persist a block; returns hashes evicted to make room."""
         if h in self._order:
             self._order.move_to_end(h)
             return []
-        # npz round-trips ml_dtypes (bfloat16, the default KV dtype) as raw
-        # void ('|V2') — persist byte views + dtype names and view() back
-        np.savez(self._path(h),
-                 k=np.ascontiguousarray(k).view(np.uint8),
-                 v=np.ascontiguousarray(v).view(np.uint8),
-                 kd=str(k.dtype), vd=str(v.dtype))
+        _save_block(self._path(h), arrays)
         self._order[h] = None
         evicted: List[int] = []
         while len(self._order) > self.capacity:
@@ -147,18 +170,16 @@ class DiskBlockPool:
             evicted.append(old)
         return evicted
 
-    def put_with_victims(self, h: int, k: np.ndarray,
-                         v: np.ndarray) -> List[Tuple[int, Optional[Block]]]:
+    def put_with_victims(
+            self, h: int,
+            *arrays: np.ndarray) -> List[Tuple[int, Optional[Block]]]:
         """Like put(), but each victim's payload is read back before its
         file is deleted — the G4 spill path needs the bytes (one extra
         disk read per eviction, paid only when G4 is configured)."""
         if h in self._order:
             self._order.move_to_end(h)
             return []
-        np.savez(self._path(h),
-                 k=np.ascontiguousarray(k).view(np.uint8),
-                 v=np.ascontiguousarray(v).view(np.uint8),
-                 kd=str(k.dtype), vd=str(v.dtype))
+        _save_block(self._path(h), arrays)
         self._order[h] = None
         evicted: List[Tuple[int, Optional[Block]]] = []
         while len(self._order) > self.capacity:
@@ -177,8 +198,7 @@ class DiskBlockPool:
             return None
         try:
             with np.load(self._path(h)) as z:
-                blk = (z["k"].view(_np_dtype(z["kd"].item())),
-                       z["v"].view(_np_dtype(z["vd"].item())))
+                blk = _load_block(z)
         except (OSError, KeyError, TypeError, AttributeError):
             logger.warning("G3 block %x unreadable; dropping", h)
             self._order.pop(h, None)

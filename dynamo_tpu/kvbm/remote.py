@@ -121,22 +121,31 @@ class RemoteBlockIndex:
                 pass
 
 
-def encode_block(h: int, k: np.ndarray, v: np.ndarray) -> Dict:
-    return {"h": h,
-            "k": np.ascontiguousarray(k).view(np.uint8).tobytes(),
-            "v": np.ascontiguousarray(v).view(np.uint8).tobytes(),
-            "kd": str(k.dtype), "vd": str(v.dtype),
-            "kshape": list(k.shape), "vshape": list(v.shape)}
+# wire member names, in payload-tuple order (scales ride for int8 blocks)
+_WIRE_MEMBERS = ("k", "v", "ks", "vs")
 
 
-def decode_block(d: Dict) -> Tuple[int, np.ndarray, np.ndarray]:
+def encode_block(h: int, *arrays: np.ndarray) -> Dict:
+    """Block payload -> wire frame: (k, v) or (k, v, ks, vs) — an int8
+    block's quantized data + fp32 scales move verbatim (half the bytes
+    of a bf16 pull, scales bit-exact)."""
+    d: Dict = {"h": h}
+    for name, arr in zip(_WIRE_MEMBERS, arrays):
+        d[name] = np.ascontiguousarray(arr).view(np.uint8).tobytes()
+        d[name + "d"] = str(arr.dtype)
+        d[name + "shape"] = list(arr.shape)
+    return d
+
+
+def decode_block(d: Dict) -> Tuple:
     from .pools import _np_dtype
 
-    k = np.frombuffer(d["k"], np.uint8).view(
-        _np_dtype(d["kd"])).reshape(d["kshape"])
-    v = np.frombuffer(d["v"], np.uint8).view(
-        _np_dtype(d["vd"])).reshape(d["vshape"])
-    return d["h"], k, v
+    arrays = tuple(
+        np.frombuffer(d[name], np.uint8).view(
+            _np_dtype(d[name + "d"])).reshape(d[name + "shape"])
+        for name in _WIRE_MEMBERS if name in d
+    )
+    return (d["h"], *arrays)
 
 
 class RemoteKvbmPuller:
@@ -151,7 +160,7 @@ class RemoteKvbmPuller:
 
     async def fetch_run(
         self, hashes: Sequence[int]
-    ) -> List[Tuple[int, np.ndarray, np.ndarray]]:
+    ) -> List[Tuple]:
         """Blocks for the longest leading run a single peer holds (may
         return fewer than advertised — peers evict concurrently)."""
         hashes = list(hashes)[: self.max_blocks]
@@ -159,7 +168,7 @@ class RemoteKvbmPuller:
         if worker is None or run == 0:
             return []
         want = hashes[:run]
-        out: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        out: List[Tuple] = []
 
         async def pull() -> None:
             async for frame in self.client.generate(
@@ -180,9 +189,9 @@ class RemoteKvbmPuller:
                            worker, len(out), exc_info=True)
             self.index.drop_worker(worker)
         # enforce the leading-run contract: a gap invalidates the tail
-        usable: List[Tuple[int, np.ndarray, np.ndarray]] = []
-        for (h, k, v), expect in zip(out, want):
-            if h != expect:
+        usable: List[Tuple] = []
+        for blk, expect in zip(out, want):
+            if blk[0] != expect:
                 break
-            usable.append((h, k, v))
+            usable.append(blk)
         return usable

@@ -32,6 +32,12 @@ def build_args() -> argparse.ArgumentParser:
                         "per step (0 = off)")
     p.add_argument("--spec-acceptance", type=float, default=0.5,
                    help="simulated per-draft acceptance probability")
+    p.add_argument("--kv-cache-dtype", default="bf16",
+                   choices=["bf16", "int8"],
+                   help="simulated KV storage dtype: int8 scales the "
+                        "block pool to what the same HBM budget holds "
+                        "at int8 bytes-per-block (~1.94x blocks) and is "
+                        "advertised in the MDC like the JAX worker")
     return p
 
 
@@ -49,6 +55,7 @@ async def main() -> None:
         role=args.role,
         speculative=({"k": args.spec_k, "acceptance": args.spec_acceptance}
                      if args.spec_k > 0 else None),
+        kv_cache_dtype=args.kv_cache_dtype,
     )
     rt = await DistributedRuntime.detached().start()
     workers = []

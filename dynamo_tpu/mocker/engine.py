@@ -57,6 +57,13 @@ class MockEngineArgs:
     # tests exercise the acceptance plumbing without a real model.
     # None disables.
     speculative: Optional[dict] = None
+    # simulated KV quantization (mirrors engine/config.py
+    # kv_cache_dtype): "int8" scales the simulated block pool to what
+    # the same HBM budget holds at int8 bytes-per-block
+    # (kv_cache_sim.kv_dtype_capacity_blocks, ~1.94x) and is advertised
+    # in the MDC exactly like the JAX worker, so router/planner tier-1
+    # tests cover the 2x-blocks regime without a TPU
+    kv_cache_dtype: str = "bf16"
 
 
 @dataclass
@@ -84,7 +91,8 @@ class MockEngine:
         from .kv_cache_sim import KvCacheSim
 
         self.args = args
-        self.cache = KvCacheSim(args.num_blocks, args.enable_prefix_caching)
+        self.cache = KvCacheSim(args.num_blocks, args.enable_prefix_caching,
+                                kv_cache_dtype=args.kv_cache_dtype)
         self.publisher = kv_event_publisher
         self.waiting: List[_Seq] = []
         self.running: List[_Seq] = []

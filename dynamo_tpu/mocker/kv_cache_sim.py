@@ -14,6 +14,19 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 
+def kv_dtype_capacity_blocks(num_blocks: int, kv_cache_dtype: str,
+                             head_dim: int = 128) -> int:
+    """Effective block capacity for a simulated cache at a given KV
+    storage dtype: the SAME HBM budget that holds `num_blocks` bf16
+    blocks holds 2*hd/(hd+4) as many int8 blocks (int8 data + one fp32
+    scale per head_dim elements — quant/kv.py's exact byte ratio; 1.94x
+    at the default head_dim 128).  Keeps router/planner tests honest
+    about the 2x-blocks regime without a TPU or a real model config."""
+    if kv_cache_dtype == "int8":
+        return max(1, int(num_blocks * 2 * head_dim / (head_dim + 4)))
+    return num_blocks
+
+
 @dataclass
 class CacheStepResult:
     stored: List[int] = field(default_factory=list)  # newly stored full-block PLHs
@@ -22,7 +35,10 @@ class CacheStepResult:
 
 
 class KvCacheSim:
-    def __init__(self, num_blocks: int, enable_prefix_caching: bool = True):
+    def __init__(self, num_blocks: int, enable_prefix_caching: bool = True,
+                 kv_cache_dtype: str = "bf16"):
+        num_blocks = kv_dtype_capacity_blocks(num_blocks, kv_cache_dtype)
+        self.kv_cache_dtype = kv_cache_dtype
         self.num_blocks = num_blocks
         self.enable_prefix_caching = enable_prefix_caching
         self.free_blocks = num_blocks

@@ -17,6 +17,7 @@ from ..protocols.model_card import register_model
 from ..router.events import KvEventPublisher
 from ..runtime import DistributedRuntime
 from .engine import MockEngine, MockEngineArgs
+from .kv_cache_sim import kv_dtype_capacity_blocks
 
 logger = logging.getLogger(__name__)
 
@@ -49,9 +50,15 @@ class MockerWorker:
             kv_cache_block_size=self.args.block_size,
             migration_limit=self.migration_limit,
             runtime_config={
-                "total_kv_blocks": self.args.num_blocks,
+                # EFFECTIVE capacity: int8 simulation scales the pool
+                # (kv_cache_sim.kv_dtype_capacity_blocks), and routers
+                # cost workers by what they actually hold
+                "total_kv_blocks": kv_dtype_capacity_blocks(
+                    self.args.num_blocks, self.args.kv_cache_dtype),
                 "max_num_seqs": self.args.max_num_seqs,
                 "role": self.args.role,
+                # same advertisement shape as the JAX worker
+                "kv_cache_dtype": self.args.kv_cache_dtype,
                 # simulated speculative decoding knobs (same shape the
                 # JAX worker advertises: planners/routers can see the
                 # configured draft length)
@@ -192,6 +199,7 @@ class MockerWorker:
                              / len(self.engines)),
                 "kv_total_blocks": sum(e.cache.num_blocks
                                        for e in self.engines),
+                "kv_cache_dtype": self.args.kv_cache_dtype,
                 # per-rank load: the router costs each rank separately
                 **({"dp_size": len(self.engines),
                     "ranks": [{"dp_rank": r, "kv_usage": e.kv_usage(),

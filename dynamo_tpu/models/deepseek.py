@@ -142,7 +142,14 @@ PRESETS: Dict[str, DeepseekConfig] = {
 def kv_cache_shapes(cfg: DeepseekConfig, num_blocks: int,
                     block_size: int) -> Tuple[tuple, tuple]:
     """(latent cache, rope-key cache) in the shared head-major layout with
-    nkv=1 — every block op (scatter/gather/offload/transfer) reuses it."""
+    nkv=1 — every block op (scatter/gather/offload/transfer) reuses it.
+
+    NOTE: this family deliberately has NO kv_cache_scale_shapes — the MLA
+    latent is already a ~4x compression of the per-head K/V and the
+    weight-absorbed decode consumes it inside matmuls where per-position
+    int8 scales don't factor out cleanly, so `kv_cache_dtype="int8"`
+    auto-falls back to bf16 here (engine/core.py, same precedent as the
+    MLA packed-prefill and spec-decode fallbacks)."""
     return (
         (cfg.n_layers, 1, num_blocks, cfg.kv_lora_rank, block_size),
         (cfg.n_layers, 1, num_blocks, cfg.qk_rope_head_dim, block_size),

@@ -28,6 +28,7 @@ from ..ops.paged_attention import (
     write_prompt_kv_batched,
     write_token_kv,
 )
+from ..quant.kv import unpack_kv
 
 
 @dataclass(frozen=True)
@@ -98,6 +99,42 @@ def kv_cache_specs() -> tuple:
     return kv_cache_spec(), kv_cache_spec()
 
 
+def kv_cache_scale_shapes(cfg: "LlamaConfig", num_blocks: int,
+                          block_size: int) -> tuple:
+    """(k_scale, v_scale) shapes for an int8 cache (quant/kv.py): one
+    fp32 scale per (layer, kv_head, block, position), sibling to the
+    paged cache.  The presence of this function is what marks a family
+    as supporting `kv_cache_dtype="int8"` — families without it (MLA)
+    auto-fall back to bf16 in the engine."""
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_blocks, block_size)
+    return shape, shape
+
+
+def kv_cache_scale_specs() -> tuple:
+    """Scale planes shard with the cache (parallel/mesh.py
+    kv_scale_spec: kv_heads over tp)."""
+    from ..parallel.mesh import kv_scale_spec
+
+    return kv_scale_spec(), kv_scale_spec()
+
+
+# (k, v, k_scale | None, v_scale | None) from either cache arity —
+# the shared tuple convention lives in quant/kv.py
+_unpack_kv = unpack_kv
+
+
+def _write_kv(fn, kv_cache, layer, *args):
+    """Dispatch a cache write through `fn` (a write_* op from
+    ops/paged_attention.py or ops/packed_prefill.py), threading the
+    quantization scales when the cache is int8.  Returns the new cache
+    tuple in the input's arity."""
+    if len(kv_cache) == 4:
+        k, v, ks, vs = kv_cache
+        return fn(k, v, layer, *args, k_scale=ks, v_scale=vs)
+    k, v = kv_cache
+    return fn(k, v, layer, *args)
+
+
 def prefill_ring(
     params: Dict[str, Any],
     cfg: "LlamaConfig",
@@ -121,16 +158,14 @@ def prefill_ring(
     (logits at the last valid position, updated kv_cache)."""
     from ..ops.ring_attention import ring_attention
 
-    k_cache, v_cache = kv_cache
     zero = jnp.int32(0)
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
     T = x.shape[0]
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
         q, k, v = _qkv(layer, cfg, h, positions)
-        k_cache, v_cache = write_prompt_kv(
-            k_cache, v_cache, li, k, v, block_table, zero, true_len
-        )
+        kv_cache = _write_kv(write_prompt_kv, kv_cache, li, k, v,
+                             block_table, zero, true_len)
         attn = ring_attention(q[None], k[None], v[None], mesh,
                               head_axis="tp")[0]
         x = x + _attn_out(layer, attn.reshape(T, cfg.q_dim))
@@ -138,7 +173,7 @@ def prefill_ring(
         x = x + _ffn(layer, cfg, h, valid=jnp.arange(T) < true_len)
     last = jnp.maximum(true_len - 1, 0)
     logits = _logits(params, cfg, x[last])
-    return logits, (k_cache, v_cache)
+    return logits, kv_cache
 
 
 PRESETS: Dict[str, LlamaConfig] = {
@@ -466,17 +501,17 @@ def prefill(
     themselves causally.  Writes the new tokens' K/V into the paged cache.
     Returns (logits_at_last_valid [vocab], updated kv_cache).
     """
-    k_cache, v_cache = kv_cache
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
     for li, layer in enumerate(params["layers"]):
         lctx = _lora_ctx(lora_bank, adapter_idx, li)
         h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
         q, k, v = _qkv(layer, cfg, h, positions, lora=lctx)
-        k_cache, v_cache = write_prompt_kv(
-            k_cache, v_cache, li, k, v, block_table, ctx_len, true_len
-        )
+        kv_cache = _write_kv(write_prompt_kv, kv_cache, li, k, v,
+                             block_table, ctx_len, true_len)
+        k_cache, v_cache, ks, vs = _unpack_kv(kv_cache)
         attn = paged_prefill_attention(
-            q, k, v, k_cache, v_cache, li, block_table, ctx_len, true_len
+            q, k, v, k_cache, v_cache, li, block_table, ctx_len, true_len,
+            k_scale=ks, v_scale=vs,
         )
         x = x + _attn_out(layer, attn.reshape(x.shape[0], cfg.q_dim),
                           lora=lctx)
@@ -486,7 +521,7 @@ def prefill(
                      valid=jnp.arange(x.shape[0]) < true_len)
     last = jnp.maximum(true_len - 1, 0)
     logits = _logits(params, cfg, x[last])
-    return logits, (k_cache, v_cache)
+    return logits, kv_cache
 
 
 def prefill_batched(
@@ -513,7 +548,6 @@ def prefill_batched(
     garbage block.  Returns (logits [Bp, vocab] at each row's last valid
     token, updated kv_cache).
     """
-    k_cache, v_cache = kv_cache
     Bp, T = token_ids.shape
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [Bp, T, d]
     valid = jnp.arange(T)[None, :] < true_lens[:, None]   # [Bp, T]
@@ -521,12 +555,13 @@ def prefill_batched(
         lctx = _lora_ctx(lora_bank, adapter_idx, li)
         h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
         q, k, v = _qkv(layer, cfg, h, positions, lora=lctx)  # [Bp,T,nh,hd]
-        k_cache, v_cache = write_prompt_kv_batched(
-            k_cache, v_cache, li, k, v, block_tables, ctx_lens, true_lens
-        )
+        kv_cache = _write_kv(write_prompt_kv_batched, kv_cache, li, k, v,
+                             block_tables, ctx_lens, true_lens)
+        k_cache, v_cache, ks, vs = _unpack_kv(kv_cache)
         attn = jax.vmap(
             lambda qb, kb, vb, tb, cl, tl: paged_prefill_attention(
-                qb, kb, vb, k_cache, v_cache, li, tb, cl, tl
+                qb, kb, vb, k_cache, v_cache, li, tb, cl, tl,
+                k_scale=ks, v_scale=vs,
             )
         )(q, k, v, block_tables, ctx_lens, true_lens)
         x = x + _attn_out(layer, attn.reshape(Bp, T, cfg.q_dim), lora=lctx)
@@ -543,7 +578,7 @@ def prefill_batched(
     last = jnp.maximum(true_lens - 1, 0)
     xl = x[jnp.arange(Bp), last]  # [Bp, d]
     logits = _logits(params, cfg, xl)
-    return logits, (k_cache, v_cache)
+    return logits, kv_cache
 
 
 def prefill_packed(
@@ -598,25 +633,23 @@ def _packed_forward(
     spec_verify_packed): K/V scatter into each token's own blocks, then
     causal-within-segment attention over each segment's paged context.
     Returns (final hidden states [T, d], updated kv_cache)."""
-    k_cache, v_cache = kv_cache
     T = token_ids.shape[0]
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
     for li, layer in enumerate(params["layers"]):
         lctx = _lora_ctx(lora_bank, adapter_idx, li)
         h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
         q, k, v = _qkv(layer, cfg, h, positions, lora=lctx)  # [T, nh, hd]
-        k_cache, v_cache = write_packed_kv(
-            k_cache, v_cache, li, k, v, block_tables, seg_ids, positions,
-            valid,
-        )
+        kv_cache = _write_kv(write_packed_kv, kv_cache, li, k, v,
+                             block_tables, seg_ids, positions, valid)
+        k_cache, v_cache, ks, vs = _unpack_kv(kv_cache)
         attn = packed_prefill_attention(
             q, k_cache, v_cache, li, block_tables, seg_ids, positions,
-            valid, impl=cfg.packed_attn_impl,
+            valid, impl=cfg.packed_attn_impl, k_scale=ks, v_scale=vs,
         )
         x = x + _attn_out(layer, attn.reshape(T, cfg.q_dim), lora=lctx)
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
         x = x + _ffn(layer, cfg, h, valid=valid)
-    return x, (k_cache, v_cache)
+    return x, kv_cache
 
 
 def spec_verify_packed(
@@ -701,26 +734,25 @@ def decode(
 ):
     """One decode step for B slots.  Writes each token's K/V, attends over
     the paged context, returns (logits [B, vocab], updated kv_cache)."""
-    k_cache, v_cache = kv_cache
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [B, d]
     pos1 = positions[:, None]  # [B, 1] for rope
     for li, layer in enumerate(params["layers"]):
         lctx = _lora_ctx(lora_bank, adapter_idx, li)
         h = rms_norm(x, layer["attn_norm"]["norm"], cfg.rms_eps)
         q, k, v = _qkv(layer, cfg, h[:, None, :], pos1, lora=lctx)
-        k_cache, v_cache = write_token_kv(
-            k_cache, v_cache, li, k[:, 0], v[:, 0], block_tables, ctx_lens
-        )
+        kv_cache = _write_kv(write_token_kv, kv_cache, li, k[:, 0],
+                             v[:, 0], block_tables, ctx_lens)
+        k_cache, v_cache, ks, vs = _unpack_kv(kv_cache)
         attn = paged_attention_decode(
             q[:, 0], k_cache, v_cache, li, block_tables, ctx_lens + 1,
-            impl=cfg.attn_impl, mesh=mesh,
+            impl=cfg.attn_impl, mesh=mesh, k_scale=ks, v_scale=vs,
         )  # [B, nh, hd]
         x = x + _attn_out(layer, attn.reshape(x.shape[0], cfg.q_dim),
                           lora=lctx)
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
         x = x + _ffn(layer, cfg, h, valid=valid)
     logits = _logits(params, cfg, x)  # [B, vocab]
-    return logits, (k_cache, v_cache)
+    return logits, kv_cache
 
 
 def decode_multi(

@@ -56,7 +56,13 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from .paged_attention import NEG_INF, _gather_ctx, _gqa_out, _gqa_scores
+from .paged_attention import (
+    NEG_INF,
+    _gather_ctx,
+    _gqa_out,
+    _gqa_scores,
+    _store_kv,
+)
 
 
 def write_packed_kv(
@@ -69,25 +75,23 @@ def write_packed_kv(
     seg_ids: jax.Array,       # [T] int32 segment row per token
     positions: jax.Array,     # [T] int32 absolute position per token
     valid: jax.Array,         # [T] bool (False = padded tail)
-) -> Tuple[jax.Array, jax.Array]:
+    k_scale: jax.Array = None,  # [L, nkv, nblocks, bs] fp32 (int8 cache)
+    v_scale: jax.Array = None,
+) -> Tuple[jax.Array, ...]:
     """Scatter a packed chunk's K/V into each token's own sequence blocks
     (one flat scatter; sequences own disjoint blocks, padding tokens land
-    in the garbage block)."""
+    in the garbage block).  With scales, tokens quantize per (token,
+    head) on the way in (paged_attention._store_kv)."""
     bs = k_cache.shape[4]
     blocks = block_tables[seg_ids, positions // bs]  # [T]
     offsets = positions % bs
     blocks = jnp.where(valid, blocks, 0)
-    k_cache = k_cache.at[layer, :, blocks, :, offsets].set(
-        k.astype(k_cache.dtype), mode="drop"
-    )
-    v_cache = v_cache.at[layer, :, blocks, :, offsets].set(
-        v.astype(v_cache.dtype), mode="drop"
-    )
-    return k_cache, v_cache
+    return _store_kv(k_cache, v_cache, layer, k, v, blocks, offsets,
+                     k_scale, v_scale)
 
 
 def _segment_flash(q, k_cache, v_cache, layer, table, token_mask,
-                   positions, chunk_cols):
+                   positions, chunk_cols, k_scale=None, v_scale=None):
     """One segment row's flash pass: online-softmax scan over chunks of
     `chunk_cols` block columns of the segment's paged context.  Returns
     fp32 attention output [T, nh, hd] for every packed token (foreign
@@ -105,8 +109,8 @@ def _segment_flash(q, k_cache, v_cache, layer, table, token_mask,
         m, l, acc = carry
         cols = jax.lax.dynamic_slice(table, (jc * chunk_cols,),
                                      (chunk_cols,))
-        k_c = _gather_ctx(k_cache, layer, cols)  # [nkv, C, hd]
-        v_c = _gather_ctx(v_cache, layer, cols)
+        k_c = _gather_ctx(k_cache, layer, cols, k_scale)  # [nkv, C, hd]
+        v_c = _gather_ctx(v_cache, layer, cols, v_scale)
         C = chunk_cols * bs
         s = _gqa_scores(q, k_c) * scale          # [T, nh, C] fp32
         span = jc * C + jnp.arange(C)
@@ -140,14 +144,18 @@ def packed_prefill_attention(
     valid: jax.Array,         # [T]
     impl: str = "auto",
     chunk_cols: int = 8,      # block columns per flash step
+    k_scale: jax.Array = None,  # int8 cache: dequant scales (quant/kv.py)
+    v_scale: jax.Array = None,
 ) -> jax.Array:
     """Causal-within-segment attention for a packed prefill chunk.
 
     Every token attends to its OWN segment's paged cache over absolute
     positions [0, positions[t]] — cached prefix plus the chunk itself,
-    whose K/V write_packed_kv already scattered in.  impl: "auto"/"xla"
-    (this XLA reference); "pallas" is reserved for a future hand-tiled
-    kernel.
+    whose K/V write_packed_kv already scattered in (so on an int8 cache
+    the chunk's own K/V round-trip the quantizer before attention reads
+    them — bit-consistent with how every later chunk will see them).
+    impl: "auto"/"xla" (this XLA reference); "pallas" is reserved for a
+    future hand-tiled kernel.
     """
     if impl not in ("auto", "xla"):
         raise ValueError(
@@ -159,6 +167,7 @@ def packed_prefill_attention(
     for s in range(S):  # static unroll: S = co-scheduled segment rows
         seg_mask = (seg_ids == s) & valid
         o_s = _segment_flash(q, k_cache, v_cache, layer, block_tables[s],
-                             seg_mask, positions, chunk_cols)
+                             seg_mask, positions, chunk_cols,
+                             k_scale, v_scale)
         out = jnp.where(seg_mask[:, None, None], o_s, out)
     return out.astype(q.dtype)

@@ -30,6 +30,23 @@ in paged_attention_decode; the kernel stays available via
 impl="pallas"), and "jnp_bf16" keeps matmul operands in the cache dtype
 with fp32 accumulation (the serving fast path; "jnp" upcasts to fp32
 for exact test numerics).
+
+Int8 KV quantization (quant/kv.py, engine `kv_cache_dtype="int8"`):
+every write function takes optional `k_scale`/`v_scale` sibling arrays
+[L, nkv, num_blocks, block_size] fp32 — when passed, the incoming K/V
+quantize per (token, head) on the way into the cache and the scale
+scatters with the same index math, and the function returns a 4-tuple.
+Which read impls support int8:
+
+  * "jnp" / "jnp_bf16" / "auto" — native: the int8 block gather is what
+    streams from HBM; dequantization happens on the gathered context
+    (`_gather_ctx`), upcast to fp32 ("jnp") or bf16 ("jnp_bf16", keeping
+    the MXU operands 16-bit with fp32 accumulation).
+  * "pallas" / "pallas_interpret" — NOT yet: the hand-tiled kernel has
+    no int8 lane layout, so a quantized cache routes these to the jnp
+    gather path (which round 5 measured faster on this platform
+    anyway).  An int8-native kernel (int8 MXU, fp32 accumulation) is
+    the follow-up once the Pallas DMA path beats XLA's gather.
 """
 
 from __future__ import annotations
@@ -40,12 +57,39 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..quant.kv import quantize_tokens
+
 NEG_INF = -1e30
 
 
 # ---------------------------------------------------------------------------
 # cache writes (block scatter)
 # ---------------------------------------------------------------------------
+
+
+def _store_kv(k_cache, v_cache, layer, k, v, blocks, offsets,
+              k_scale, v_scale):
+    """Shared scatter tail for every write site: data at
+    [layer, :, blocks, :, offsets] (advanced dims front — the target
+    reads [T, nkv, hd], exactly the token-major layout k/v arrive in),
+    and for an int8 cache the per-(token, head) fp32 scales at
+    [layer, :, blocks, offsets] (target [T, nkv]) with the SAME
+    blocks/offsets, so data and scale can never disagree on placement.
+    Returns the cache tuple in the caller's arity."""
+    if k_scale is not None:
+        k, ks = quantize_tokens(k)
+        v, vs = quantize_tokens(v)
+        k_scale = k_scale.at[layer, :, blocks, offsets].set(ks, mode="drop")
+        v_scale = v_scale.at[layer, :, blocks, offsets].set(vs, mode="drop")
+    k_cache = k_cache.at[layer, :, blocks, :, offsets].set(
+        k.astype(k_cache.dtype), mode="drop"
+    )
+    v_cache = v_cache.at[layer, :, blocks, :, offsets].set(
+        v.astype(v_cache.dtype), mode="drop"
+    )
+    if k_scale is not None:
+        return k_cache, v_cache, k_scale, v_scale
+    return k_cache, v_cache
 
 
 def write_prompt_kv(
@@ -57,7 +101,9 @@ def write_prompt_kv(
     block_table: jax.Array,  # [max_blocks] int32
     ctx_len: jax.Array,      # scalar: tokens already in cache
     true_len: jax.Array,     # scalar: valid entries of k/v
-) -> Tuple[jax.Array, jax.Array]:
+    k_scale: jax.Array = None,  # [L, nkv, nblocks, bs] fp32 (int8 cache)
+    v_scale: jax.Array = None,
+) -> Tuple[jax.Array, ...]:
     T = k.shape[0]
     bs = k_cache.shape[4]
     pos = ctx_len + jnp.arange(T, dtype=jnp.int32)  # absolute positions
@@ -66,16 +112,8 @@ def write_prompt_kv(
     valid = jnp.arange(T) < true_len
     # invalid rows scatter to the garbage block
     blocks = jnp.where(valid, blocks, 0)
-    # mixed indexing (scalar layer + slices + index arrays) moves the
-    # advanced dims to the FRONT: the target reads [T, nkv, hd] — exactly
-    # the token-major layout k/v arrive in (positions land on the lane dim)
-    k_cache = k_cache.at[layer, :, blocks, :, offsets].set(
-        k.astype(k_cache.dtype), mode="drop"
-    )
-    v_cache = v_cache.at[layer, :, blocks, :, offsets].set(
-        v.astype(v_cache.dtype), mode="drop"
-    )
-    return k_cache, v_cache
+    return _store_kv(k_cache, v_cache, layer, k, v, blocks, offsets,
+                     k_scale, v_scale)
 
 
 def write_prompt_kv_batched(
@@ -87,7 +125,9 @@ def write_prompt_kv_batched(
     block_tables: jax.Array,  # [Bp, max_blocks] int32
     ctx_lens: jax.Array,      # [Bp] tokens already in cache per sequence
     true_lens: jax.Array,     # [Bp] valid entries of each row of k/v
-) -> Tuple[jax.Array, jax.Array]:
+    k_scale: jax.Array = None,  # [L, nkv, nblocks, bs] fp32 (int8 cache)
+    v_scale: jax.Array = None,
+) -> Tuple[jax.Array, ...]:
     """Multi-sequence chunk scatter: Bp sequences' prefill chunks written in
     one flat scatter (sequences own disjoint blocks, so rows never collide;
     invalid/padding rows land in the garbage block)."""
@@ -102,13 +142,8 @@ def write_prompt_kv_batched(
     of = offsets.reshape(-1)
     kf = k.reshape(Bp * T, *k.shape[2:])
     vf = v.reshape(Bp * T, *v.shape[2:])
-    k_cache = k_cache.at[layer, :, bf, :, of].set(
-        kf.astype(k_cache.dtype), mode="drop"
-    )
-    v_cache = v_cache.at[layer, :, bf, :, of].set(
-        vf.astype(v_cache.dtype), mode="drop"
-    )
-    return k_cache, v_cache
+    return _store_kv(k_cache, v_cache, layer, kf, vf, bf, of,
+                     k_scale, v_scale)
 
 
 def write_token_kv(
@@ -119,19 +154,15 @@ def write_token_kv(
     v: jax.Array,
     block_tables: jax.Array,  # [B, max_blocks]
     ctx_lens: jax.Array,      # [B] position to write (== current length)
-) -> Tuple[jax.Array, jax.Array]:
+    k_scale: jax.Array = None,  # [L, nkv, nblocks, bs] fp32 (int8 cache)
+    v_scale: jax.Array = None,
+) -> Tuple[jax.Array, ...]:
     bs = k_cache.shape[4]
     B = k.shape[0]
     blocks = block_tables[jnp.arange(B), ctx_lens // bs]  # [B]
     offsets = ctx_lens % bs
-    # advanced dims front (see write_prompt_kv): target is [B, nkv, hd]
-    k_cache = k_cache.at[layer, :, blocks, :, offsets].set(
-        k.astype(k_cache.dtype), mode="drop"
-    )
-    v_cache = v_cache.at[layer, :, blocks, :, offsets].set(
-        v.astype(v_cache.dtype), mode="drop"
-    )
-    return k_cache, v_cache
+    return _store_kv(k_cache, v_cache, layer, k, v, blocks, offsets,
+                     k_scale, v_scale)
 
 
 # ---------------------------------------------------------------------------
@@ -139,12 +170,23 @@ def write_token_kv(
 # ---------------------------------------------------------------------------
 
 
-def _gather_ctx(cache: jax.Array, layer: int,
-                block_table: jax.Array) -> jax.Array:
-    """[L,nkv,nb,hd,bs] + [max_blocks] -> [nkv, max_blocks*bs, hd]."""
+def _gather_ctx(cache: jax.Array, layer: int, block_table: jax.Array,
+                scale: jax.Array = None, dtype=None) -> jax.Array:
+    """[L,nkv,nb,hd,bs] + [max_blocks] -> [nkv, max_blocks*bs, hd].
+
+    `scale` [L, nkv, nb, bs] dequantizes an int8 cache on the gathered
+    context (quant/kv.py): the int8 gather is what streams from HBM;
+    the upcast target is `dtype` (bf16 for the jnp_bf16 fast path) or
+    fp32 when unset."""
     g = cache[layer][:, block_table]  # [nkv, max_blocks, hd, bs]
     nkv, mb, hd, bs = g.shape
-    return g.swapaxes(2, 3).reshape(nkv, mb * bs, hd)
+    g = g.swapaxes(2, 3).reshape(nkv, mb * bs, hd)
+    if scale is not None:
+        s = scale[layer][:, block_table].reshape(nkv, mb * bs)
+        g = g.astype(jnp.float32) * s[..., None]
+        if dtype is not None:
+            g = g.astype(dtype)
+    return g
 
 
 def _gqa_scores(q: jax.Array, k: jax.Array,
@@ -192,18 +234,22 @@ def paged_prefill_attention(
     block_table: jax.Array,
     ctx_len: jax.Array,   # cached tokens this chunk attends to
     true_len: jax.Array,  # valid tokens in the chunk
+    k_scale: jax.Array = None,  # int8 cache: dequant scales (quant/kv.py)
+    v_scale: jax.Array = None,
 ) -> jax.Array:
     """Chunk tokens attend to (cached context) ++ (chunk, causally).
 
     One code path serves plain prefill (ctx_len=0), prefix-cache hits and
     chunked prefill (ctx_len>0) — the unified form that lets the engine reuse
-    blocks the router already counted as overlap.
+    blocks the router already counted as overlap.  The chunk's own K/V
+    attend at full precision (they arrive fresh from the projection);
+    only the cached context dequantizes on an int8 cache.
     """
     T, nh, hd = q.shape
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
 
-    k_ctx = _gather_ctx(k_cache, layer, block_table)  # [nkv, S, hd]
-    v_ctx = _gather_ctx(v_cache, layer, block_table)
+    k_ctx = _gather_ctx(k_cache, layer, block_table, k_scale)  # [nkv,S,hd]
+    v_ctx = _gather_ctx(v_cache, layer, block_table, v_scale)
     S = k_ctx.shape[1]
     k_hm = k.swapaxes(0, 1)  # head-major [nkv, T, hd]
     v_hm = v.swapaxes(0, 1)
@@ -232,17 +278,22 @@ def paged_attention_decode_jnp(
     block_tables: jax.Array,  # [B, max_blocks]
     kv_lens: jax.Array,       # [B] valid tokens (incl. the one just written)
     native_dtype: bool = False,
+    k_scale: jax.Array = None,  # int8 cache: dequant scales (quant/kv.py)
+    v_scale: jax.Array = None,
 ) -> jax.Array:
     """XLA path: the block gather feeds the einsums directly (fused by
     XLA — no explicit DMA kernel).  native_dtype=True keeps matmul
     operands in the cache dtype (bf16) with fp32 accumulation; False
-    upcasts to fp32 (exact reference numerics for tests)."""
+    upcasts to fp32 (exact reference numerics for tests).  An int8 cache
+    dequantizes on the gather — to bf16 under native_dtype (operands
+    stay 16-bit for the MXU), else to fp32."""
     B, nh, hd = q.shape
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    deq_dtype = jnp.bfloat16 if native_dtype else None
 
     def one(qb, table, kvlen):
-        kb = _gather_ctx(k_cache, layer, table)  # [nkv, S, hd]
-        vb = _gather_ctx(v_cache, layer, table)
+        kb = _gather_ctx(k_cache, layer, table, k_scale, deq_dtype)
+        vb = _gather_ctx(v_cache, layer, table, v_scale, deq_dtype)
         s = _gqa_scores(qb, kb, native_dtype) * scale   # [nh, S]
         mask = (jnp.arange(kb.shape[1]) < kvlen)[None, :]
         s = jnp.where(mask, s, NEG_INF)
@@ -297,6 +348,8 @@ def paged_attention_decode(
     kv_lens: jax.Array,
     impl: str = "auto",
     mesh=None,
+    k_scale: jax.Array = None,
+    v_scale: jax.Array = None,
 ) -> jax.Array:
     """Single-token batched paged attention (the decode hot loop).
 
@@ -312,8 +365,15 @@ def paged_attention_decode(
     shard_map per shard.  Without a mesh, "auto" under tp>1 would hit
     GSPMD's unpartitionable-custom-call all-gather, so callers serving
     multi-chip must pass their mesh (the engine does).
+
+    k_scale/v_scale: an int8 cache's dequant scales (quant/kv.py).  The
+    Pallas kernel has no int8 lane layout yet, so a quantized cache
+    routes "pallas"/"pallas_interpret" to the jnp gather path (see the
+    module docstring's impl support matrix).
     """
     tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+    if k_scale is not None and impl in ("pallas", "pallas_interpret"):
+        impl = "jnp_bf16"
     if impl == "auto":
         # "auto" = the XLA gather path.  Measured on v5e (round 5,
         # benchmarks/bench_decode_phases.py, llama-3b B=8 ctx=2048): the
@@ -347,4 +407,5 @@ def paged_attention_decode(
     return paged_attention_decode_jnp(
         q, k_cache, v_cache, layer, block_tables, kv_lens,
         native_dtype=(impl == "jnp_bf16"),
+        k_scale=k_scale, v_scale=v_scale,
     )

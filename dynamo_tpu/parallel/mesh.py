@@ -111,3 +111,10 @@ def kv_cache_spec() -> P:
     kv_heads axis over tp (same split as the attention heads).  Head-major
     layout keeps each tp shard a single contiguous slab."""
     return P(None, "tp", None, None, None)
+
+
+def kv_scale_spec() -> P:
+    """Quantization scales [layers, kv_heads, blocks, block_size] riding
+    next to an int8 cache (quant/kv.py): same kv_heads split over tp, so
+    a shard's scale plane stays co-resident with its cache slab."""
+    return P(None, "tp", None, None)

@@ -29,6 +29,7 @@ class WorkerSample:
     active_seqs: int = 0
     kv_usage: float = 0.0
     itl_ema_s: float = 0.0
+    kv_cache_dtype: str = ""     # "" = worker predates the advertisement
     seen_t: float = field(default_factory=time.monotonic)
 
 
@@ -40,6 +41,9 @@ class AggregateLoad:
     req_per_s: float = 0.0       # fleet-wide arrival rate (windowed)
     mean_isl: float = 0.0        # mean prompt tokens per request (windowed)
     mean_itl_s: float = 0.0      # mean decode inter-token latency (EMA)
+    # distinct KV storage dtypes live workers report (perf-model
+    # fidelity input: PerfModel.check_kv_dtype)
+    kv_dtypes: tuple = ()
 
     @property
     def active_per_worker(self) -> float:
@@ -90,6 +94,7 @@ class LoadObserver:
                     active_seqs=int(payload.get("active_seqs", 0)),
                     kv_usage=float(payload.get("kv_usage", 0.0)),
                     itl_ema_s=float(payload.get("itl_ema_s", 0.0)),
+                    kv_cache_dtype=str(payload.get("kv_cache_dtype", "")),
                 )
                 if "requests_total" in payload:
                     hist = self._cum.setdefault(w, deque(maxlen=64))
@@ -144,6 +149,8 @@ class LoadObserver:
             req_per_s=req_rate,
             mean_isl=mean_isl,
             mean_itl_s=sum(itls) / len(itls) if itls else 0.0,
+            kv_dtypes=tuple(sorted({s.kv_cache_dtype for s in live
+                                    if s.kv_cache_dtype})),
         )
 
 

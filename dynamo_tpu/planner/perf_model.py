@@ -49,6 +49,16 @@ class PerfModel:
         self.profile = profile
         self.itl_correction = 1.0  # measured/predicted EMA, clamped
         self._corr_alpha = 0.2
+        # KV storage dtype the profile was measured at (profiler stamps
+        # meta["kv_cache_dtype"]; "" = untagged legacy profile).  An ITL
+        # surface measured at bf16 applied to an int8 fleet (or vice
+        # versa) is systematically wrong — int8 halves the decode read's
+        # HBM bytes AND ~doubles the block pool, so both the latency
+        # curve and the capacity answers shift.  check_kv_dtype warns
+        # (once per offending dtype) instead of failing: the online ITL
+        # correction still converges, but the operator should re-profile.
+        self.kv_cache_dtype = str(profile.meta.get("kv_cache_dtype", ""))
+        self._kv_dtype_warned: set = set()
         # group by isl: sorted (concurrency, itl_p95 / ttft_p95 / req_per_s)
         by_isl: Dict[int, List] = {}
         for p in profile.points:
@@ -162,6 +172,28 @@ class PerfModel:
             logger.warning("perf model: TTFT target %.4fs unattainable at "
                            "isl=%d; planning best-effort", target_s, isl)
         return best
+
+    # -- profile fidelity -------------------------------------------------
+
+    def check_kv_dtype(self, worker_dtypes) -> list:
+        """Compare the live fleet's KV storage dtypes (worker load
+        samples / MDC `kv_cache_dtype`) against the dtype this profile
+        was measured at.  Returns the mismatching dtypes (empty = fine)
+        and warns once per offending dtype.  Untagged values on either
+        side are skipped — absence of evidence is not a mismatch."""
+        if not self.kv_cache_dtype:
+            return []
+        bad = sorted({d for d in worker_dtypes
+                      if d and d != self.kv_cache_dtype})
+        for d in bad:
+            if d not in self._kv_dtype_warned:
+                self._kv_dtype_warned.add(d)
+                logger.warning(
+                    "perf model: profile was measured at "
+                    "kv_cache_dtype=%s but live workers report %s — ITL/"
+                    "TTFT estimates are systematically off; re-profile "
+                    "at the serving dtype", self.kv_cache_dtype, d)
+        return bad
 
     # -- online correction ------------------------------------------------
 

@@ -193,6 +193,13 @@ class Planner:
         c = self.config
         pm = self.perf_model
         isl = load.mean_isl or None
+        # profile fidelity: an ITL surface measured at one KV storage
+        # dtype must not silently steer a fleet serving the other
+        # (int8 halves decode HBM traffic and ~doubles the block pool)
+        mismatched = pm.check_kv_dtype(load.kv_dtypes)
+        if mismatched:
+            diag["kv_dtype_mismatch"] = {
+                "profile": pm.kv_cache_dtype, "workers": mismatched}
         # online correction from live decode latency: prefer the FPM
         # stream's per-program dispatch gaps; fall back to the coarse
         # itl_ema_s scalar in load_metrics

@@ -144,11 +144,23 @@ async def profile_engine(
     concurrencies: Sequence[int] = (1, 2, 4, 8, 16),
     rounds: int = 2,
     warmup: bool = True,
+    kv_cache_dtype: Optional[str] = None,
 ) -> PerfProfile:
     """Sweep the (isl, concurrency) grid.  `engine` is anything with the
-    generate() contract; callers own its lifecycle."""
+    generate() contract; callers own its lifecycle.
+
+    The profile is tagged with the engine's KV storage dtype (explicit
+    `kv_cache_dtype` beats auto-detection off the engine) so the SLA
+    planner can refuse to silently apply a bf16-measured ITL surface to
+    an int8 fleet (planner/perf_model.py check_kv_dtype)."""
+    if kv_cache_dtype is None:
+        # JaxEngine exposes the EFFECTIVE dtype; the mocker carries it
+        # on its args
+        kv_cache_dtype = getattr(engine, "kv_dtype", None) or getattr(
+            getattr(engine, "args", None), "kv_cache_dtype", "")
     prof = PerfProfile(model_name=model_name,
-                       meta={"osl": osl, "rounds": rounds})
+                       meta={"osl": osl, "rounds": rounds,
+                             "kv_cache_dtype": kv_cache_dtype})
     token_base = 0
     if warmup:
         # first call pays compilation / pool-initialisation; don't let it

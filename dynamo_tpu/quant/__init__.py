@@ -1,0 +1,27 @@
+"""KV-cache quantization subsystem (engine config `kv_cache_dtype`).
+
+quant/kv.py holds the symmetric int8 primitives and the bytes-per-block
+capacity math; the write/read integration lives next to the cache ops
+(ops/paged_attention.py, ops/packed_prefill.py) and the model families
+thread the scale arrays as extra members of the KV cache tuple.
+"""
+
+from .kv import (
+    INT8_MAX,
+    blocks_for_hbm_budget,
+    dequantize,
+    is_quantized,
+    kv_cache_bytes_per_block,
+    quantize_tokens,
+    unpack_kv,
+)
+
+__all__ = [
+    "INT8_MAX",
+    "blocks_for_hbm_budget",
+    "dequantize",
+    "is_quantized",
+    "kv_cache_bytes_per_block",
+    "quantize_tokens",
+    "unpack_kv",
+]

@@ -83,6 +83,10 @@ def make_proposer(config, mesh):
             prefill_buckets=config.prefill_buckets,
             model_path=config.spec_draft_model_path,
             max_k=config.spec_k, seed=config.seed,
+            # the draft cache matches the target's quantization policy:
+            # its writes (catch-up prefill + propose bursts) are KV write
+            # sites like any other, and its HBM footprint halves too
+            kv_cache_dtype=config.kv_cache_dtype,
         )
     raise ValueError(
         f"spec_decode must be 'off' | 'ngram' | 'draft', "

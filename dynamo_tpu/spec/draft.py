@@ -45,7 +45,8 @@ class DraftModelProposer:
 
     def __init__(self, model_cfg, mesh, *, num_blocks: int,
                  block_size: int, prefill_buckets, model_path: str = "",
-                 max_k: int = 4, seed: int = 0):
+                 max_k: int = 4, seed: int = 0,
+                 kv_cache_dtype: str = "bf16"):
         from ..parallel.mesh import shard_params
 
         self.cfg = model_cfg
@@ -54,6 +55,10 @@ class DraftModelProposer:
         self.block_size = block_size
         self.buckets = tuple(prefill_buckets)
         self.max_k = max_k
+        # int8 draft cache (quant/kv.py): same fallback rule as the
+        # engine — a family without the quantized path stays bf16
+        quantized = (kv_cache_dtype == "int8"
+                     and hasattr(self.family, "kv_cache_scale_shapes"))
         with mesh:
             if model_path:
                 from ..models.loader import load_params
@@ -69,12 +74,23 @@ class DraftModelProposer:
             k_spec, v_spec = self.family.kv_cache_specs()
             from jax.sharding import NamedSharding
 
-            self.kv = (
-                jax.jit(partial(jnp.zeros, k_shape, model_cfg.dtype),
+            dtype = jnp.int8 if quantized else model_cfg.dtype
+            kv = [
+                jax.jit(partial(jnp.zeros, k_shape, dtype),
                         out_shardings=NamedSharding(mesh, k_spec))(),
-                jax.jit(partial(jnp.zeros, v_shape, model_cfg.dtype),
+                jax.jit(partial(jnp.zeros, v_shape, dtype),
                         out_shardings=NamedSharding(mesh, v_spec))(),
-            )
+            ]
+            if quantized:
+                scale_shapes = self.family.kv_cache_scale_shapes(
+                    model_cfg, num_blocks, block_size)
+                scale_specs = self.family.kv_cache_scale_specs()
+                kv += [
+                    jax.jit(partial(jnp.zeros, shape, jnp.float32),
+                            out_shardings=NamedSharding(mesh, spec))()
+                    for shape, spec in zip(scale_shapes, scale_specs)
+                ]
+            self.kv = tuple(kv)
         self._jit_prefill = jax.jit(
             partial(self._prefill_impl, self.family, self.cfg),
             donate_argnums=(1,))

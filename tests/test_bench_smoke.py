@@ -21,7 +21,9 @@ BENCHES = sorted(glob.glob(os.path.join(REPO, "benchmarks",
 
 def test_benchmarks_discovered():
     # the glob must see the suite; an empty list would vacuously pass
-    assert len(BENCHES) >= 5, BENCHES
+    assert len(BENCHES) >= 7, BENCHES
+    names = {os.path.basename(p) for p in BENCHES}
+    assert "bench_kv_quant.py" in names
 
 
 @pytest.mark.parametrize(

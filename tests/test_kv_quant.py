@@ -1,0 +1,531 @@
+"""Int8 KV-cache quantization subsystem (quant/kv.py + engine
+kv_cache_dtype="int8"): primitive error bounds, end-to-end greedy
+parity vs bf16, exact scale round-trips through the KVBM tiers and the
+disagg wire, capacity sizing, multihost bit-identity, and the
+mocker/planner satellites."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.ops import paged_attention as pa
+from dynamo_tpu.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.quant.kv import (
+    blocks_for_hbm_budget,
+    dequantize,
+    kv_cache_bytes_per_block,
+    quantize_tokens,
+)
+
+FP32 = LlamaConfig(name="tiny32", vocab_size=256, d_model=64, n_layers=2,
+                   n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,
+                   dtype=jnp.float32)
+
+
+def engine(**kw):
+    defaults = dict(model_config=FP32, block_size=4, num_blocks=128,
+                    max_blocks_per_seq=16, max_num_seqs=4,
+                    prefill_buckets=(8, 16, 32, 64), seed=7)
+    defaults.update(kw)
+    return JaxEngine(EngineConfig(**defaults))
+
+
+def greedy_req(tokens, n, rid, seed=0):
+    return PreprocessedRequest(
+        token_ids=tokens, request_id=rid,
+        sampling=SamplingOptions(temperature=0.0, seed=seed),
+        stop=StopConditions(max_tokens=n, ignore_eos=True),
+    )
+
+
+async def collect(eng, req):
+    toks = []
+    async for out in eng.generate(req):
+        toks.extend(out.token_ids)
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 3.0, (64, 4, 32)).astype(np.float32))
+    q, scale = quantize_tokens(x)
+    assert q.dtype == jnp.int8
+    assert scale.shape == (64, 4)
+    deq = dequantize(q, scale)
+    # symmetric per-token quantization: error <= scale/2 == absmax/254
+    # (small fp32 slack: the q*scale product rounds once more)
+    err = np.abs(np.asarray(deq - x))
+    bound = np.asarray(scale)[..., None] * (0.5 + 1e-5) + 1e-6
+    np.testing.assert_array_less(err, np.broadcast_to(bound, err.shape))
+
+
+def test_quantize_zero_rows_and_extremes():
+    x = jnp.zeros((3, 2, 8), jnp.float32)
+    q, scale = quantize_tokens(x)
+    assert np.all(np.asarray(q) == 0) and np.all(np.asarray(scale) == 0.0)
+    np.testing.assert_array_equal(np.asarray(dequantize(q, scale)), 0.0)
+    # the absmax element must round-trip to itself exactly
+    y = jnp.asarray([[[-5.0, 2.0, 5.0, 0.0]]])
+    qy, sy = quantize_tokens(y)
+    deq = np.asarray(dequantize(qy, sy))
+    assert deq[0, 0, 0] == -5.0 and deq[0, 0, 2] == 5.0
+
+
+def test_write_sites_quantize_and_gather_dequantizes():
+    """Every write op scatters int8 + scales with the same index math;
+    _gather_ctx returns the dequantized context within the bound."""
+    L, nkv, nb, hd, bs = 2, 2, 9, 8, 4
+    rng = np.random.default_rng(1)
+    kc = jnp.zeros((L, nkv, nb, hd, bs), jnp.int8)
+    vc = jnp.zeros_like(kc)
+    ks = jnp.zeros((L, nkv, nb, bs), jnp.float32)
+    vs = jnp.zeros_like(ks)
+    T = 10
+    k = jnp.asarray(rng.normal(0, 2, (T, nkv, hd)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 2, (T, nkv, hd)).astype(np.float32))
+    table = jnp.asarray([3, 5, 7, 0, 0, 0, 0, 0], jnp.int32)
+    kc, vc, ks, vs = pa.write_prompt_kv(
+        kc, vc, 0, k, v, table, jnp.int32(0), jnp.int32(T),
+        k_scale=ks, v_scale=vs)
+    got = np.asarray(pa._gather_ctx(kc, 0, table, ks))  # [nkv, S, hd]
+    want = np.asarray(k).transpose(1, 0, 2)             # [nkv, T, hd]
+    # gathered scale per (head, stream position), same layout as `got`
+    scale = np.asarray(ks)[0][:, np.asarray(table)].reshape(nkv, -1)
+    err = np.abs(got[:, :T] - want)
+    bound = scale[:, :T, None] * (0.5 + 1e-5) + 1e-6
+    np.testing.assert_array_less(err, np.broadcast_to(bound, err.shape))
+    # decode append into the next free position (block 7, offset T % bs)
+    tok_k = jnp.asarray(rng.normal(0, 2, (1, nkv, hd)).astype(np.float32))
+    kc, vc, ks, vs = pa.write_token_kv(
+        kc, vc, 0, tok_k, tok_k, table[None], jnp.asarray([T], jnp.int32),
+        k_scale=ks, v_scale=vs)
+    got = np.asarray(pa._gather_ctx(kc, 0, table, ks))
+    err = np.abs(got[:, T] - np.asarray(tok_k)[0])
+    s = np.asarray(ks)[0, :, 7, T % bs]
+    bound = s[:, None] * (0.5 + 1e-5) + 1e-6
+    np.testing.assert_array_less(err, np.broadcast_to(bound, err.shape))
+
+
+def test_bf16_write_path_unchanged():
+    """Without scales the write ops return 2-tuples (the pre-quantization
+    contract, byte-identical behavior) and the engine default cache stays
+    a 2-tuple of the model dtype."""
+    kc = jnp.zeros((1, 1, 4, 4, 4), jnp.float32)
+    out = pa.write_token_kv(kc, kc, 0, jnp.ones((1, 1, 4)),
+                            jnp.ones((1, 1, 4)),
+                            jnp.zeros((1, 4), jnp.int32),
+                            jnp.zeros((1,), jnp.int32))
+    assert len(out) == 2
+    eng = engine()
+    assert len(eng.kv) == 2 and eng.kv[0].dtype == jnp.float32
+    assert eng.kv_dtype == "bf16"
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end
+# ---------------------------------------------------------------------------
+
+
+async def test_greedy_parity_bf16_vs_int8():
+    """Greedy decode with an int8 cache matches bf16 token-for-token on
+    the test geometry (per-token scales bound elementwise error at
+    absmax/254, far below the argmax margins).  Covers packed chunked
+    prefill (long prompt), prefix-cache reuse, and fused decode."""
+    e_ref = engine()
+    e_q = engine(kv_cache_dtype="int8")
+    assert e_q.kv_dtype == "int8" and len(e_q.kv) == 4
+    assert e_q.kv[0].dtype == jnp.int8
+    assert e_q.kv[2].dtype == jnp.float32
+    prompts = [list(range(3, 25)),            # multi-block
+               [5, 9] * 40]                   # > largest bucket: chunked
+    for i, p in enumerate(prompts):
+        ref = await collect(e_ref, greedy_req(p, 8, f"r{i}"))
+        got = await collect(e_q, greedy_req(p, 8, f"q{i}"))
+        assert got == ref, f"prompt {i}: {got} != {ref}"
+    # prefix-cache hit on the quantized cache must preserve output too
+    again = await collect(e_q, greedy_req(prompts[0], 8, "q-again"))
+    ref = await collect(e_ref, greedy_req(prompts[0], 8, "r-again"))
+    assert again == ref
+    await e_ref.close()
+    await e_q.close()
+
+
+async def test_speculative_decoding_on_int8_cache():
+    """The ngram spec path (packed verify + draft-position KV writes)
+    serves the int8 cache: greedy output token-identical to the plain
+    int8 engine."""
+    kw = dict(kv_cache_dtype="int8", decode_fused_steps=2,
+              decode_pipeline_depth=2)
+    plain = engine(**kw)
+    spec = engine(spec_decode="ngram", spec_k=3, **kw)
+    assert spec.spec_enabled
+    prompt = [7, 8, 9, 10] * 6  # repetitive: the ngram proposer engages
+    want = await collect(plain, greedy_req(prompt, 16, "p"))
+    got = await collect(spec, greedy_req(prompt, 16, "s"))
+    assert got == want
+    assert spec.metrics.get("spec_steps", 0) > 0
+    await plain.close()
+    await spec.close()
+
+
+def test_mla_family_falls_back_to_bf16():
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+
+    mla = DeepseekConfig(
+        name="mla-q", vocab_size=256, d_model=64, n_layers=2,
+        n_heads=4, q_lora_rank=24, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, ffn_dim=128, dtype=jnp.float32)
+    eng = JaxEngine(EngineConfig(
+        model_config=mla, block_size=4, num_blocks=32,
+        max_blocks_per_seq=8, max_num_seqs=2, prefill_buckets=(8, 16),
+        kv_cache_dtype="int8"))
+    assert eng.kv_dtype == "bf16"
+    assert len(eng.kv) == 2 and eng.kv[0].dtype == jnp.float32
+
+
+def test_invalid_kv_dtype_rejected():
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        engine(kv_cache_dtype="fp8")
+
+
+# ---------------------------------------------------------------------------
+# capacity sizing
+# ---------------------------------------------------------------------------
+
+
+def test_capacity_doubles_within_hbm_budget():
+    cfg = llama.PRESETS["llama-3b"]
+    b_bf = blocks_for_hbm_budget(llama, cfg, 128, "bf16", 16 * 10**9)
+    b_q = blocks_for_hbm_budget(llama, cfg, 128, "int8", 16 * 10**9)
+    assert b_q / b_bf >= 1.8, (b_bf, b_q)
+    assert kv_cache_bytes_per_block(llama, cfg, 128, "int8") \
+        < kv_cache_bytes_per_block(llama, cfg, 128, "bf16")
+
+
+def test_engine_kv_hbm_budget_sizes_block_pool():
+    budget_gb = 0.002  # 2 MB: tiny32 fp32 blocks are 4 KiB
+    e_bf = engine(kv_hbm_gb=budget_gb)
+    e_q = engine(kv_hbm_gb=budget_gb, kv_cache_dtype="int8")
+    nb_bf = e_bf.config.num_blocks
+    nb_q = e_q.config.num_blocks
+    assert nb_q / nb_bf >= 1.8, (nb_bf, nb_q)
+    # the allocator and the device arrays agree with the derived count
+    assert e_q.allocator.num_blocks == nb_q
+    assert e_q.kv[0].shape[2] == nb_q
+
+
+# ---------------------------------------------------------------------------
+# KVBM tiers: scales round-trip bit-exactly
+# ---------------------------------------------------------------------------
+
+
+def _rand_block(rng, quant):
+    k = rng.normal(size=(2, 4, 2, 8)).astype(np.float32)
+    v = rng.normal(size=(2, 4, 2, 8)).astype(np.float32)
+    if not quant:
+        return (k, v)
+    ks = rng.random((2, 4, 2)).astype(np.float32)
+    vs = rng.random((2, 4, 2)).astype(np.float32)
+    return (k.astype(np.int8), v.astype(np.int8), ks, vs)
+
+
+def test_kvbm_tiers_roundtrip_quantized_blocks(tmp_path):
+    """G2 -> G3 demotion -> fetch promotion must return all four payload
+    arrays BIT-exact (scales included) — a perturbed scale rescales every
+    element of the block."""
+    from dynamo_tpu.kvbm import TieredKvManager
+
+    mgr = TieredKvManager(2, disk_dir=str(tmp_path / "g3"), disk_blocks=8,
+                          object_dir=str(tmp_path / "g4"))
+    rng = np.random.default_rng(3)
+    blocks = {h: _rand_block(rng, quant=True) for h in (11, 12, 13)}
+    for h, blk in blocks.items():
+        mgr.offload(h, *blk)  # capacity 2: 11 demotes to G3
+    assert 11 in mgr.g3 and 11 not in mgr.g2
+    for h, want in blocks.items():
+        got, _events = mgr.fetch(h)
+        assert got is not None and len(got) == 4
+        for a, b in zip(got, want):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_object_store_roundtrips_quantized_blocks(tmp_path):
+    from dynamo_tpu.kvbm.object_store import ObjectStorePool
+
+    pool = ObjectStorePool(str(tmp_path))
+    rng = np.random.default_rng(4)
+    blk = _rand_block(rng, quant=True)
+    assert pool.put(99, *blk)
+    got = pool.get(99)
+    assert len(got) == 4
+    for a, b in zip(got, blk):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+def test_kvbm_remote_wire_roundtrips_scales():
+    from dynamo_tpu.kvbm.remote import decode_block, encode_block
+
+    rng = np.random.default_rng(5)
+    blk = _rand_block(rng, quant=True)
+    h, *arrays = decode_block(encode_block(42, *blk))
+    assert h == 42 and len(arrays) == 4
+    for a, b in zip(arrays, blk):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+    # bf16-era 2-array frames still decode (mixed fleets)
+    h, *arrays = decode_block(encode_block(7, *blk[:2]))
+    assert len(arrays) == 2
+
+
+async def test_engine_offload_onboard_int8_preserves_output():
+    """Engine-level G2 round trip at int8: prompt A's quantized blocks
+    offload under churn, onboard on resubmission (no recompute), and the
+    greedy output is unchanged."""
+    eng = engine(kv_cache_dtype="int8", num_blocks=16,
+                 max_blocks_per_seq=8, host_cache_blocks=64,
+                 offload_watermark_blocks=16, prefill_buckets=(8, 16, 32))
+    prompt_a = list(range(1, 13))
+    out1 = await collect(eng, greedy_req(prompt_a, 4, "a1"))
+    for i in range(6):
+        p = [50 + 7 * i + j for j in range(12)]
+        await collect(eng, greedy_req(p, 2, f"churn{i}"))
+    assert eng.kvbm.stats["offloaded"] > 0
+    out2 = await collect(eng, greedy_req(prompt_a, 4, "a2"))
+    assert out2 == out1
+    assert eng.metrics.get("onboarded_tokens", 0) > 0, \
+        "workload failed to exercise the onboard (inject) path"
+    await eng.close()
+
+
+# ---------------------------------------------------------------------------
+# disagg wire
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_frame_roundtrips_scales_bitexact():
+    from dynamo_tpu.disagg.transfer import (
+        KvLayout,
+        decode_chunk_frame,
+        encode_chunk_frame,
+    )
+
+    rng = np.random.default_rng(6)
+    kb = rng.integers(-127, 128, (2, 3, 4, 2, 8)).astype(np.int8)
+    vb = rng.integers(-127, 128, (2, 3, 4, 2, 8)).astype(np.int8)
+    ksb = rng.random((2, 3, 4, 2)).astype(np.float32)
+    vsb = rng.random((2, 3, 4, 2)).astype(np.float32)
+    layout = KvLayout.of(kb, scales=True)
+    assert layout.dtype == "int8" and layout.scales
+    # scale bytes are priced into the chunk bound
+    assert layout.block_bytes() == 2 * (2 * 4 * 2 * 8) + 2 * 4 * 2 * 2 * 4
+    b0, n, k2, v2, ks2, vs2 = decode_chunk_frame(
+        encode_chunk_frame(0, kb, vb, ksb, vsb), layout)
+    assert (b0, n) == (0, 3)
+    for a, b in ((k2, kb), (v2, vb), (ks2, ksb), (vs2, vsb)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+    # a quantized layout REQUIRES the scale planes
+    with pytest.raises(ValueError, match="scale"):
+        decode_chunk_frame(encode_chunk_frame(0, kb, vb), layout)
+    # wire round trip of the layout keeps the scales flag
+    assert KvLayout.from_dict(layout.to_dict()).scales
+
+
+def test_layout_rejects_mixed_dtype_pairs():
+    from dynamo_tpu.disagg.transfer import KvLayout
+
+    rng = np.random.default_rng(7)
+    q = KvLayout.of(rng.integers(0, 5, (2, 3, 4, 2, 8)).astype(np.int8),
+                    scales=True)
+    bf = KvLayout.of(rng.random((2, 3, 4, 2, 8)).astype(np.float32))
+    with pytest.raises(ValueError, match="dtype"):
+        q.check_compatible(bf)
+
+
+async def test_disagg_transfer_int8_end_to_end():
+    """KV prefilled on an int8 prefill worker continues identically on an
+    int8 decode worker — the quantized payload + scales ride the wire and
+    the output matches an aggregated int8 engine."""
+    import uuid as _uuid
+
+    from dynamo_tpu.disagg.prefill_router import (
+        ConditionalDisaggConfig,
+        PrefillOrchestrator,
+    )
+    from dynamo_tpu.engine.worker import JaxEngineWorker
+    from dynamo_tpu.protocols import LLMEngineOutput
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem", event_plane="inproc"),
+        cluster_id=_uuid.uuid4().hex).start()
+    ecfg = dict(model_config=FP32, block_size=4, num_blocks=64,
+                max_blocks_per_seq=16, max_num_seqs=2,
+                prefill_buckets=(8, 16, 32), seed=7,
+                kv_cache_dtype="int8", transfer_chunk_bytes=2048)
+    prefill_worker = await JaxEngineWorker(
+        rt, EngineConfig(role="prefill", **ecfg), component="prefill",
+    ).start()
+    decode_worker = await JaxEngineWorker(
+        rt, EngineConfig(role="decode", **ecfg), component="backend",
+    ).start()
+    agg = JaxEngine(EngineConfig(**ecfg))
+
+    prompt = list(range(30, 52))
+    expect = await collect(agg, greedy_req(prompt, 6, "agg"))
+
+    pclient = await (rt.namespace("dynamo").component("prefill")
+                     .endpoint("generate").client()).start()
+    dclient = await (rt.namespace("dynamo").component("backend")
+                     .endpoint("generate").client()).start()
+    orch = PrefillOrchestrator(
+        pclient, ConditionalDisaggConfig(always_remote=True))
+    routed = await orch.maybe_prefill(greedy_req(prompt, 6, "int8d"))
+    assert routed.disaggregated_params is not None
+    tokens = []
+    async for item in dclient.generate(routed.to_dict()):
+        tokens.extend(LLMEngineOutput.from_dict(item).token_ids)
+    assert tokens == expect, "int8 disagg continuation diverged"
+    assert decode_worker.engine.metrics["prefill_tokens"] == 0
+    assert decode_worker.engine.metrics.get("pull_blocks", 0) > 0
+
+    await orch.close()
+    await pclient.close()
+    await dclient.close()
+    await agg.close()
+    await prefill_worker.close()
+    await decode_worker.close()
+    await rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# multihost replay
+# ---------------------------------------------------------------------------
+
+
+async def test_multihost_follower_bit_identical_at_int8():
+    """A follower replaying the leader's step stream ends with ALL FOUR
+    cache components bit-identical (int8 data and fp32 scales)."""
+    import uuid as _uuid
+
+    from dynamo_tpu.engine.worker import JaxEngineWorker
+    from dynamo_tpu.parallel.multihost import MultihostContext
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem", event_plane="inproc"),
+        cluster_id=_uuid.uuid4().hex).start()
+    ecfg = dict(model_config=FP32, block_size=4, num_blocks=32,
+                max_blocks_per_seq=8, max_num_seqs=2,
+                prefill_buckets=(8, 16), seed=5, kv_cache_dtype="int8")
+    follower = await JaxEngineWorker(
+        rt, EngineConfig(**ecfg), mh=MultihostContext(rank=1, world=2),
+    ).start()
+    leader = await JaxEngineWorker(
+        rt, EngineConfig(**ecfg), mh=MultihostContext(rank=0, world=2),
+    ).start()
+    assert len(leader.engine.kv) == 4
+    assert len(follower.engine.kv) == 4
+
+    toks = await collect(leader.engine,
+                         greedy_req(list(range(3, 17)), 6, "mhq"))
+    assert len(toks) == 6
+    for _ in range(300):
+        await asyncio.sleep(0.02)
+        if all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(leader.engine.kv, follower.engine.kv)):
+            break
+    for i, (a, b) in enumerate(zip(leader.engine.kv, follower.engine.kv)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"cache component {i} diverged")
+    await leader.close()
+    await follower.close()
+    await rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellites: mocker + planner
+# ---------------------------------------------------------------------------
+
+
+def test_mocker_simulates_capacity_doubling_and_advertises():
+    from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+    from dynamo_tpu.mocker.engine import MockEngine
+    from dynamo_tpu.mocker.kv_cache_sim import kv_dtype_capacity_blocks
+
+    assert kv_dtype_capacity_blocks(1000, "bf16") == 1000
+    assert kv_dtype_capacity_blocks(1000, "int8") == 1939  # 2*128/132
+    args = MockEngineArgs(num_blocks=1000, kv_cache_dtype="int8")
+    eng = MockEngine(args)
+    assert eng.cache.num_blocks == 1939
+    card = MockerWorker(None, args).card
+    rc = card.runtime_config
+    assert rc["kv_cache_dtype"] == "int8"
+    assert rc["total_kv_blocks"] == 1939
+
+
+def test_mocker_cli_flag_parses():
+    from dynamo_tpu.mocker.__main__ import build_args
+
+    a = build_args().parse_args(["--kv-cache-dtype", "int8"])
+    assert a.kv_cache_dtype == "int8"
+
+
+def test_perf_model_warns_on_kv_dtype_mismatch(caplog):
+    from dynamo_tpu.planner.perf_model import PerfModel
+    from dynamo_tpu.profiler import PerfProfile
+    from dynamo_tpu.profiler.profile import PerfPoint
+
+    prof = PerfProfile(points=[
+        PerfPoint(isl=128, osl=32, concurrency=c, itl_mean_s=0.01 * c,
+                  ttft_p95_s=0.1, req_per_s=1.0) for c in (1, 2, 4)],
+        meta={"kv_cache_dtype": "bf16"})
+    pm = PerfModel(prof)
+    assert pm.kv_cache_dtype == "bf16"
+    assert pm.check_kv_dtype(("bf16",)) == []
+    with caplog.at_level("WARNING"):
+        assert pm.check_kv_dtype(("int8",)) == ["int8"]
+    assert any("kv_cache_dtype" in r.message for r in caplog.records)
+    # warns once per dtype; untagged workers never mismatch
+    caplog.clear()
+    with caplog.at_level("WARNING"):
+        assert pm.check_kv_dtype(("int8", "")) == ["int8"]
+    assert not caplog.records
+    # untagged PROFILE never mismatches either
+    pm2 = PerfModel(PerfProfile(points=prof.points))
+    assert pm2.check_kv_dtype(("int8",)) == []
+
+
+def test_load_observer_aggregates_kv_dtypes():
+    from dynamo_tpu.planner.metrics import LoadObserver
+
+    obs = LoadObserver.__new__(LoadObserver)
+    obs.stale_after_s = 60.0
+    obs.rate_window_s = 10.0
+    obs.samples = {}
+    obs._cum = {}
+    from dynamo_tpu.planner.metrics import WorkerSample
+
+    obs.samples[1] = WorkerSample(active_seqs=1, kv_cache_dtype="int8")
+    obs.samples[2] = WorkerSample(active_seqs=1, kv_cache_dtype="bf16")
+    agg = obs.aggregate()
+    assert agg.kv_dtypes == ("bf16", "int8")
