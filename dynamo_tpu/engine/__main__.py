@@ -91,6 +91,11 @@ def build_args() -> argparse.ArgumentParser:
                    help="draft model preset for --spec-decode draft")
     p.add_argument("--spec-draft-model-path", default="",
                    help="draft HF checkpoint dir (overrides the preset)")
+    p.add_argument("--drain-deadline-s", type=float, default=5.0,
+                   help="SIGTERM grace: in-flight requests get this long "
+                        "to finish before the rest error with the "
+                        "migratable 'worker draining' marker and replay "
+                        "on surviving workers")
     return p
 
 
@@ -134,6 +139,19 @@ async def main() -> None:
         rt, config, namespace=args.namespace, component=args.component,
         migration_limit=args.migration_limit,
     ).start()
+
+    async def drain_worker() -> None:
+        # graceful SIGTERM: withdraw the lease, finish/migrate in-flight
+        # requests (engine/worker.py drain()), then exit — even if a
+        # drain step fails, the process must still come down
+        try:
+            await worker.drain(args.drain_deadline_s)
+        finally:
+            rt.root_token.kill()
+
+    from ..runtime.aio import install_drain_handler
+
+    install_drain_handler(drain_worker)
     if worker.served is not None:
         print(f"ready instance_id={worker.served.instance_id}", flush=True)
     else:  # multihost follower: no routing identity, replay only

@@ -36,10 +36,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import chaos
 from ..models import get_family
 from ..parallel.mesh import MeshConfig, make_mesh, shard_params
-from ..protocols import LLMEngineOutput, PreprocessedRequest
+from ..protocols import (
+    DRAIN_ABORT,
+    DRAIN_REJECT,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
 from ..quant.kv import is_quantized
+from ..runtime.retry import PULL_POLICY, call_with_retry
 from ..tokens import TokenBlockSequence, request_salt
 from .block_allocator import BlockAllocator
 from .config import EngineConfig
@@ -436,6 +443,9 @@ class JaxEngine:
         self._task: Optional[asyncio.Task] = None
         self._loop_ref: Optional[asyncio.AbstractEventLoop] = None
         self._closed = False
+        # graceful drain (engine/worker.py drain()): set to reject new
+        # requests with the migratable "worker draining" marker
+        self.draining = False
         self.metrics: Dict[str, Any] = {
             "steps": 0, "prefill_tokens": 0, "decode_tokens": 0,
             "cache_hit_tokens": 0, "preemptions": 0, "step_time_s": 0.0,
@@ -886,12 +896,12 @@ class JaxEngine:
             self._step_lock.release()
             self.kvbm.close()
 
-    def _fail_all_streams(self) -> None:
+    def _fail_all_streams(
+        self,
+        error: str = "worker engine error: engine loop failed or shut down",
+    ) -> None:
         """Terminate every in-flight stream (shutdown or loop crash)."""
-        err = LLMEngineOutput(
-            finish_reason="error",
-            error="worker engine error: engine loop failed or shut down",
-        )
+        err = LLMEngineOutput(finish_reason="error", error=error)
         with self._qlock:
             stuck = list(self.waiting) + [
                 s for s in self._slots if s is not None
@@ -900,7 +910,25 @@ class JaxEngine:
         for slot in stuck:
             if not slot.finished:
                 slot.finished = True
+                # finished=True makes the consumer's teardown skip the
+                # cancel request, so ask for it here: if the scheduler is
+                # still alive (drain_abort — the loop keeps running),
+                # _process_cancellations reaps the slot and frees its KV
+                # blocks; a process that stays up after a drain RPC must
+                # not leak every aborted slot.  On the loop-crash path
+                # nobody processes this, which is moot — close() tears
+                # the whole cache down.
+                slot.cancel_requested = True
                 slot.out_q.put_nowait(err)
+
+    def drain_abort(self) -> None:
+        """Graceful-drain deadline: error every in-flight stream with
+        the migratable "worker draining" marker so the frontend replays
+        each request (token-replay migration) on a surviving worker
+        with no client-visible failure."""
+        self.draining = True
+        self._fail_all_streams(error=DRAIN_ABORT)
+        self._wake.set()
 
     @property
     def num_active_seqs(self) -> int:
@@ -921,6 +949,21 @@ class JaxEngine:
         self, request: PreprocessedRequest, token=None
     ) -> AsyncIterator[LLMEngineOutput]:
         self.start()
+        if self.draining:
+            # reject before admission with the migratable marker: the
+            # router may still dispatch here in the window between lease
+            # withdrawal and its watch converging
+            yield LLMEngineOutput(finish_reason="error", error=DRAIN_REJECT)
+            return
+        if self._task is not None and self._task.done():
+            # the scheduler loop died (crash injection or a real bug):
+            # fail fast instead of parking the request forever — the
+            # marker classifies as migratable so the frontend replays it
+            yield LLMEngineOutput(
+                finish_reason="error",
+                error="worker engine error: engine loop crashed",
+            )
+            return
         if len(request.token_ids) >= self.config.max_context:
             yield LLMEngineOutput(
                 finish_reason="error",
@@ -1430,6 +1473,11 @@ class JaxEngine:
         with self._step_lock:
             if self._closed:
                 return
+            # chaos seam: crash ("fail") or wedge the scheduler on step
+            # N — the loop's crash handler fails all streams with the
+            # migratable worker-engine-error marker; a wedge is caught
+            # by the canary (health_check.py)
+            chaos.hit("engine.step", key=self.config.served_name)
             self._process_cancellations()
             self._maybe_offload()
             self._admit_waiting()
@@ -2003,12 +2051,32 @@ class JaxEngine:
         prefix."""
         src = None
         t0 = time.monotonic()
+        rid = slot.request.request_id
+
+        async def pull_chunk(b0: int, n: int):
+            # unified retry (runtime/retry.py): a transiently failing
+            # chunk op (peer hiccup, injected fault) is retried with
+            # jittered backoff before the whole pull gives up and falls
+            # back to local prefill.  The chaos seam sits INSIDE the
+            # retried call so `times=1` rules are absorbed by a retry
+            # while unlimited rules exhaust it.
+            async def once():
+                await chaos.ahit("disagg.pull.chunk", key=f"{rid}:{b0}")
+                return await src.chunk(b0, n)
+
+            return await call_with_retry(
+                once, PULL_POLICY,
+                on_retry=lambda a, e: logger.warning(
+                    "kv pull chunk [%d,%d) for %s failed (attempt %d): "
+                    "%s", b0, b0 + n, rid, a, e),
+            )
+
         try:
             await slot.admitted.wait()
             if slot.finished or slot.cancel_requested:
                 return
             src = await self.kv_pull_fn(dp)
-            header = await src.open()
+            header = await call_with_retry(src.open, PULL_POLICY)
             from ..disagg.transfer import KvLayout
 
             layout = KvLayout.from_dict(header["layout"])
@@ -2040,7 +2108,7 @@ class JaxEngine:
             # pipelined: chunk i+1 is in flight on the SOURCE while chunk
             # i injects on this engine's scheduler (receiver-paced, one
             # outstanding prefetch — the sender registry holds one chunk)
-            nxt = (asyncio.ensure_future(src.chunk(*spans[0]))
+            nxt = (asyncio.ensure_future(pull_chunk(*spans[0]))
                    if spans else None)
             try:
                 for idx, (b0, n) in enumerate(spans):
@@ -2048,7 +2116,7 @@ class JaxEngine:
                         return
                     arrs = await nxt
                     nxt = (asyncio.ensure_future(
-                        src.chunk(*spans[idx + 1]))
+                        pull_chunk(*spans[idx + 1]))
                         if idx + 1 < len(spans) else None)
                     await self._call_on_scheduler(
                         partial(self._inject_pulled_chunk, slot, b0, n,

@@ -565,11 +565,41 @@ class JaxEngineWorker:
             m.set("dynamo_engine_kv_usage", self.engine.kv_usage())
             m.set("dynamo_engine_itl_ema_seconds", self.engine.itl_ema_s)
 
+    async def drain(self, deadline_s: float = 5.0) -> None:
+        """Graceful drain (SIGTERM path): withdraw this worker's routing
+        identity from discovery, reject new work with the migratable
+        "worker draining" marker, let in-flight requests finish until the
+        deadline, then drain_abort() the rest so the frontend's
+        token-replay migration moves them to surviving workers with no
+        client-visible failure.  Only this worker's keys are deleted —
+        co-resident workers on the same runtime keep serving.
+
+        Followers of a multi-host slice have no routing identity and
+        nothing to drain (the leader's drain stops the step stream)."""
+        import time
+
+        if not self.mh.is_leader or self.engine is None:
+            return
+        self.engine.draining = True
+        if self.served is not None:
+            logger.warning("draining jax engine worker %d (deadline %.1fs)",
+                           self.served.instance_id, deadline_s)
+            await deregister_model(self.runtime, self.card,
+                                   self.served.instance_id)
+            await self.runtime.discovery.delete(self.served.instance.key())
+        t0 = time.monotonic()
+        while (self.engine.num_active_seqs
+               and time.monotonic() - t0 < deadline_s):
+            await asyncio.sleep(0.02)
+        self.engine.drain_abort()
+
     async def close(self) -> None:
         if getattr(self, "_broker_id", None) is not None:
             from ..disagg import broker
 
             broker.deregister_engine(self._broker_id)
+        for client in getattr(self, "_pull_clients", {}).values():
+            await client.close()
         if getattr(self, "_kvbm_index", None) is not None:
             await self._kvbm_index.close()
         if getattr(self, "_kvbm_pull_client", None) is not None:

@@ -10,24 +10,32 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from dataclasses import dataclass, replace
 from typing import Any, AsyncIterator, Dict, Optional
 
 from ..protocols import LLMEngineOutput, ModelDeploymentCard, PreprocessedRequest
 from ..runtime import CancellationToken, Client, EngineError
+from ..runtime.aio import StreamIdleTimeout, iter_with_idle_timeout
+from ..runtime.retry import MIGRATION_POLICY, Backoff
 from .preprocessor import OpenAIPreprocessor
 
 logger = logging.getLogger(__name__)
 
 MIGRATABLE_MARKERS = ("connection lost", "no handler", "worker draining",
-                      "not found", "worker engine error")
+                      "not found", "worker engine error", "worker stalled")
 
 
 def is_migratable(err: Exception) -> bool:
     """Worker-death errors are retryable on another instance; user
     cancellations and model errors are not (ref: migration.rs:60-75).
     'not found' covers the pick-vs-lease-expiry race (instance vanished
-    between routing and dispatch)."""
+    between routing and dispatch).  A transport-level OSError (dial
+    refused to a just-died worker, connection reset mid-stream) is an
+    instance failure by construction — the e2e drain scenario hits it
+    when a replay races the discovery watch."""
+    if isinstance(err, OSError):
+        return True
     msg = str(err).lower()
     return any(m in msg for m in MIGRATABLE_MARKERS)
 
@@ -42,11 +50,24 @@ class MigrationOperator:
     """
 
     def __init__(self, client: Client, migration_limit: int = 0,
-                 route=None):
+                 route=None, retry_policy=None,
+                 stream_idle_s: Optional[float] = None):
         self.client = client
         self.migration_limit = migration_limit
         # route(request, token) -> (instance_id | None); KV router plugs in here
         self.route = route
+        # unified backoff between replay attempts (runtime/retry.py):
+        # full jitter decorrelates a fleet of frontends replaying after
+        # the same worker death
+        self.retry_policy = retry_policy or MIGRATION_POLICY
+        # wedged-worker detector: a stream that goes silent for this
+        # long fails with a migratable "worker stalled" error and
+        # replays elsewhere (the canary withdraws the lease, but only
+        # this bound can rescue the request already in flight there).
+        # 0/None disables; default from DYN_STREAM_IDLE_S.
+        if stream_idle_s is None:
+            stream_idle_s = float(os.environ.get("DYN_STREAM_IDLE_S", "0"))
+        self.stream_idle_s = stream_idle_s or None
 
     async def generate(
         self, request: PreprocessedRequest,
@@ -57,6 +78,7 @@ class MigrationOperator:
         emitted: list[int] = []
         avoid: set[int] = set()
         route = self.route
+        backoff = Backoff(self.retry_policy)
         try:
             while True:
                 req = request
@@ -69,14 +91,37 @@ class MigrationOperator:
                     )
                 instance_id = None
                 if route is not None:
+                    live = self.client.instance_ids
+                    if avoid and all(i in avoid for i in live):
+                        # every live instance is on the avoid list — a
+                        # fleet-wide blip would otherwise permanently
+                        # exhaust routing candidates for this request;
+                        # instances that stayed dead are gone from
+                        # discovery anyway, so forgiving the set only
+                        # re-admits workers that recovered
+                        logger.warning(
+                            "request %s: avoid set %s excludes every live "
+                            "instance; relaxing", request.request_id,
+                            sorted(avoid))
+                        avoid.clear()
                     instance_id = await route(req, avoid=avoid)
                 try:
                     first = True
-                    async for item in self.client.generate(
+                    picked: list = []
+
+                    def on_pick(iid, _picked=picked):
+                        _picked.append(iid)
+                        if tracker is not None:
+                            tracker.on_dispatch(iid)
+
+                    stream = self.client.generate(
                         req.to_dict(), instance_id=instance_id, token=token,
-                        on_pick=(tracker.on_dispatch if tracker is not None
-                                 else None),
-                    ):
+                        on_pick=on_pick, avoid=avoid,
+                    )
+                    if self.stream_idle_s:
+                        stream = iter_with_idle_timeout(
+                            stream, self.stream_idle_s)
+                    async for item in stream:
                         out = LLMEngineOutput.from_dict(item)
                         if out.finish_reason == "error":
                             # not a completion: surface as an error (HTTP
@@ -94,7 +139,8 @@ class MigrationOperator:
                         emitted.extend(out.token_ids)
                         yield out
                     return
-                except (EngineError, RuntimeError) as e:
+                except (EngineError, RuntimeError, OSError,
+                        StreamIdleTimeout) as e:
                     if (token is not None and token.is_stopped()):
                         raise
                     if attempts >= self.migration_limit or not is_migratable(e):
@@ -102,11 +148,17 @@ class MigrationOperator:
                     attempts += 1
                     if instance_id is not None:
                         avoid.add(instance_id)
+                    elif picked:
+                        # the client's own router chose: avoid what it
+                        # picked, so a replay doesn't land back on the
+                        # instance that just failed
+                        avoid.add(picked[-1])
                     logger.warning(
                         "migrating request %s (attempt %d/%d) after: %s",
                         request.request_id, attempts, self.migration_limit, e,
                     )
-                    await asyncio.sleep(0.05)
+                    if not await backoff.sleep(token=token):
+                        raise
         finally:
             if hasattr(route, "complete"):
                 route.complete(request.request_id)

@@ -25,7 +25,9 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from .. import chaos
 from ..router.events import KvCacheEvent, kv_event_subject
+from ..runtime.retry import KVBM_POLICY, call_with_retry
 
 logger = logging.getLogger(__name__)
 
@@ -171,14 +173,35 @@ class RemoteKvbmPuller:
         out: List[Tuple] = []
 
         async def pull() -> None:
+            # each attempt restarts the run — the leading-run contract
+            # below would reject a resumed walk with a gap anyway
+            out.clear()
             async for frame in self.client.generate(
                     {"hashes": want}, instance_id=worker):
+                # chaos seam: peer pull fails partway through the run /
+                # slow peer (key carries the frame ordinal for after=N)
+                await chaos.ahit("kvbm.remote_pull",
+                                 key=f"{worker}:{len(out)}")
                 if frame.get("h") is None:
                     break  # peer signals end-of-run (evicted mid-walk)
                 out.append(decode_block(frame))
 
         try:
-            await asyncio.wait_for(pull(), timeout=self.timeout_s)
+            # unified retry (runtime/retry.py): a transient peer hiccup
+            # re-pulls with jittered backoff before we give the peer up.
+            # The deadline wraps the WHOLE retried operation — timeout_s
+            # stays the hard give-up bound for a slow/dead peer (a
+            # timeout retried 3x would triple decode's wait for KV that
+            # local prefill can recompute), and wait_for's cancellation
+            # aborts the in-flight attempt immediately.
+            await asyncio.wait_for(
+                call_with_retry(
+                    pull, KVBM_POLICY,
+                    on_retry=lambda a, e: logger.warning(
+                        "kvbm pull from %d failed (attempt %d): %s",
+                        worker, a, e),
+                ),
+                timeout=self.timeout_s)
         except asyncio.TimeoutError:
             logger.warning("kvbm pull from %d timed out after %d blocks",
                            worker, len(out))

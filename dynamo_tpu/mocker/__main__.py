@@ -6,6 +6,7 @@ for frontend/router/planner testing.
 
 import argparse
 import asyncio
+import logging
 
 from ..runtime import DistributedRuntime
 from ..runtime.logging import setup_logging
@@ -38,6 +39,28 @@ def build_args() -> argparse.ArgumentParser:
                         "block pool to what the same HBM budget holds "
                         "at int8 bytes-per-block (~1.94x blocks) and is "
                         "advertised in the MDC like the JAX worker")
+    # fault modes (chaos plane satellites): run chaos scenarios in tier-1
+    # and live e2e without a real crash harness
+    p.add_argument("--fail-after-tokens", type=int, default=0,
+                   help="simulate worker death after N decode tokens: "
+                        "every stream errors with the migratable "
+                        "connection-lost marker (0 = off)")
+    p.add_argument("--wedge-after", type=int, default=0,
+                   help="stop stepping after N scheduler steps "
+                        "(alive-but-stuck; the canary withdraws the "
+                        "lease, the frontend's idle bound rescues "
+                        "in-flight streams; 0 = off)")
+    p.add_argument("--flaky", type=float, default=0.0,
+                   help="per-decode-token probability of dropping that "
+                        "stream with a migratable error (0.0 = off)")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for the fault-mode RNG (reproducible "
+                        "--flaky runs)")
+    p.add_argument("--drain-deadline-s", type=float, default=5.0,
+                   help="SIGTERM grace: in-flight requests get this long "
+                        "to finish before the rest error with the "
+                        "migratable 'worker draining' marker and "
+                        "replay elsewhere")
     return p
 
 
@@ -56,6 +79,10 @@ async def main() -> None:
         speculative=({"k": args.spec_k, "acceptance": args.spec_acceptance}
                      if args.spec_k > 0 else None),
         kv_cache_dtype=args.kv_cache_dtype,
+        fail_after_tokens=args.fail_after_tokens,
+        wedge_after=args.wedge_after,
+        flaky=args.flaky,
+        fault_seed=args.fault_seed,
     )
     rt = await DistributedRuntime.detached().start()
     workers = []
@@ -64,6 +91,28 @@ async def main() -> None:
                          component=args.component,
                          migration_limit=args.migration_limit)
         workers.append(await w.start())
+
+    async def drain_all() -> None:
+        # graceful SIGTERM: drain every worker (in-flight requests finish
+        # or migrate with zero client-visible errors), then exit — even
+        # if a drain step fails, the process must still come down.
+        # return_exceptions: one worker's failed drain (flaky discovery)
+        # must not cut short the others' grace period mid-drain
+        try:
+            results = await asyncio.gather(
+                *(w.drain(args.drain_deadline_s) for w in workers),
+                return_exceptions=True)
+            for w, r in zip(workers, results):
+                if isinstance(r, BaseException):
+                    logging.getLogger(__name__).error(
+                        "drain of worker %s failed",
+                        w.served.instance_id, exc_info=r)
+        finally:
+            rt.root_token.kill()
+
+    from ..runtime.aio import install_drain_handler
+
+    install_drain_handler(drain_all)
     print(f"ready workers={[w.served.instance_id for w in workers]}", flush=True)
     try:
         await rt.root_token.wait_killed()

@@ -15,13 +15,27 @@ import asyncio
 import logging
 import random
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Dict, List, Optional
 
-from ..protocols import LLMEngineOutput, PreprocessedRequest
+from .. import chaos
+from ..protocols import (
+    DRAIN_ABORT,
+    DRAIN_REJECT,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
 from ..tokens import TokenBlockSequence, request_salt
 
 logger = logging.getLogger(__name__)
+
+# migratable markers (frontend/pipeline.py MIGRATABLE_MARKERS) carried by
+# the simulated fault modes, so a mocker-injected death classifies exactly
+# like a real one; the drain markers are shared with the JAX engine
+# (protocols.DRAIN_REJECT / DRAIN_ABORT)
+DEATH_ERROR = "connection lost (mocker: simulated worker death)"
+FLAKY_ERROR = "connection lost (mocker: flaky stream drop)"
 
 
 @dataclass
@@ -64,6 +78,21 @@ class MockEngineArgs:
     # in the MDC exactly like the JAX worker, so router/planner tier-1
     # tests cover the 2x-blocks regime without a TPU
     kv_cache_dtype: str = "bf16"
+    # -- fault modes (chaos plane satellites) -----------------------------
+    # die (error every stream with the migratable DEATH_ERROR marker,
+    # reject everything after) once this many decode tokens have been
+    # emitted engine-wide; 0 = off.  Simulates worker-kill-mid-decode
+    # without a crash harness.
+    fail_after_tokens: int = 0
+    # stop stepping (alive-but-stuck: requests admit, streams go silent)
+    # after this many scheduler steps; 0 = off.  The canary path and the
+    # frontend's stream-idle rescue are what should save the requests.
+    wedge_after: int = 0
+    # per-decode-token probability of dropping that sequence's stream
+    # with the migratable FLAKY_ERROR marker; 0.0 = off
+    flaky: float = 0.0
+    # seed for the fault-mode RNG (flaky draws) — reproducible chaos
+    fault_seed: int = 0
 
 
 @dataclass
@@ -73,6 +102,7 @@ class _Seq:
     blocks: TokenBlockSequence
     out_queue: asyncio.Queue
     num_prompt_tokens: int
+    seed_val: int = 0  # position-addressed stream seed (see _next_token)
     prefill_pos: int = 0  # tokens prefetched so far (chunked prefill)
     generated: int = 0
     cached_blocks: int = 0
@@ -99,6 +129,13 @@ class MockEngine:
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._closed = False
+        # graceful drain (worker.drain()): reject new work with the
+        # migratable marker while in-flight requests finish or migrate
+        self.draining = False
+        # fail_after_tokens tripped: the simulated worker is dead
+        self.dead = False
+        # fault-mode RNG (flaky draws) — seeded, so chaos runs reproduce
+        self._fault_rng = random.Random(args.fault_seed)
         # FPM-style counters
         self.metrics = {
             "steps": 0, "prefill_tokens": 0, "decode_tokens": 0,
@@ -148,8 +185,31 @@ class MockEngine:
     ) -> AsyncIterator[LLMEngineOutput]:
         """Enqueue a request and stream engine outputs (one token per item)."""
         self.start()
+        if self.draining:
+            # reject before admission: the router may still dispatch here
+            # in the window between lease withdrawal and watch convergence
+            yield LLMEngineOutput(finish_reason="error", error=DRAIN_REJECT)
+            return
+        if self.dead:
+            yield LLMEngineOutput(finish_reason="error", error=DEATH_ERROR)
+            return
+        if self._task is not None and self._task.done():
+            # scheduler loop died (chaos injection or a bug): fail fast
+            # with the migratable marker instead of parking forever
+            yield LLMEngineOutput(
+                finish_reason="error",
+                error="worker engine error: engine loop crashed")
+            return
         self.metrics["requests"] += 1
         self.metrics["prompt_tokens"] += len(request.token_ids)
+        # zlib.crc32, not hash(): the builtin is randomized per process
+        # (PYTHONHASHSEED), and this seed must survive a cross-process
+        # migration — worker B regenerating a seedless request's stream
+        # has to agree with worker A about the suffix
+        seed_val = (request.sampling.seed
+                    if request.sampling.seed is not None
+                    else zlib.crc32(request.request_id.encode())
+                    & 0x7FFFFFFF)
         seq = _Seq(
             request_id=request.request_id,
             request=request,
@@ -160,11 +220,8 @@ class MockEngine:
             ),
             out_queue=asyncio.Queue(),
             num_prompt_tokens=len(request.token_ids),
-            rng=random.Random(
-                request.sampling.seed
-                if request.sampling.seed is not None
-                else hash(request.request_id) & 0x7FFFFFFF
-            ),
+            seed_val=seed_val,
+            rng=random.Random(seed_val),
         )
         from ..protocols.llm import DISAGG_ANNOTATION
 
@@ -198,6 +255,36 @@ class MockEngine:
             await self.publisher.removed(removed)
         return len(removed)
 
+    def _fail_all_streams(self, error: str) -> None:
+        """Terminate every in-flight stream with a typed error."""
+        err = LLMEngineOutput(finish_reason="error", error=error)
+        stuck = self.waiting + self.running
+        self.waiting = []
+        self.running = []
+        for seq in stuck:
+            if not seq.finished:
+                seq.finished = True
+                res = self.cache.free(seq.request_id)
+                self._publish(res)
+                seq.out_queue.put_nowait(err)
+
+    def drain_abort(self) -> None:
+        """Graceful-drain deadline: error every in-flight stream with the
+        migratable "worker draining" marker so the frontend replays each
+        request on a surviving worker with no client-visible failure."""
+        self.draining = True
+        self._fail_all_streams(DRAIN_ABORT)
+
+    def _die(self) -> None:
+        """fail_after_tokens tripped: simulate a worker death — every
+        stream errors with the migratable connection-lost marker and the
+        engine rejects everything from now on."""
+        logger.warning("mock engine %s: simulated death after %d tokens",
+                       self.args.model_name,
+                       self.metrics["decode_tokens"])
+        self.dead = True
+        self._fail_all_streams(DEATH_ERROR)
+
     # -- internals --------------------------------------------------------
     def _cancel_seq(self, seq: _Seq) -> None:
         seq.finished = True
@@ -224,6 +311,14 @@ class MockEngine:
                 await self._step()
         except asyncio.CancelledError:
             pass
+        except Exception:
+            # mirror JaxEngine._loop: a crashed scheduler (chaos "fail"
+            # injection or a bug) fails every stream with the migratable
+            # worker-engine-error marker so the frontend replays them
+            logger.exception("mock engine loop crashed")
+            self._fail_all_streams(
+                "worker engine error: engine loop failed or shut down")
+            raise
 
     def _try_admit(self) -> None:
         while self.waiting and len(self.running) < self.args.max_num_seqs:
@@ -248,6 +343,17 @@ class MockEngine:
             self.running.append(seq)
 
     async def _step(self) -> None:
+        if (self.args.wedge_after
+                and self.metrics["steps"] >= self.args.wedge_after):
+            # alive-but-stuck: the lease stays fresh, admitted streams go
+            # silent — the canary (health_check.py) and the frontend's
+            # stream-idle rescue are what must save the requests
+            await asyncio.sleep(3600.0)
+            return
+        # chaos seam: crash ("fail") or wedge the scheduler on step N —
+        # same seam name as JaxEngine._sched_step, so one chaos rule
+        # drives either engine
+        await chaos.ahit("engine.step", key=self.args.model_name)
         self._try_admit()
         if not self.running:
             await asyncio.sleep(0)  # let admissions catch up
@@ -289,6 +395,11 @@ class MockEngine:
                 else 0.9 * self.itl_ema_s + 0.1 * step_s
 
         for seq in decode_seqs:
+            if seq.finished or seq not in self.running:
+                # finished while this step slept: drain_abort()/_die()/
+                # cancellation ran at the await point and already freed
+                # the seq — touching its cache entry now would KeyError
+                continue
             if seq.disagg_prefill:
                 # prefill-only hop: emit first token + transfer metadata and
                 # finish (mock transfer is instantaneous; no parking)
@@ -325,6 +436,21 @@ class MockEngine:
                 })
                 emit = 1 + a
             for _ in range(emit):
+                if (self.args.fail_after_tokens
+                        and self.metrics["decode_tokens"]
+                        >= self.args.fail_after_tokens):
+                    self._die()
+                    return
+                if (self.args.flaky
+                        and self._fault_rng.random() < self.args.flaky):
+                    # drop just this sequence's stream mid-decode with a
+                    # migratable marker; the engine itself stays healthy
+                    seq.finished = True
+                    self.running.remove(seq)
+                    self._publish(self.cache.free(seq.request_id))
+                    seq.out_queue.put_nowait(LLMEngineOutput(
+                        finish_reason="error", error=FLAKY_ERROR))
+                    break
                 tok = self._next_token(seq)
                 completed = seq.blocks.append(tok)
                 partial = seq.blocks.partial_len()
@@ -379,8 +505,16 @@ class MockEngine:
             if seq.generated < len(data):
                 return 3 + data[seq.generated]  # MockTokenizer BYTE_BASE
             return self.args.eos_token_id
-        # deterministic pseudo-random stream; occasionally the EOS token
-        r = seq.rng
+        # Position-addressed deterministic stream: the token at absolute
+        # context position n is a pure function of (seed, n) — the mock
+        # analogue of greedy decoding being a pure function of context.
+        # This is what makes token-replay migration exact here: a
+        # replayed request (prompt + already-emitted tokens) continues at
+        # the same absolute position and regenerates the identical
+        # suffix, so the chaos suite can assert token-identity between a
+        # faulted run and the fault-free one.
+        pos = seq.num_prompt_tokens + seq.generated
+        r = random.Random((seq.seed_val << 20) ^ pos)
         if not seq.request.stop.ignore_eos and r.random() < 0.005:
             return self.args.eos_token_id
         return r.randrange(3, self.args.vocab_size)

@@ -214,6 +214,34 @@ class MockerWorker:
                 "itl_ema_s": itl,
             })
 
+    async def drain(self, deadline_s: float = 5.0) -> None:
+        """Graceful drain (SIGTERM path): withdraw this worker's routing
+        identity from discovery, reject new work, let in-flight requests
+        finish until the deadline, then error the rest with the
+        migratable "worker draining" marker so the frontend replays them
+        on surviving workers — zero client-visible failures.
+
+        Only THIS worker's keys are deleted (not the runtime lease):
+        co-resident workers on the same runtime keep serving."""
+        import time
+
+        from ..protocols.model_card import deregister_model
+
+        for eng in getattr(self, "engines", []):
+            eng.draining = True
+        if self.served is not None:
+            logger.warning("draining mocker worker %d (deadline %.1fs)",
+                           self.served.instance_id, deadline_s)
+            await deregister_model(self.runtime, self.card,
+                                   self.served.instance_id)
+            await self.runtime.discovery.delete(self.served.instance.key())
+        t0 = time.monotonic()
+        while (any(e.num_active_seqs for e in getattr(self, "engines", []))
+               and time.monotonic() - t0 < deadline_s):
+            await asyncio.sleep(0.02)
+        for eng in getattr(self, "engines", []):
+            eng.drain_abort()
+
     async def close(self) -> None:
         from ..protocols.model_card import deregister_model
 

@@ -1,4 +1,6 @@
 from .llm import (
+    DRAIN_ABORT,
+    DRAIN_REJECT,
     FinishReason,
     LLMEngineOutput,
     PreprocessedRequest,
@@ -8,6 +10,8 @@ from .llm import (
 from .model_card import ModelDeploymentCard
 
 __all__ = [
+    "DRAIN_ABORT",
+    "DRAIN_REJECT",
     "FinishReason",
     "LLMEngineOutput",
     "ModelDeploymentCard",

@@ -18,6 +18,15 @@ FinishReason = str  # "stop" | "length" | "eos" | "cancelled" | "error"
 # prefill only and parks the KV for the decode worker to pull)
 DISAGG_ANNOTATION = "disagg_prefill"
 
+# graceful-drain error markers (engine/worker.py drain(), mocker drain):
+# one shared definition because BOTH engines must emit byte-identical
+# text and the frontend's migratable classification
+# (frontend/pipeline.py MIGRATABLE_MARKERS) substring-matches the
+# "worker draining" prefix — a reworded copy in one engine would
+# silently break token-replay migration for that engine only
+DRAIN_REJECT = "worker draining: request rejected before admission"
+DRAIN_ABORT = "worker draining: in-flight request migrating"
+
 
 @dataclass
 class SamplingOptions:

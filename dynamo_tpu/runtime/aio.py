@@ -3,9 +3,53 @@
 from __future__ import annotations
 
 import asyncio
-from typing import Any, AsyncIterator, Optional
+import logging
+import signal as _signal
+from typing import Any, AsyncIterator, Callable, Optional
+
+logger = logging.getLogger(__name__)
 
 _SENTINEL = object()
+
+
+def install_drain_handler(
+    drain: Callable[[], "asyncio.Future | Any"],
+    signals: tuple = (_signal.SIGTERM, _signal.SIGINT),
+) -> None:
+    """SIGTERM/SIGINT → graceful drain.
+
+    The FIRST signal starts `drain` (an async callable, run once on the
+    current loop).  Any signal after that — drain still running or
+    already done — restores the default disposition and re-delivers
+    itself, terminating the process immediately: a drain stuck on a dead
+    discovery backend must still be killable by a plain second TERM/^C,
+    and an orchestrator's TERM → grace-period → KILL sequence maps onto
+    drain semantics (engine/worker.py drain(): withdraw lease, finish
+    in-flight, migrate the rest)."""
+    loop = asyncio.get_running_loop()
+    state: dict = {"task": None}
+
+    def _on_signal(sig: int) -> None:
+        if state["task"] is not None:
+            # second signal: graceful had its chance — fall through to
+            # default handling NOW (terminate), not on some later signal
+            logger.warning("signal %s during/after drain: exiting",
+                           _signal.Signals(sig).name)
+            loop.remove_signal_handler(sig)
+            _signal.raise_signal(sig)
+            return
+        logger.warning("signal %s: draining", _signal.Signals(sig).name)
+        state["task"] = loop.create_task(drain())
+        # a drain that dies must be LOUD: its exception would otherwise
+        # never be retrieved (this dict holds the only reference) and the
+        # process would sit in wait_killed forever
+        state["task"].add_done_callback(
+            lambda t: (not t.cancelled() and t.exception() is not None
+                       and logger.error("drain failed",
+                                        exc_info=t.exception())))
+
+    for sig in signals:
+        loop.add_signal_handler(sig, _on_signal, sig)
 
 
 async def next_or_cancel(q: asyncio.Queue, cancel: Optional[asyncio.Event]) -> Any:
@@ -31,6 +75,71 @@ async def next_or_cancel(q: asyncio.Queue, cancel: Optional[asyncio.Event]) -> A
 
 
 CANCELLED = _SENTINEL
+
+
+class StreamIdleTimeout(Exception):
+    """No item arrived within the idle window (wedged producer)."""
+
+
+async def iter_with_idle_timeout(
+    ait: AsyncIterator[Any], idle_s: float
+) -> AsyncIterator[Any]:
+    """Re-yield `ait`, raising StreamIdleTimeout if the gap between
+    items (or before the first item) exceeds `idle_s`.  This is the
+    frontend's wedged-worker detector: a stream from an alive-but-stuck
+    worker produces no error on its own — lease withdrawal stops NEW
+    routing, but only an idle bound can fail the in-flight stream so
+    migration replays it elsewhere."""
+    it = ait.__aiter__()
+    try:
+        while True:
+            nxt = asyncio.ensure_future(it.__anext__())
+            try:
+                item = await asyncio.wait_for(asyncio.shield(nxt), idle_s)
+            except asyncio.TimeoutError:
+                if nxt.done() and not nxt.cancelled():
+                    # not an idle gap: the future resolved in the same
+                    # cycle the deadline fired (wait_for reports timeout
+                    # even when the shielded future already holds an
+                    # outcome) — use the stream's REAL outcome, whether
+                    # that is a frame that must not be dropped, a clean
+                    # end, or the stream's own error (surfaced as-is,
+                    # not misreported as a stall that never elapsed)
+                    exc = nxt.exception()
+                    if exc is None:
+                        item = nxt.result()
+                    elif isinstance(exc, StopAsyncIteration):
+                        return
+                    else:
+                        raise exc
+                else:
+                    nxt.cancel()
+                    try:
+                        await nxt
+                    except (asyncio.CancelledError, StopAsyncIteration,
+                            Exception):
+                        pass
+                    raise StreamIdleTimeout(
+                        f"worker stalled: no stream frame for "
+                        f"{idle_s:.1f}s") from None
+            except StopAsyncIteration:
+                return
+            except asyncio.CancelledError:
+                nxt.cancel()
+                raise
+            yield item
+    finally:
+        # propagate closure to the inner stream promptly: a consumer that
+        # abandons this wrapper (migration raising past the async-for)
+        # must release the underlying client stream NOW — its finally is
+        # what tells the worker to stop generating for a dead consumer —
+        # not whenever the GC finalizes an orphaned async generator
+        aclose = getattr(it, "aclose", None)
+        if aclose is not None:
+            try:
+                await aclose()
+            except Exception:
+                pass
 
 
 async def iter_queue(

@@ -13,6 +13,7 @@ import asyncio
 import logging
 from typing import Any, AsyncIterator, Dict, Optional
 
+from .. import chaos
 from .cancellation import CancellationToken
 from .discovery import INSTANCE_PREFIX, Instance, WatchEvent, new_instance_id
 from .push_router import PushRouter, RouterMode
@@ -180,10 +181,15 @@ class Client:
         token: Optional[CancellationToken] = None,
         ctx: Optional[Dict[str, Any]] = None,
         on_pick=None,
+        avoid=(),
     ) -> AsyncIterator[Any]:
         """Route a request and yield the response stream.  `on_pick` is
         told the chosen instance id (request tracing needs the placement
-        even when this client's own router decides it)."""
+        even when this client's own router decides it).  `avoid` holds
+        instance ids that already failed this request (migration): the
+        built-in router skips them while any alternative exists — a
+        replay must not land back on the worker that just died while the
+        discovery watch is still converging."""
         if not self._instances:
             await self.wait_for_instances()
         if instance_id is not None:
@@ -191,11 +197,21 @@ class Client:
             if inst is None:
                 raise RuntimeError(f"instance {instance_id} not found for {self.endpoint.path}")
         else:
-            inst = self.router.pick(self.instances)
+            candidates = self.instances
+            if avoid:
+                filtered = [i for i in candidates
+                            if i.instance_id not in avoid]
+                if filtered:
+                    candidates = filtered
+            inst = self.router.pick(candidates)
         if on_pick is not None:
             on_pick(inst.instance_id)
         self.router.on_dispatch(inst.instance_id)
         try:
+            # chaos seam: dispatch failure (instance picked but the
+            # stream never opens — the pick-vs-death race, injectable)
+            await chaos.ahit("request_plane.dispatch",
+                             key=f"{self.endpoint.path}:{inst.instance_id}")
             async for item in self.runtime.request_client.stream(
                 inst.address, self.endpoint.path, payload, ctx=ctx,
                 token=token, instance_id=inst.instance_id,

@@ -19,11 +19,16 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import secrets
 import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+from .. import chaos
+
+logger = logging.getLogger(__name__)
 
 INSTANCE_PREFIX = "v1/instances"
 MDC_PREFIX = "v1/mdc"
@@ -140,12 +145,17 @@ class DiscoveryBackend:
 
     async def withdraw_lease(self) -> None:
         """Temporarily remove every leased key (unhealthy process);
-        `restore_lease` re-registers them."""
+        `restore_lease` re-registers them.  Failure-partway semantics
+        matter (the chaos suite injects them): keys stashed by an earlier
+        partial attempt must survive a retry — they are no longer in
+        `_owned_values` (delete() popped them), so resetting the stash
+        here would lose their values forever."""
         # stash each key only after ITS delete: a concurrent legitimate
         # delete (endpoint shutdown mid-withdraw) either empties the
         # _owned_values slot before we process it (skipped below) or pops
         # it from _withdrawn_values after we stashed it — never resurrected
-        self._withdrawn_values = {}
+        if not hasattr(self, "_withdrawn_values"):
+            self._withdrawn_values = {}
         owned = getattr(self, "_owned_values", {})
         for key in list(owned):
             value = owned.get(key)
@@ -155,10 +165,23 @@ class DiscoveryBackend:
             self._withdrawn_values[key] = value
 
     async def restore_lease(self) -> None:
+        """Re-register everything withdraw_lease stashed.  A put that
+        fails partway (transient discovery outage) must keep the
+        not-yet-restored keys stashed so the caller's retry (the next
+        canary probe's reconcile) can finish the job."""
         stash = getattr(self, "_withdrawn_values", {})
         self._withdrawn_values = {}
-        for key, value in stash.items():
-            await self.put(key, value)
+        try:
+            while stash:
+                key = next(iter(stash))
+                await self.put(key, stash[key])
+                stash.pop(key)
+        finally:
+            if stash:
+                # failed partway: merge survivors back (a concurrent
+                # withdraw may have stashed new keys meanwhile)
+                for key, value in stash.items():
+                    self._withdrawn_values.setdefault(key, value)
 
 
 # ---------------------------------------------------------------------------
@@ -188,6 +211,7 @@ class MemDiscovery(DiscoveryBackend):
         self._owned_values: Dict[str, Dict[str, Any]] = {}
 
     async def put(self, key: str, value: Dict[str, Any], lease: bool = True) -> None:
+        await chaos.ahit("discovery.op", key=f"put:{key}")
         self._cluster.store[key] = value
         if lease:
             self._owned.add(key)
@@ -195,6 +219,7 @@ class MemDiscovery(DiscoveryBackend):
         self._cluster.notify(WatchEvent("put", key, value))
 
     async def delete(self, key: str) -> None:
+        await chaos.ahit("discovery.op", key=f"delete:{key}")
         self._cluster.store.pop(key, None)
         self._owned.discard(key)
         self._owned_values.pop(key, None)
@@ -202,6 +227,7 @@ class MemDiscovery(DiscoveryBackend):
         self._cluster.notify(WatchEvent("delete", key))
 
     async def get_prefix(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        await chaos.ahit("discovery.op", key=f"get:{prefix}")
         return {k: v for k, v in self._cluster.store.items() if k.startswith(prefix)}
 
     async def watch(
@@ -269,6 +295,17 @@ class FileDiscovery(DiscoveryBackend):
 
     async def _heartbeat_loop(self) -> None:
         while not self._closed.is_set():
+            try:
+                # chaos seam: a missed heartbeat beat — owned keys age
+                # toward TTL expiry exactly like a partitioned process
+                await chaos.ahit("discovery.lease", key=self.root)
+            except chaos.ChaosError:
+                try:
+                    await asyncio.wait_for(self._closed.wait(),
+                                           timeout=self.ttl_s / 3)
+                except asyncio.TimeoutError:
+                    pass
+                continue
             for key in list(self._owned):
                 p = self._path(key)
                 try:
@@ -281,6 +318,7 @@ class FileDiscovery(DiscoveryBackend):
                 pass
 
     async def put(self, key: str, value: Dict[str, Any], lease: bool = True) -> None:
+        await chaos.ahit("discovery.op", key=f"put:{key}")
         await self.start()
         p = self._path(key)
         os.makedirs(os.path.dirname(p), exist_ok=True)
@@ -293,6 +331,7 @@ class FileDiscovery(DiscoveryBackend):
             self._owned_values[key] = value
 
     async def delete(self, key: str) -> None:
+        await chaos.ahit("discovery.op", key=f"delete:{key}")
         self._owned.discard(key)
         self._owned_values.pop(key, None)
         self._forget_withdrawn(key)
@@ -330,6 +369,7 @@ class FileDiscovery(DiscoveryBackend):
         return out
 
     async def get_prefix(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        await chaos.ahit("discovery.op", key=f"get:{prefix}")
         return await asyncio.get_event_loop().run_in_executor(None, self._scan, prefix)
 
     async def watch(
@@ -337,11 +377,22 @@ class FileDiscovery(DiscoveryBackend):
     ) -> AsyncIterator[WatchEvent]:
         known: Dict[str, str] = {}
         while cancel is None or not cancel.is_set():
-            snap = await self.get_prefix(prefix)
-            pending: List[WatchEvent] = []
-            diff_snapshot(known, snap, pending.append)
-            for ev in pending:
-                yield ev
+            try:
+                snap = await self.get_prefix(prefix)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # transient scan failure (FS hiccup / injected outage):
+                # keep the last known view and retry next poll — a
+                # poll-based watch must not die on one bad snapshot
+                logger.warning("file discovery scan failed; retrying",
+                               exc_info=True)
+                snap = None
+            if snap is not None:
+                pending: List[WatchEvent] = []
+                diff_snapshot(known, snap, pending.append)
+                for ev in pending:
+                    yield ev
             try:
                 if cancel is not None:
                     await asyncio.wait_for(cancel.wait(), timeout=self.poll_s)

@@ -26,7 +26,9 @@ import json
 import logging
 from typing import Any, AsyncIterator, Dict, Optional
 
+from .. import chaos
 from .discovery import DiscoveryBackend, WatchEvent, diff_snapshot
+from .retry import LEASE_POLICY, call_with_retry
 
 logger = logging.getLogger(__name__)
 
@@ -94,8 +96,16 @@ class EtcdDiscovery(DiscoveryBackend):
         async with self._start_lock:  # concurrent first puts race here
             if self.lease_id is not None:
                 return
-            out = await self._call("/v3/lease/grant",
-                                   {"TTL": int(round(self.ttl_s)), "ID": 0})
+            # lease ops ride the unified retry policy (runtime/retry.py):
+            # a transient gateway outage at startup must not kill the
+            # worker before it ever registers
+            out = await call_with_retry(
+                lambda: self._call("/v3/lease/grant",
+                                   {"TTL": int(round(self.ttl_s)), "ID": 0}),
+                LEASE_POLICY,
+                on_retry=lambda n, e: logger.warning(
+                    "etcd lease grant failed (attempt %d): %s", n, e),
+            )
             self.lease_id = int(out["ID"])
             if self._ka_task is None:
                 self._ka_task = asyncio.create_task(self._keepalive_loop())
@@ -112,6 +122,10 @@ class EtcdDiscovery(DiscoveryBackend):
             except asyncio.TimeoutError:
                 pass
             try:
+                # chaos seam: fail = a missed keepalive (transient
+                # outage); the loop's own retry-next-tick then covers
+                # recovery, and a long enough outage expires the lease
+                await chaos.ahit("discovery.lease", key=self.endpoint)
                 async with self._http().post(
                     f"{self.endpoint}/v3/lease/keepalive",
                     json={"ID": self.lease_id},
@@ -131,20 +145,31 @@ class EtcdDiscovery(DiscoveryBackend):
                     logger.warning("etcd re-register failed: %s", e)
 
     async def _reregister(self) -> None:
-        out = await self._call("/v3/lease/grant",
-                               {"TTL": int(round(self.ttl_s)), "ID": 0})
+        out = await call_with_retry(
+            lambda: self._call("/v3/lease/grant",
+                               {"TTL": int(round(self.ttl_s)), "ID": 0}),
+            LEASE_POLICY,
+        )
         self.lease_id = int(out["ID"])
         for key, value in list(self._owned.items()):
-            await self._call("/v3/kv/put", {
+            body = {
                 "key": _b64(key.encode()),
                 "value": _b64(json.dumps(value).encode()),
                 "lease": self.lease_id,
-            })
+            }
+            # per-key retry: one flaky put must not abort the whole
+            # re-registration (the keepalive loop would restart it, but
+            # each restart grants yet another lease)
+            await call_with_retry(
+                lambda body=body: self._call("/v3/kv/put", body),
+                LEASE_POLICY,
+            )
 
     # -- kv ---------------------------------------------------------------
 
     async def put(self, key: str, value: Dict[str, Any],
                   lease: bool = True) -> None:
+        await chaos.ahit("discovery.op", key=f"put:{key}")
         await self.start()
         body = {
             "key": _b64(key.encode()),
@@ -156,6 +181,7 @@ class EtcdDiscovery(DiscoveryBackend):
         await self._call("/v3/kv/put", body)
 
     async def delete(self, key: str) -> None:
+        await chaos.ahit("discovery.op", key=f"delete:{key}")
         self._owned.pop(key, None)
         self._forget_withdrawn(key)
         await self._call("/v3/kv/deleterange", {"key": _b64(key.encode())})
@@ -176,6 +202,7 @@ class EtcdDiscovery(DiscoveryBackend):
         return kvs, revision
 
     async def get_prefix(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        await chaos.ahit("discovery.op", key=f"get:{prefix}")
         kvs, _ = await self._range(prefix)
         return kvs
 

@@ -59,6 +59,11 @@ class _Target:
     last_result_t: float = 0.0
     activity: asyncio.Event = field(default_factory=asyncio.Event)
     task: Optional[asyncio.Task] = None
+    # deregistered: the loop must exit even if its cancellation is lost
+    # (py3.10 wait_for swallows a cancel that races the inner future
+    # completing — exactly what happens when drain's last stream frames
+    # fire on_activity while close() cancels the canary)
+    closed: bool = False
 
     @property
     def subject(self) -> str:
@@ -90,6 +95,7 @@ class SystemHealth:
                                 instance_id: Optional[int]) -> None:
         t = self.targets.pop(f"{path}:{instance_id}", None)
         if t is not None and t.task is not None:
+            t.closed = True
             t.task.cancel()
             try:
                 await t.task
@@ -129,7 +135,7 @@ class SystemHealth:
 
     # -- canary machinery -------------------------------------------------
     async def _canary_loop(self, t: _Target) -> None:
-        while True:
+        while not t.closed:
             try:
                 await asyncio.wait_for(t.activity.wait(),
                                        timeout=self.config.canary_wait_s)
@@ -137,6 +143,8 @@ class SystemHealth:
                 continue  # organic traffic proved health; re-arm
             except asyncio.TimeoutError:
                 pass
+            if t.closed:
+                return
             ok = await self._probe(t)
             t.last_result_t = time.monotonic()
             if ok != t.ready:

@@ -18,6 +18,7 @@ import logging
 import secrets
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
+from .. import chaos
 from .cancellation import CancellationToken
 from .codec import read_frame, write_frame
 
@@ -169,6 +170,16 @@ class RequestPlaneServer:
         ctx = RequestContext(rid, token, frame.get("ctx"))
         try:
             async for item in handler(frame.get("payload"), ctx):
+                if chaos.active() is not None:
+                    # chaos seam: per-frame fate — "drop" loses this
+                    # frame, "delay" stalls the stream, "truncate"/
+                    # "fail" raise (the client sees the same err frame
+                    # a dying worker would produce)
+                    fate = await chaos.ahit(
+                        "request_plane.frame",
+                        key=f"{path}:{frame.get('iid')}")
+                    if fate == "drop":
+                        continue
                 await send({"t": "data", "id": rid, "data": item})
                 if self.on_activity is not None:
                     self.on_activity(path, frame.get("iid"))

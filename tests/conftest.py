@@ -16,11 +16,25 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # tmpfs weight cache for them would grow /dev/shm forever (explicit cache
 # tests point DYN_WEIGHT_CACHE_DIR at a tmp dir instead)
 os.environ.setdefault("DYN_WEIGHT_CACHE", "0")
+# NOTE: do NOT enable JAX's persistent compilation cache here.  On this
+# image (jaxlib 0.4.36 CPU, 8 virtual devices, donated-buffer engine
+# programs) deserializing cached executables corrupts the heap: a warm
+# cache makes the suite fail nondeterministically — wrong KV bytes in the
+# multihost bit-identity tests on a good day, a segfault inside gc on a
+# bad one.  Reproducer: run tests/test_engine.py tests/test_kvbm.py
+# tests/test_multihost.py twice with JAX_COMPILATION_CACHE_DIR pointed at
+# the same dir — cold passes, warm crashes.  The suite's wall clock is
+# kept inside its envelope by compiling at -O0 instead (below).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# tier-1 runs tiny models where XLA optimization buys nothing but compile
+# time (~1/3 of suite wall clock); correctness assertions (greedy token
+# equality, leader/follower bit-identity) compare within-run outputs, so
+# the pass-pipeline level does not affect them
+if "xla_backend_optimization_level" not in flags:
+    flags = (flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = flags
 
 # this image's axon TPU plugin prepends itself to jax_platforms regardless of
 # JAX_PLATFORMS; force the CPU backend explicitly for tests
@@ -44,19 +58,81 @@ if not os.path.exists(_native_so):
         pass  # no toolchain: tests run on the pure-Python indexer
 
 import asyncio
+import gc
 import inspect
+import warnings
 
 import pytest
 
 
 def pytest_pyfunc_call(pyfuncitem):
-    """Minimal async-test support (pytest-asyncio is not in the image)."""
+    """Minimal async-test support (pytest-asyncio is not in the image),
+    plus tier-1-wide leak detection: a test that exits with pending
+    asyncio tasks (something it started and never cancelled/awaited) or
+    that leaves never-awaited coroutines behind FAILS.  Leaked tasks are
+    how wedged-worker bugs hide — a canary loop or pull task that
+    outlives its test would be silently destroyed with the loop.
+
+    Tasks the test's own teardown already cancelled are given a few loop
+    cycles to retire before the check, so `task.cancel()` without an
+    await (the common close() idiom) does not false-positive.  A test
+    that legitimately abandons tasks can opt out with
+    `@pytest.mark.allow_task_leaks`."""
     fn = pyfuncitem.obj
-    if inspect.iscoroutinefunction(fn):
-        kwargs = {
-            name: pyfuncitem.funcargs[name]
-            for name in pyfuncitem._fixtureinfo.argnames
-        }
-        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
-        return True
-    return None
+    if not inspect.iscoroutinefunction(fn):
+        return None
+    kwargs = {
+        name: pyfuncitem.funcargs[name]
+        for name in pyfuncitem._fixtureinfo.argnames
+    }
+    leaked: list = []
+
+    async def runner():
+        me = asyncio.current_task()
+        try:
+            await asyncio.wait_for(fn(**kwargs), timeout=120)
+        finally:
+            # let tasks cancelled-but-not-reaped by the test's teardown
+            # retire before judging what is genuinely leaked; a short
+            # real-time grace covers teardown paths that need wall clock
+            # (aiohttp connection handlers after server cleanup, nested
+            # cancellation chains)
+            import time as _time
+
+            deadline = _time.monotonic() + 0.75
+            while _time.monotonic() < deadline:
+                await asyncio.sleep(0)
+                if all(t.done() for t in asyncio.all_tasks()
+                       if t is not me):
+                    break
+                await asyncio.sleep(0.02)
+            pending = [t for t in asyncio.all_tasks()
+                       if t is not me and not t.done()]
+            leaked.extend(pending)
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        asyncio.run(runner())
+        # never-awaited coroutines surface their RuntimeWarning when the
+        # object dies: refcounting catches the common case the moment the
+        # test's frames unwind, a young-generation pass catches the
+        # cycle-trapped rest.  (A FULL gc.collect() here would walk the
+        # whole JAX heap after every async test — tens of ms each, minutes
+        # across the suite.)
+        gc.collect(1)
+    if leaked and not pyfuncitem.get_closest_marker("allow_task_leaks"):
+        pytest.fail(
+            "test leaked pending asyncio tasks (start it, own it): "
+            + ", ".join(repr(t) for t in leaked[:8]), pytrace=False)
+    never_awaited = [w for w in caught
+                     if "was never awaited" in str(w.message)]
+    if never_awaited:
+        pytest.fail(
+            "test left never-awaited coroutines: "
+            + ", ".join(str(w.message) for w in never_awaited[:8]),
+            pytrace=False)
+    return True

@@ -17,6 +17,9 @@ from typing import Dict, List, Optional, Tuple
 
 from aiohttp import web
 
+# queue sentinel: close() wakes parked watch handlers with this
+_WATCH_CLOSED = object()
+
 
 def _unb64(s: str) -> bytes:
     return base64.b64decode(s)
@@ -67,6 +70,12 @@ class FakeEtcd:
         if self._expiry_task is not None:
             self._expiry_task.cancel()
             self._expiry_task = None
+        # wake every long-poll watch handler: a client that abandoned its
+        # watch leaves the handler parked on q.get() forever, and
+        # AppRunner.cleanup() does not cancel in-flight handlers — the
+        # conftest pending-task check would flag the leak
+        for _s, _e, q in list(self.watchers):
+            q.put_nowait(_WATCH_CLOSED)
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
@@ -186,6 +195,8 @@ class FakeEtcd:
                 {"result": {"created": True}}).encode() + b"\n")
             while True:
                 ev = await q.get()
+                if ev is _WATCH_CLOSED:
+                    break
                 await resp.write(json.dumps(
                     {"result": {"events": [ev]}}).encode() + b"\n")
         except (ConnectionResetError, asyncio.CancelledError):

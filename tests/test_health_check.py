@@ -166,3 +166,55 @@ async def test_system_status_health_reflects_canaries():
         await w.close()
     finally:
         await rt.shutdown()
+
+
+async def test_canary_recovery_retries_failed_lease_restore():
+    """Satellite (ISSUE 5): a transient discovery outage during
+    _reconcile_lease must be retried by the next probe and end with the
+    lease restored.  The restore's put fails once (injected); the stash
+    must survive the failed attempt (discovery.py restore_lease) and the
+    next canary's _maybe_reconcile must finish the job."""
+    from dynamo_tpu import chaos
+
+    rt = await fresh_runtime(canary_wait_s=0.1,
+                             request_timeout_s=0.3).start()
+    try:
+        args = MockEngineArgs(model_name="m", block_size=4,
+                              base_step_s=0.0005)
+        w = await MockerWorker(rt, args).start()
+        key = w.served.instance.key()
+
+        # wedge -> canary trips -> lease withdrawn
+        real_generate = w.engine.generate
+
+        async def hung_generate(request, token=None):
+            await asyncio.sleep(3600)
+            yield  # pragma: no cover
+
+        w.engine.generate = hung_generate
+        for _ in range(200):
+            if key not in await rt.discovery.get_prefix("v1/instances"):
+                break
+            await asyncio.sleep(0.05)
+        assert key not in await rt.discovery.get_prefix("v1/instances")
+
+        # recover the engine, but fail the FIRST restore put (transient
+        # discovery outage exactly during _reconcile_lease)
+        plane = chaos.ChaosPlane(seed=41).rule(
+            "discovery.op", "fail", match="put:", times=1,
+            error="injected discovery outage during restore")
+        w.engine.generate = real_generate
+        with plane:
+            for _ in range(300):
+                if (rt.system_health.healthy
+                        and key in await rt.discovery.get_prefix(
+                            "v1/instances")):
+                    break
+                await asyncio.sleep(0.05)
+        assert plane.fired() >= 1, "restore was never attempted"
+        assert rt.system_health.healthy
+        assert key in await rt.discovery.get_prefix("v1/instances"), \
+            "lease not restored after the transient outage"
+        await w.close()
+    finally:
+        await rt.shutdown()

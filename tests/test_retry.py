@@ -1,0 +1,144 @@
+"""Unified retry policy (runtime/retry.py): backoff shape, deadlines,
+cancellation-awareness — the single source of retry semantics adopted by
+migration, disagg pulls, KVBM remote pulls, and etcd lease ops."""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from dynamo_tpu.runtime.cancellation import CancellationToken
+from dynamo_tpu.runtime.retry import Backoff, RetryPolicy, call_with_retry
+
+
+def test_raw_delay_is_capped_exponential():
+    p = RetryPolicy(base_s=0.1, cap_s=1.0, multiplier=2.0, jitter=False)
+    assert p.raw_delay(1) == pytest.approx(0.1)
+    assert p.raw_delay(2) == pytest.approx(0.2)
+    assert p.raw_delay(3) == pytest.approx(0.4)
+    assert p.raw_delay(5) == pytest.approx(1.0)  # capped
+    assert p.raw_delay(50) == pytest.approx(1.0)
+
+
+def test_full_jitter_draws_within_envelope_and_is_seeded():
+    p = RetryPolicy(base_s=0.1, cap_s=1.0)
+    rng = random.Random(7)
+    draws = [p.delay(n, rng) for n in range(1, 6)]
+    for n, d in enumerate(draws, start=1):
+        assert 0.0 <= d <= p.raw_delay(n)
+    # seeded rng -> reproducible schedule (chaos runs depend on this)
+    rng2 = random.Random(7)
+    assert draws == [p.delay(n, rng2) for n in range(1, 6)]
+
+
+async def test_call_with_retry_recovers_after_transient_failures():
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    t0 = time.monotonic()
+    out = await call_with_retry(
+        fn, RetryPolicy(max_attempts=5, base_s=0.001, cap_s=0.002))
+    assert out == "ok"
+    assert len(calls) == 3
+    assert time.monotonic() - t0 < 1.0
+
+
+async def test_call_with_retry_exhausts_attempts():
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        raise ValueError("always")
+
+    with pytest.raises(ValueError):
+        await call_with_retry(
+            fn, RetryPolicy(max_attempts=3, base_s=0.001, cap_s=0.002))
+    assert len(calls) == 3  # max_attempts counts the first try
+
+
+async def test_call_with_retry_respects_retry_on_filter():
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        raise KeyError("not retryable")
+
+    with pytest.raises(KeyError):
+        await call_with_retry(
+            fn, RetryPolicy(max_attempts=5, base_s=0.001),
+            retry_on=(ValueError,))
+    assert len(calls) == 1
+
+
+async def test_call_with_retry_never_retries_cancellation():
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        raise asyncio.CancelledError()
+
+    with pytest.raises(asyncio.CancelledError):
+        await call_with_retry(
+            fn, RetryPolicy(max_attempts=5, base_s=0.001))
+    assert len(calls) == 1
+
+
+async def test_backoff_deadline_bounds_wall_clock():
+    p = RetryPolicy(max_attempts=1 << 20, base_s=0.01, cap_s=0.02,
+                    deadline_s=0.1)
+    bo = Backoff(p)
+    t0 = time.monotonic()
+    n = 0
+    while await bo.sleep():
+        n += 1
+        assert n < 1000, "deadline never tripped"
+    assert time.monotonic() - t0 < 1.0
+    assert n >= 1
+
+
+async def test_backoff_stopped_token_aborts_sleep_immediately():
+    p = RetryPolicy(max_attempts=10, base_s=5.0, cap_s=5.0, jitter=False)
+    bo = Backoff(p)
+    token = CancellationToken()
+    token.stop()
+    t0 = time.monotonic()
+    assert await bo.sleep(token=token) is False
+    assert time.monotonic() - t0 < 1.0
+    token.detach()
+
+
+async def test_backoff_token_stop_mid_sleep_wakes_early():
+    p = RetryPolicy(max_attempts=10, base_s=5.0, cap_s=5.0, jitter=False)
+    bo = Backoff(p)
+    token = CancellationToken()
+
+    async def stopper():
+        await asyncio.sleep(0.05)
+        token.stop()
+
+    task = asyncio.create_task(stopper())
+    t0 = time.monotonic()
+    assert await bo.sleep(token=token) is False
+    assert time.monotonic() - t0 < 2.0  # not the 5s backoff
+    await task
+    token.detach()
+
+
+async def test_on_retry_sees_attempt_and_error():
+    seen = []
+
+    async def fn():
+        raise RuntimeError("x")
+
+    with pytest.raises(RuntimeError):
+        await call_with_retry(
+            fn, RetryPolicy(max_attempts=3, base_s=0.001),
+            on_retry=lambda n, e: seen.append((n, str(e))))
+    assert [n for n, _ in seen] == [1, 2, 3]
+    assert all(m == "x" for _, m in seen)

@@ -242,6 +242,7 @@ async def test_kv_router_event_gap_recovery():
     assert m == {7: 10}
 
     await router.close()
+    await gen_client.close()
     await rt.shutdown()
 
 
@@ -281,6 +282,7 @@ async def test_kv_router_late_join_full_replay():
     assert router.indexer.find_matches(hs) == {9: 8}
 
     await router.close()
+    await gen_client.close()
     await rt.shutdown()
 
 
