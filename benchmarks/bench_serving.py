@@ -114,7 +114,19 @@ async def main():
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--slo-ttft", type=float, default=2.0)
     p.add_argument("--slo-itl", type=float, default=0.025)
+    p.add_argument("--trace-out", default="",
+                   help="record the run's timeline spans (obs/) and dump "
+                        "a Perfetto-loadable Chrome trace here; also "
+                        "prints the obs.report gap-attribution line")
     args = p.parse_args()
+
+    tracer = None
+    if args.trace_out:
+        from dynamo_tpu import obs
+
+        tracer = obs.Tracer(service="bench_serving",
+                            ring=4 * obs.DEFAULT_RING,
+                            out_path=args.trace_out).install()
 
     rows = synthesize(args.requests, rate_rps=args.rate,
                       input_len=args.input_len, output_len=args.output_len,
@@ -131,6 +143,19 @@ async def main():
                   f"{max(1, args.workers // 2)}d",
         **dis.summary(args.slo_ttft, args.slo_itl),
     }))
+
+    if tracer is not None:
+        from dynamo_tpu.obs.report import report_paths
+
+        path = tracer.dump()
+        tracer.uninstall()
+        if path is None:
+            print(json.dumps({"config": "trace",
+                              "error": f"trace dump to "
+                                       f"{args.trace_out!r} failed"}))
+        else:
+            print(json.dumps({"config": "trace", "trace_out": path,
+                              **report_paths([path])["gap"]}))
 
 
 if __name__ == "__main__":

@@ -208,6 +208,18 @@ class ChaosPlane:
 _PLANE: Optional[ChaosPlane] = None
 
 
+def _flight_dump(seam: str) -> None:
+    """An injection fired: snapshot the timeline tracer's ring (obs/)
+    so the post-mortem has the spans that led up to the fault.  No-op
+    when tracing is off; never lets observability break an injection."""
+    try:
+        from .. import obs
+
+        obs.flight_dump(f"chaos.{seam}")
+    except Exception:  # pragma: no cover
+        logger.warning("chaos flight dump failed", exc_info=True)
+
+
 def active() -> Optional[ChaosPlane]:
     return _PLANE
 
@@ -222,6 +234,7 @@ def hit(seam: str, key: Optional[str] = None) -> Optional[str]:
     r = _PLANE.decide(seam, key)
     if r is None:
         return None
+    _flight_dump(seam)
     if r.action in ("fail", "truncate"):
         raise ChaosError(r.message())
     if r.action == "delay":
@@ -239,6 +252,7 @@ async def ahit(seam: str, key: Optional[str] = None) -> Optional[str]:
     r = _PLANE.decide(seam, key)
     if r is None:
         return None
+    _flight_dump(seam)
     if r.action in ("fail", "truncate"):
         raise ChaosError(r.message())
     if r.action == "delay":

@@ -53,6 +53,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import obs
+
 try:
     import ml_dtypes  # jax dependency; provides numpy bfloat16
     _BF16 = np.dtype(ml_dtypes.bfloat16)
@@ -267,16 +269,21 @@ class RequestPlanePullSource(PullSource):
         return out
 
     async def open(self) -> Dict[str, Any]:
-        header = await self._call({"op": "open",
-                                   "request_id": self.params["request_id"]})
+        with obs.span("disagg_open",
+                      request_id=self.params["request_id"]):
+            header = await self._call(
+                {"op": "open", "request_id": self.params["request_id"]})
         self.layout = KvLayout.from_dict(header["layout"])
         return header
 
     async def chunk(self, b0: int, n: int):
-        frame = await self._call({
-            "op": "chunk", "request_id": self.params["request_id"],
-            "start": int(b0), "count": int(n),
-        })
+        with obs.span("disagg_chunk",
+                      request_id=self.params["request_id"],
+                      start=int(b0), count=int(n)):
+            frame = await self._call({
+                "op": "chunk", "request_id": self.params["request_id"],
+                "start": int(b0), "count": int(n),
+            })
         out = decode_chunk_frame(frame, self.layout)
         fb0, fn, arrs = out[0], out[1], out[2:]
         if fb0 != b0 or fn != n:

@@ -17,6 +17,7 @@ if os.environ.get("DYN_JAX_PLATFORM"):
 
     jax.config.update("jax_platforms", os.environ["DYN_JAX_PLATFORM"])
 
+from .. import obs
 from ..runtime import DistributedRuntime
 from ..runtime.logging import setup_logging
 from .config import EngineConfig
@@ -101,6 +102,9 @@ def build_args() -> argparse.ArgumentParser:
 
 async def main() -> None:
     setup_logging()
+    # timeline tracing (obs/): DYN_TRACE=1 installs the process
+    # tracer; DYN_TRACE_OUT gets a Chrome trace dump at exit
+    obs.install_from_env()
     args = build_args().parse_args()
     config = EngineConfig(
         model=args.model,

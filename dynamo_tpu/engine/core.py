@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .. import chaos
+from .. import chaos, obs
 from ..models import get_family
 from ..parallel.mesh import MeshConfig, make_mesh, shard_params
 from ..protocols import (
@@ -459,6 +459,12 @@ class JaxEngine:
         # The worker drains this ring onto the event plane; the SLA
         # planner regresses its perf model on it online.
         self.fpm: deque = deque(maxlen=4096)
+        # timeline tracing (obs/): steps run on whatever pool thread
+        # asyncio.to_thread picked, but the step lock serializes them —
+        # pin every step-phase span to ONE logical track per engine so
+        # the report's innermost-span attribution sees a well-nested
+        # timeline (co-resident engines in one process stay distinct)
+        self._obs_track = f"sched:{id(self):x}"
         self._fpm_last_decode_t = 0.0
         self._fpm_last_prefill_t = 0.0
         # time of the last BLOCKING device fetch (np.asarray round trip):
@@ -927,6 +933,9 @@ class JaxEngine:
         each request (token-replay migration) on a surviving worker
         with no client-visible failure."""
         self.draining = True
+        # flight recorder: the last N spans are the timeline that led to
+        # the abort — dump them before the streams are torn down
+        obs.flight_dump("drain_abort")
         self._fail_all_streams(error=DRAIN_ABORT)
         self._wake.set()
 
@@ -1452,6 +1461,7 @@ class JaxEngine:
             pass
         except Exception:
             logger.exception("engine loop crashed")
+            obs.flight_dump("engine_crash")
             self._fail_all_streams()
             raise
 
@@ -1478,9 +1488,16 @@ class JaxEngine:
             # migratable worker-engine-error marker; a wedge is caught
             # by the canary (health_check.py)
             chaos.hit("engine.step", key=self.config.served_name)
+            # timeline spans (obs/): one `step` covering the iteration,
+            # `sched` over the host-only scheduling work; the dispatch
+            # phases emit their own spans inside.  Each is one
+            # module-global None check when tracing is off.
+            t_step = obs.begin()
+            t = obs.begin()
             self._process_cancellations()
             self._maybe_offload()
             self._admit_waiting()
+            obs.end("sched", t, track=self._obs_track)
             self._prefill_step()
             self._guided_step()
             self._spec_step()
@@ -1490,6 +1507,11 @@ class JaxEngine:
                 # no dispatchable decode work: flush the pipeline tail so
                 # trailing tokens/finishes are delivered promptly
                 self._drain_inflight()
+            if t_step:  # attrs are only worth computing when tracing
+                obs.end("step", t_step, track=self._obs_track,
+                        active=sum(1 for s in self._slots
+                                   if s is not None),
+                        waiting=len(self.waiting))
 
     # -- distributed KVBM (kvbm/remote.py) ---------------------------------
     async def _remote_prefetch(self, request: PreprocessedRequest) -> None:
@@ -1568,11 +1590,15 @@ class JaxEngine:
         )
         if not cands:
             return
+        t_obs = obs.begin()
         ids = _pow2_ids([bid for _, bid in cands])
         if self.step_sink is not None:
             self.step_sink("gather", {"ids": ids})
+        t_d = obs.begin()
         arrs = [np.asarray(a)
                 for a in self._jit_gather(self.kv, jnp.asarray(ids))]
+        obs.end("device_wait", t_d, track=self._obs_track,
+                what="offload_gather")
         for i, (h, _) in enumerate(cands):
             # contiguous copies: a [:, i] view would pin the whole gathered
             # batch buffer in host RAM for as long as any one block lives.
@@ -1580,6 +1606,8 @@ class JaxEngine:
             # half the host-tier bytes, scales bit-exact (kvbm/pools.py)
             self._emit_tier_events(self.kvbm.offload(
                 h, *(np.ascontiguousarray(a[:, i]) for a in arrs)))
+        obs.end("kvbm_offload", t_obs, track=self._obs_track,
+                blocks=len(cands))
 
     def _try_onboard(self, slot: _Slot, hit: int, cap_blocks: int) -> int:
         """Extend a G1 prefix hit with blocks onboarded from G2/G3: scatter
@@ -1591,6 +1619,7 @@ class JaxEngine:
         run = self.kvbm.match_run(hashes[hit:cap_blocks])
         if run == 0:
             return 0
+        t_obs = obs.begin()
         block_ids = self.allocator.seq_block_ids(self._seq_id(slot))
         arity = len(self.kv)
         comps: List[list] = [[] for _ in range(arity)]
@@ -1632,6 +1661,7 @@ class JaxEngine:
             self.kv, *(jnp.asarray(a) for a in stacked[:2]),
             jnp.asarray(ids_arr), *(jnp.asarray(a) for a in stacked[2:])
         )
+        obs.end("kvbm_onboard", t_obs, track=self._obs_track, blocks=n)
         return n
 
     # -- prefill ----------------------------------------------------------
@@ -1719,6 +1749,17 @@ class JaxEngine:
         )[: self.config.max_prefill_seqs]
         if not pslots:
             return
+        t_obs = obs.begin()
+        try:
+            self._prefill_dispatch(pslots)
+        finally:
+            obs.end("prefill_dispatch", t_obs, track=self._obs_track,
+                    rows=len(pslots))
+
+    def _prefill_dispatch(self, pslots) -> None:
+        """Route this step's prefilling slots to one program (see
+        _prefill_step; split out so the dispatch span covers every
+        path)."""
         c = self.config
         self.metrics["prefill_steps"] = \
             self.metrics.get("prefill_steps", 0) + 1
@@ -1807,7 +1848,10 @@ class JaxEngine:
         firsts = None
         if any(s.prefill_pos + ch >= s.prompt_len
                for s, ch in zip(pslots, chunks)):
+            t_obs = obs.begin()
             firsts = np.asarray(tok)
+            obs.end("device_wait", t_obs, track=self._obs_track,
+                    what="prefill_first")
             self._fpm_sync_t = time.monotonic()
         for i, (slot, chunk) in enumerate(zip(pslots, chunks)):
             self._finish_prefill_chunk(
@@ -1911,7 +1955,10 @@ class JaxEngine:
         firsts = None
         if any(s.prefill_pos + ch >= s.prompt_len
                for s, ch in zip(plan.slots, plan.chunks)):
+            t_obs = obs.begin()
             firsts = np.asarray(tok)
+            obs.end("device_wait", t_obs, track=self._obs_track,
+                    what="prefill_first")
             self._fpm_sync_t = time.monotonic()
         for i, (slot, chunk) in enumerate(zip(plan.slots, plan.chunks)):
             self._finish_prefill_chunk(
@@ -1977,7 +2024,10 @@ class JaxEngine:
         # blocking token fetch only on the completing chunk (see
         # _prefill_step: intermediate chunks discard the sample)
         if pos + chunk >= slot.prompt_len:
+            t_obs = obs.begin()
             first = int(np.asarray(tok))
+            obs.end("device_wait", t_obs, track=self._obs_track,
+                    what="prefill_first")
             self._fpm_sync_t = time.monotonic()
         else:
             first = -1
@@ -2052,6 +2102,9 @@ class JaxEngine:
         src = None
         t0 = time.monotonic()
         rid = slot.request.request_id
+        t_obs = obs.begin()
+        tid_obs = (obs.trace_id_from_annotations(slot.request.annotations)
+                   if t_obs else None)
 
         async def pull_chunk(b0: int, n: int):
             # unified retry (runtime/retry.py): a transiently failing
@@ -2173,6 +2226,7 @@ class JaxEngine:
                 pass
             self._wake.set()
         finally:
+            obs.end("kv_pull", t_obs, request_id=rid, trace_id=tid_obs)
             if src is not None:
                 try:
                     await src.close()
@@ -2417,12 +2471,16 @@ class JaxEngine:
             jnp.asarray(a["seg_ids"]), jnp.asarray(a["tables"]),
             jnp.asarray(a["valid"]), jnp.asarray(a["temps_t"]),
         )
+        t_obs = obs.begin()
         ids = np.asarray(ids)
         vals = np.asarray(vals)
         lse = np.asarray(lse)
+        obs.end("device_wait", t_obs, track=self._obs_track,
+                what="spec_verify_fetch")
         self._fpm_sync_t = time.monotonic()
         from .sampler import spec_accept_tokens
 
+        t_obs = obs.begin()
         proposed_total = accepted_total = 0
         specced = set()
         for (s, drafts), off in zip(plan.rows, plan.offsets):
@@ -2463,6 +2521,8 @@ class JaxEngine:
             s.draft_pos = min(s.ctx_len, ctx0 + len(drafts))
             if not s.finished:
                 self._spec_trim(s)
+        obs.end("sample", t_obs, track=self._obs_track,
+                what="spec_accept", lanes=len(plan.rows))
         self._specced = frozenset(specced)
         self.metrics["spec_steps"] = self.metrics.get("spec_steps", 0) + 1
         self.metrics["spec_proposed"] = \
@@ -2568,6 +2628,7 @@ class JaxEngine:
     def _decode_step(self) -> None:
         c = self.config
         B = c.max_num_seqs
+        t_obs = obs.begin()
         # pipeline: keep at most depth-1 unread bursts after this dispatch;
         # processing the oldest here overlaps its (already-complete or
         # nearly-complete) fetch with the device compute of newer bursts
@@ -2682,7 +2743,8 @@ class JaxEngine:
             for s in active:
                 lidx[s.index] = s.lora_idx
             a["lidx"] = lidx
-        if self._is_continuation(a, active, k):
+        cont_burst = self._is_continuation(a, active, k)
+        if cont_burst:
             # steady state: nothing changed but the clock — advance the
             # device-resident descriptor in-program, upload nothing
             prev = self._last_desc
@@ -2720,6 +2782,8 @@ class JaxEngine:
             lanes[s.index] = (self._seq_id(s), s.epoch)
             self._chain_owner[s.index] = lanes[s.index]
         self._inflight.append({"burst": burst, "k": k, "lanes": lanes})
+        obs.end("decode_dispatch", t_obs, track=self._obs_track,
+                cont=cont_burst, k=k, lanes=len(active))
 
     GUIDED_TOPM = 32
     GUIDED_TOPM_WIDE = 256
@@ -2796,6 +2860,7 @@ class JaxEngine:
             )
         codec = self._guided_codec()
         B = c.max_num_seqs
+        t_obs = obs.begin()
         for slot in gslots:
             # block for the next position (no burst speculation needed)
             nblocks = int(np.count_nonzero(slot.block_table))
@@ -2851,7 +2916,11 @@ class JaxEngine:
                         return ("tok", tok)
                 return None
 
-            chosen = choose(np.asarray(ids[i]), np.asarray(vals[i]))
+            t_d = obs.begin()
+            cand_ids, cand_vals = np.asarray(ids[i]), np.asarray(vals[i])
+            obs.end("device_wait", t_d, track=self._obs_track,
+                    what="guided_fetch")
+            chosen = choose(cand_ids, cand_vals)
             if chosen is None:
                 # nothing in the top-M set extends the document: retry
                 # once with a widened candidate set before giving up —
@@ -2886,6 +2955,8 @@ class JaxEngine:
                 # the token budget — close canonically (a few tokens
                 # over) instead of emitting truncated invalid JSON
                 self._guided_finish(slot, codec, forced=True)
+        obs.end("sample", t_obs, track=self._obs_track, what="guided",
+                lanes=len(gslots))
 
     def _guided_emit(self, slot: _Slot, tok: int,
                      finish: Optional[str]) -> None:
@@ -3077,7 +3148,10 @@ class JaxEngine:
         finish, or to since-freed blocks that device program order
         guarantees were overwritten only by later dispatches)."""
         e = self._inflight.popleft()
+        t_obs = obs.begin()
         arr = np.asarray(e["burst"])  # [k, B]
+        obs.end("device_wait", t_obs, track=self._obs_track, k=e["k"],
+                what="burst_fetch")
         self._fpm_sync_t = time.monotonic()
         for i, ident in e["lanes"].items():
             s = self._slots[i] if i < len(self._slots) else None

@@ -17,6 +17,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from .. import obs
 from ..protocols import LLMEngineOutput, ModelDeploymentCard, PreprocessedRequest
 from ..protocols.model_card import deregister_model, register_model
 from ..router.events import KvEventPublisher
@@ -129,6 +130,9 @@ class JaxEngineWorker:
                    else {}),
                 **({"reasoning_parser": self.config.reasoning_parser}
                    if self.config.reasoning_parser else {}),
+                # timeline tracing capability (obs/): planners/routers
+                # can see which workers will emit spans for a trace_id
+                **({"tracing": True} if obs.enabled() else {}),
             },
         )
 
@@ -251,9 +255,17 @@ class JaxEngineWorker:
         async def generate_handler(payload, ctx):
             request = PreprocessedRequest.from_dict(payload)
             ntok = 0
+            # worker-side request span: stitches to the frontend's
+            # `request` span and request_end record via the propagated
+            # trace_id (obs cross-process stitching)
+            t_obs = obs.begin()
             async for out in self.engine.generate(request, token=ctx.token):
                 ntok += len(out.token_ids)
                 yield out.to_dict()
+            obs.end("worker_request", t_obs,
+                    trace_id=obs.trace_id_from_annotations(
+                        request.annotations) if t_obs else None,
+                    request_id=request.request_id, tokens=ntok)
             # trace join: the frontend's traceparent annotation makes this
             # worker's structured log line greppable by trace_id
             tp = next((a.split(":", 1)[1] for a in request.annotations
@@ -523,6 +535,18 @@ class JaxEngineWorker:
         # local /metrics surface (system-status server): queue depth,
         # active sequences, KV pressure per worker
         m = self.runtime.metrics.scoped(component=self.component)
+        tr = obs.tracer()
+        if tr is not None:
+            # per-span-kind duration histograms on this worker's
+            # /metrics, next to the engine gauges
+            tr.bind_metrics(m)
+        # local FPM aggregation: the same derivations the planner's
+        # FpmObserver runs fleet-wide, fed from this worker's own ring
+        # BEFORE it ships — so a bare `/metrics` scrape sees the
+        # headline engine numbers without a planner in the deployment
+        from ..planner.metrics import FpmWindow
+
+        fw = FpmWindow()
         while True:
             await asyncio.sleep(0.5)
             if self.engine is None or self.served is None:
@@ -533,6 +557,19 @@ class JaxEngineWorker:
             steps = []
             while self.engine.fpm and len(steps) < 512:
                 steps.append(self.engine.fpm.popleft())
+            for rec in steps:
+                fw.add(self.served.instance_id, rec)
+            m.set("dynamo_engine_prefill_mfu",
+                  fw.prefill_mfu(self.config.peak_tflops))
+            m.set("dynamo_engine_prefill_queue_depth",
+                  fw.prefill_queue_depth())
+            m.set("dynamo_engine_prefill_tokens_per_s",
+                  fw.prefill_tokens_per_s())
+            m.set("dynamo_engine_decode_tokens_per_s",
+                  fw.decode_tokens_per_s())
+            acc = fw.spec_acceptance()
+            if acc is not None:
+                m.set("dynamo_engine_spec_acceptance", acc)
             if steps:
                 try:
                     await self.runtime.event_plane.publish(fpm_subject, {

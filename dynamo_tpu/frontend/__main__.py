@@ -5,6 +5,7 @@ import argparse
 import asyncio
 import os
 
+from .. import obs
 from ..runtime import DistributedRuntime, RouterMode
 from ..runtime.logging import setup_logging
 from .service import HttpService, ModelManager, ModelWatcher
@@ -38,6 +39,9 @@ def build_args() -> argparse.ArgumentParser:
 
 async def main() -> None:
     setup_logging()
+    # timeline tracing (obs/): DYN_TRACE=1 installs the process
+    # tracer; DYN_TRACE_OUT gets a Chrome trace dump at exit
+    obs.install_from_env()
     args = build_args().parse_args()
     rt = await DistributedRuntime.detached().start()
     manager = ModelManager()

@@ -249,9 +249,9 @@ class AnthropicRoutes:
             request.headers, req.request_id, model, svc.trace_sink,
             session_id=req.session_id, endpoint="anthropic_messages",
             input_tokens=len(req.token_ids))
-        tp = tracker.traceparent()
-        if tp is not None and svc.trace_sink.config.enabled:
-            req.annotations = list(req.annotations) + [f"traceparent:{tp}"]
+        from .. import obs
+
+        tracker.propagate(req)
         # Same output-parser composition the OpenAI routes run:
         # Anthropic clients must see tool_use blocks / stop_reason
         # "tool_use", never raw <tool_call> text.
@@ -262,6 +262,7 @@ class AnthropicRoutes:
         svc._inflight_delta(+1)
         svc._m_requests.inc("dynamo_frontend_requests_total", model=model)
         t0 = time.monotonic()
+        t_obs = obs.begin()
         try:
             if body.get("stream"):
                 return await self._stream(request, pipeline, req, model,
@@ -269,6 +270,8 @@ class AnthropicRoutes:
             return await self._unary(pipeline, req, model, stops, token,
                                      tracker, parser)
         finally:
+            obs.end("request", t_obs, trace_id=tracker.trace_id,
+                    request_id=req.request_id, model=model)
             svc._inflight_delta(-1)
             svc._m_requests.observe(
                 "dynamo_frontend_request_duration_seconds",

@@ -14,6 +14,7 @@ import os
 from dataclasses import dataclass, replace
 from typing import Any, AsyncIterator, Dict, Optional
 
+from .. import obs
 from ..protocols import LLMEngineOutput, ModelDeploymentCard, PreprocessedRequest
 from ..runtime import CancellationToken, Client, EngineError
 from ..runtime.aio import StreamIdleTimeout, iter_with_idle_timeout
@@ -146,6 +147,9 @@ class MigrationOperator:
                     if attempts >= self.migration_limit or not is_migratable(e):
                         raise
                     attempts += 1
+                    # flight recorder: the ring holds the timeline that
+                    # led to this worker failure — dump before replaying
+                    obs.flight_dump("migration")
                     if instance_id is not None:
                         avoid.add(instance_id)
                     elif picked:
@@ -225,7 +229,9 @@ class ModelPipeline:
         pending = ""  # holdback buffer for partial stop-string matches
         async for out in self.migration.generate(request, token=token,
                                                  tracker=tracker):
+            t_obs = obs.begin()
             delta = detok.push(out.token_ids)
+            obs.end("detok", t_obs, tokens=len(out.token_ids))
             finish = out.finish_reason
             if stops:
                 pending += delta

@@ -147,6 +147,8 @@ class RequestTracker:
     finish_reason: Optional[str] = None
     error: Optional[str] = None
     tool_call_names: List[str] = field(default_factory=list)
+    _dispatches: int = 0
+    _finished: bool = False
 
     @staticmethod
     def from_headers(headers, request_id: str, model: str,
@@ -160,9 +162,13 @@ class RequestTracker:
     # -- hooks along the pipeline ----------------------------------------
     def on_dispatch(self, instance_id: Optional[int]) -> None:
         """Called per dispatch attempt (MigrationOperator): the last one
-        wins as the decode worker; earlier ones count as migrations."""
-        if self.decode_worker_id is not None:
-            self.migrations += 1
+        wins as the decode worker; every attempt after the first counts
+        as a migration.  Counted from an explicit attempt counter, NOT
+        by comparing instance ids: a token-replay that lands back on the
+        SAME instance (avoid set relaxed because it excluded every live
+        worker) is still a migration the record must show."""
+        self._dispatches += 1
+        self.migrations = self._dispatches - 1
         self.decode_worker_id = instance_id
 
     def on_prefill_worker(self, instance_id: int) -> None:
@@ -189,9 +195,38 @@ class RequestTracker:
             return None
         return f"00-{self.trace_id}-{self.span_id}-01"
 
+    def propagate(self, req) -> None:
+        """Shared frontend-route hook (OpenAI + Anthropic surfaces):
+        with timeline tracing on (obs/) and no inbound `traceparent`,
+        mint a trace_id so this request's record, its frontend span,
+        and every worker span still stitch into one trace; then ride
+        the outgoing traceparent on the request annotations when either
+        tracing plane wants it — and only then, or a service mesh
+        injecting traceparent everywhere would flood worker logs."""
+        from .. import obs
+
+        if self.trace_id is None and obs.enabled():
+            self.trace_id = secrets.token_hex(16)
+        tp = self.traceparent()
+        sink_on = self.sink is not None and self.sink.config.enabled
+        if tp is not None and (sink_on or obs.enabled()):
+            req.annotations = list(req.annotations) + [f"traceparent:{tp}"]
+
     # -- record ----------------------------------------------------------
     def finish(self, finish_reason: Optional[str] = None,
                error: Optional[str] = None) -> Dict[str, Any]:
+        """Emit the request_end record — exactly once.
+
+        Called on EVERY terminal path, not only clean finishes: client
+        abort ("client_disconnected"), migration budget exhausted and
+        drain-abort (the EngineError text, which carries the worker's
+        failure marker), encoder/preprocess failures.  Error paths can
+        race a clean finish (a stream teardown exception after the
+        success record already emitted), so a second call returns the
+        first record instead of double-counting the request."""
+        if self._finished:
+            return self._record
+        self._finished = True
         now = time.monotonic()
         total_ms = (now - self._t0) * 1000.0
         ttft_ms = ((self._first_token_t - self._t0) * 1000.0
@@ -258,6 +293,7 @@ class RequestTracker:
             }
         if self.session_id:
             record["agent_context"] = {"session_id": self.session_id}
+        self._record = record
         if self.sink is not None:
             self.sink.emit(record)
         return record

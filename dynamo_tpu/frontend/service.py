@@ -17,6 +17,7 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from .. import obs
 from ..protocols import ModelDeploymentCard
 from ..runtime import (
     CancellationToken,
@@ -574,12 +575,9 @@ class HttpService:
             session_id=req.session_id,
             endpoint="chat" if chat else "completions",
             input_tokens=len(req.token_ids))
-        tp = tracker.traceparent()
-        if tp is not None and self.trace_sink.config.enabled:
-            # ride annotations so worker logs join the same trace_id —
-            # only when tracing is on, or a service mesh injecting
-            # traceparent everywhere would flood worker logs
-            req.annotations = list(req.annotations) + [f"traceparent:{tp}"]
+        # mint/propagate the trace context (request_trace.propagate):
+        # worker logs and timeline spans join the same trace_id
+        tracker.propagate(req)
         if req.multimodal and pipeline.encoder is not None:
             # encode here (not inside the pipeline) so usage accounting
             # and conditional disagg see the spliced placeholder tokens
@@ -615,6 +613,7 @@ class HttpService:
         self._inflight_delta(+1)
         self._m_requests.inc("dynamo_frontend_requests_total", model=model)
         t0 = time.monotonic()
+        t_obs = obs.begin()
         try:
             if body.get("stream"):
                 return await self._stream_response(
@@ -625,6 +624,8 @@ class HttpService:
                                               model, parser=parser,
                                               tracker=tracker)
         finally:
+            obs.end("request", t_obs, trace_id=tracker.trace_id,
+                    request_id=req.request_id, model=model)
             self._inflight_delta(-1)
             self._m_requests.observe(
                 "dynamo_frontend_request_duration_seconds",
@@ -853,9 +854,12 @@ class HttpService:
                 if text or reasoning or calls or finish or first:
                     if calls and tracker is not None:
                         tracker.add_tool_calls(calls)
+                    t_obs = obs.begin()
                     await resp.write(chunk(text, finish, first,
                                            reasoning=reasoning,
                                            tool_calls=calls))
+                    obs.end("frame_egress", t_obs,
+                            tokens=d.token_count)
                     first = False
                 if d.finish_reason:
                     final_finish = finish or d.finish_reason

@@ -8,6 +8,7 @@ import argparse
 import asyncio
 import logging
 
+from .. import obs
 from ..runtime import DistributedRuntime
 from ..runtime.logging import setup_logging
 from .engine import MockEngineArgs
@@ -66,6 +67,9 @@ def build_args() -> argparse.ArgumentParser:
 
 async def main() -> None:
     setup_logging()
+    # timeline tracing (obs/): DYN_TRACE=1 installs the process
+    # tracer; DYN_TRACE_OUT gets a Chrome trace dump at exit
+    obs.install_from_env()
     args = build_args().parse_args()
     engine_args = MockEngineArgs(
         model_name=args.model_name,

@@ -19,7 +19,7 @@ import zlib
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Dict, List, Optional
 
-from .. import chaos
+from .. import chaos, obs
 from ..protocols import (
     DRAIN_ABORT,
     DRAIN_REJECT,
@@ -152,6 +152,11 @@ class MockEngine:
         from collections import deque
 
         self.fpm: deque = deque(maxlen=4096)
+        # timeline tracing (obs/): the same span kinds the JAX engine
+        # emits, from the simulated step loop — router/planner/chaos
+        # tests exercise the whole timeline plane CPU-only.  One logical
+        # track per engine (several mockers share one event loop).
+        self._obs_track = f"sched:{id(self):x}"
 
     # -- public API -------------------------------------------------------
     def start(self) -> None:
@@ -273,6 +278,8 @@ class MockEngine:
         migratable "worker draining" marker so the frontend replays each
         request on a surviving worker with no client-visible failure."""
         self.draining = True
+        # flight recorder: same post-mortem tie-in as the JAX engine
+        obs.flight_dump("drain_abort")
         self._fail_all_streams(DRAIN_ABORT)
 
     def _die(self) -> None:
@@ -354,7 +361,13 @@ class MockEngine:
         # same seam name as JaxEngine._sched_step, so one chaos rule
         # drives either engine
         await chaos.ahit("engine.step", key=self.args.model_name)
+        # timeline spans: same kinds (and zero-cost-off None check) as
+        # JaxEngine._sched_step, so obs.report decomposes a mocker run
+        # with the same phase taxonomy
+        t_step = obs.begin()
+        t_obs = obs.begin()
         self._try_admit()
+        obs.end("sched", t_obs, track=self._obs_track)
         if not self.running:
             await asyncio.sleep(0)  # let admissions catch up
             return
@@ -363,6 +376,7 @@ class MockEngine:
         prefill_tokens = 0
         decode_seqs: List[_Seq] = []
 
+        t_obs = obs.begin()
         for seq in list(self.running):
             remaining_prefill = seq.num_prompt_tokens - seq.prefill_pos
             if remaining_prefill > 0:
@@ -378,6 +392,9 @@ class MockEngine:
                 budget -= chunk
             else:
                 decode_seqs.append(seq)
+        if prefill_tokens:
+            obs.end("prefill_dispatch", t_obs, track=self._obs_track,
+                    tokens=prefill_tokens)
 
         # simulated step latency
         step_s = (
@@ -385,7 +402,11 @@ class MockEngine:
             + prefill_tokens * self.args.prefill_s_per_token
             + len(decode_seqs) * self.args.decode_s_per_seq
         ) / max(self.args.speedup_ratio, 1e-6)
+        # the sleep IS the simulated device step: device_wait by kind
+        t_obs = obs.begin()
         await asyncio.sleep(step_s)
+        obs.end("device_wait", t_obs, track=self._obs_track,
+                what="sim_step")
 
         self.metrics["steps"] += 1
         self.metrics["prefill_tokens"] += prefill_tokens
@@ -394,6 +415,7 @@ class MockEngine:
             self.itl_ema_s = step_s if self.itl_ema_s == 0.0 \
                 else 0.9 * self.itl_ema_s + 0.1 * step_s
 
+        t_obs = obs.begin()
         for seq in decode_seqs:
             if seq.finished or seq not in self.running:
                 # finished while this step slept: drain_abort()/_die()/
@@ -486,6 +508,11 @@ class MockEngine:
                     res = self.cache.free(seq.request_id)
                     self._publish(res)
                     break
+        if decode_seqs:
+            obs.end("decode_dispatch", t_obs, track=self._obs_track,
+                    cont=False, k=1, lanes=len(decode_seqs))
+        obs.end("step", t_step, track=self._obs_track,
+                active=len(self.running), waiting=len(self.waiting))
 
     def _next_token(self, seq: _Seq) -> int:
         canned = self.args.canned_text

@@ -12,6 +12,7 @@ import asyncio
 import logging
 from typing import Optional
 
+from .. import obs
 from ..protocols import LLMEngineOutput, ModelDeploymentCard, PreprocessedRequest
 from ..protocols.model_card import register_model
 from ..router.events import KvEventPublisher
@@ -66,6 +67,8 @@ class MockerWorker:
                    if self.args.speculative is not None else {}),
                 **({"reasoning_parser": self.reasoning_parser}
                    if self.reasoning_parser else {}),
+                # same tracing-capability advertisement as the JAX worker
+                **({"tracing": True} if obs.enabled() else {}),
             },
         )
 
@@ -96,9 +99,17 @@ class MockerWorker:
             request = PreprocessedRequest.from_dict(payload)
             eng = self.engines[request.dp_rank % len(self.engines)]
             ntok = 0
+            # worker-side request span (same stitching contract as the
+            # JAX engine worker: trace_id from the propagated
+            # traceparent annotation)
+            t_obs = obs.begin()
             async for out in eng.generate(request, token=ctx.token):
                 ntok += len(out.token_ids)
                 yield out.to_dict()
+            obs.end("worker_request", t_obs,
+                    trace_id=obs.trace_id_from_annotations(
+                        request.annotations) if t_obs else None,
+                    request_id=request.request_id, tokens=ntok)
             # trace join (same contract as the JAX engine worker)
             tp = next((a.split(":", 1)[1] for a in request.annotations
                        if a.startswith("traceparent:")), None)
@@ -163,6 +174,15 @@ class MockerWorker:
         """Periodic load metrics for least-loaded / KV routing cost inputs."""
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"
         fpm_subject = f"fpm.{self.namespace}.{self.component}"
+        m = self.runtime.metrics.scoped(component=self.component)
+        tr = obs.tracer()
+        if tr is not None:
+            tr.bind_metrics(m)
+        # local FPM aggregation mirrors the JAX worker: /metrics scrapes
+        # see spec acceptance etc. without a planner attached
+        from ..planner.metrics import FpmWindow
+
+        fw = FpmWindow()
         while True:
             await asyncio.sleep(0.25)
             if self.engine is None or self.served is None:
@@ -174,6 +194,11 @@ class MockerWorker:
             for eng in self.engines:
                 while eng.fpm and len(steps) < 512:
                     steps.append(eng.fpm.popleft())
+            for rec in steps:
+                fw.add(self.served.instance_id, rec)
+            acc = fw.spec_acceptance()
+            if acc is not None:
+                m.set("dynamo_engine_spec_acceptance", acc)
             if steps:
                 try:
                     await self.runtime.event_plane.publish(fpm_subject, {

@@ -1,0 +1,200 @@
+"""Gap-attribution report: reduce a Chrome trace dump to the numbers
+ROADMAP item 3 (overlapped scheduling) is scored on.
+
+    python -m dynamo_tpu.obs.report trace.json [more-dumps.json ...]
+
+"Served is 0.40 of raw" is a symptom; this report turns a recorded
+timeline into the ranked culprits: what fraction of engine wall time is
+host scheduling vs device wait vs dispatch build vs idle, how often
+decode ran as a device-resident continuation burst, and the p50/p95 of
+every phase.  Multiple dumps (frontend + each worker) merge; engine
+tracks are recognized by their ``sched:`` prefix (obs/__init__.py pins
+step spans there).
+
+Attribution is **innermost-span self time**: on one track, every
+instant belongs to the deepest span covering it, so nesting (``step``
+wraps ``sched`` wraps nothing; ``decode_dispatch`` wraps
+``device_wait``) never double-counts and the partition sums to wall
+time exactly — ``step_other`` is the step loop's unattributed host
+overhead, ``idle`` the time outside any span (scheduler parked, or the
+device running ahead of a host with nothing to do).  The acceptance
+bar "phases sum to ≥95% of wall" is therefore a property of the
+recording, checked here, not an accounting trick.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Tuple
+
+from ..runtime.metrics import percentile
+
+ENGINE_TRACK_PREFIX = "sched:"
+
+
+def load_events(paths: Iterable[str]) -> List[Dict[str, Any]]:
+    """Merge the X-phase events of several dumps, resolving each event's
+    track to "<service>:<pid>/<thread-name>" so same-named tracks from
+    different processes stay distinct."""
+    out: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        other = doc.get("otherData", {})
+        proc = f"{other.get('service', 'proc')}:{other.get('pid', 0)}"
+        names: Dict[int, str] = {}
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+                names[ev["tid"]] = ev["args"]["name"]
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            out.append({
+                "name": ev["name"],
+                "track": f"{proc}/{names.get(ev['tid'], ev['tid'])}",
+                "ts": float(ev["ts"]),
+                "dur": float(ev.get("dur", 0.0)),
+                "args": ev.get("args", {}) or {},
+            })
+    return out
+
+
+def _self_times(events: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Innermost-covering-span self time per kind on ONE track, in µs.
+
+    Events must be well nested per track (they are: each track is one
+    serialized timeline).  Sweep the start/end boundaries with a stack;
+    each elapsed segment is charged to the span open on top."""
+    bounds: List[Tuple[float, int, int]] = []  # (t, +1 open | -1 close, idx)
+    for i, ev in enumerate(events):
+        if ev["dur"] <= 0.0:
+            # a zero-width span has zero self time by definition; in the
+            # sweep its close would sort before its own open and the
+            # ghost entry would swallow the track's unattributed time
+            continue
+        bounds.append((ev["ts"], 1, i))
+        bounds.append((ev["ts"] + ev["dur"], -1, i))
+    # at equal t, close before open EXCEPT a parent opening at the same
+    # instant as its child: opens sort by (t, kind=1) after closes —
+    # and among same-t opens, longer spans (parents) first
+    bounds.sort(key=lambda b: (b[0], b[1] == 1,
+                               -events[b[2]]["dur"] if b[1] == 1
+                               else events[b[2]]["dur"]))
+    self_us: Dict[str, float] = defaultdict(float)
+    stack: List[int] = []
+    last_t = None
+    for t, kind, idx in bounds:
+        if last_t is not None and stack and t > last_t:
+            self_us[events[stack[-1]]["name"]] += t - last_t
+        last_t = t
+        if kind == 1:
+            stack.append(idx)
+        else:
+            if idx in stack:  # tolerate slight overlap from clock jitter
+                stack.remove(idx)
+    return dict(self_us)
+
+
+def report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    by_track: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+    for ev in events:
+        by_track[ev["track"]].append(ev)
+
+    # -- engine-track wall partition --------------------------------------
+    engine_tracks = [t for t, evs in by_track.items()
+                     if ENGINE_TRACK_PREFIX in t
+                     or any(e["name"] == "step" for e in evs)]
+    wall_us = 0.0
+    phase_us: Dict[str, float] = defaultdict(float)
+    for t in engine_tracks:
+        evs = sorted(by_track[t], key=lambda e: e["ts"])
+        if not evs:
+            continue
+        t0 = min(e["ts"] for e in evs)
+        t1 = max(e["ts"] + e["dur"] for e in evs)
+        wall_us += t1 - t0
+        for kind, us in _self_times(evs).items():
+            key = "step_other" if kind == "step" else kind
+            phase_us[key] += us
+    idle_us = max(0.0, wall_us - sum(phase_us.values()))
+
+    # -- per-kind latency stats (all tracks) ------------------------------
+    durs: Dict[str, List[float]] = defaultdict(list)
+    for ev in events:
+        durs[ev["name"]].append(ev["dur"])
+    kinds = {
+        k: {
+            "count": len(v),
+            "total_s": round(sum(v) / 1e6, 6),
+            "p50_ms": round(percentile(v, 50) / 1e3, 4),
+            "p95_ms": round(percentile(v, 95) / 1e3, 4),
+        }
+        for k, v in sorted(durs.items())
+    }
+
+    # -- headline gap numbers ---------------------------------------------
+    decode = [ev for ev in events if ev["name"] == "decode_dispatch"]
+    cont = sum(1 for ev in decode if ev["args"].get("cont"))
+    steps = [ev for ev in events if ev["name"] == "step"]
+    gap: Dict[str, Any] = {}
+    if wall_us > 0:
+        frac = {k: round(us / wall_us, 4)
+                for k, us in sorted(phase_us.items(),
+                                    key=lambda kv: -kv[1])}
+        frac["idle"] = round(idle_us / wall_us, 4)
+        gap = {
+            "engine_wall_s": round(wall_us / 1e6, 6),
+            # what the overlapped scheduler must drive to ~0: host time
+            # spent deciding instead of keeping the device fed
+            "sched_overhead_frac": round(
+                (phase_us.get("sched", 0.0)
+                 + phase_us.get("step_other", 0.0)) / wall_us, 4),
+            "device_wait_frac": round(
+                phase_us.get("device_wait", 0.0) / wall_us, 4),
+            # time the scheduler wasn't even stepping: with work queued
+            # this is device-idle the host never filled
+            "idle_frac": round(idle_us / wall_us, 4),
+            "device_idle_per_step_ms": round(
+                (idle_us + phase_us.get("sched", 0.0)
+                 + phase_us.get("step_other", 0.0))
+                / max(len(steps), 1) / 1e3, 4),
+            "wall_fractions": frac,
+        }
+        if decode:
+            gap["cont_burst_frac"] = round(cont / len(decode), 4)
+    trace_ids = {ev["args"]["trace_id"] for ev in events
+                 if "trace_id" in ev["args"]}
+    return {
+        "spans": len(events),
+        "tracks": len(by_track),
+        "engine_tracks": len(engine_tracks),
+        "distinct_trace_ids": len(trace_ids),
+        "gap": gap,
+        "kinds": kinds,
+    }
+
+
+def report_paths(paths: Iterable[str]) -> Dict[str, Any]:
+    return report(load_events(paths))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "dynamo_tpu.obs.report",
+        description="Gap-attribution report over Chrome trace dumps "
+                    "(DYN_TRACE_OUT / bench_serving.py --trace-out).")
+    p.add_argument("paths", nargs="+", help="Chrome trace JSON dump(s)")
+    p.add_argument("--indent", type=int, default=2,
+                   help="JSON indent (0 = one line)")
+    args = p.parse_args(argv)
+    rep = report_paths(args.paths)
+    json.dump(rep, sys.stdout, indent=args.indent or None)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,57 +154,24 @@ class LoadObserver:
         )
 
 
-class FpmObserver:
-    """Forward-pass-metrics consumer (ref fpm_publisher.rs + the
-    reference's instrumented_scheduler.py): workers stream one record per
-    dispatched program on `fpm.{ns}.{component}`; this observer keeps a
-    sliding window per worker and derives the measured decode ITL
-    (Σ dispatch gaps / Σ tokens-per-lane) and prefill throughput —
-    finer-grained and fresher than the 0.5s EMA in load_metrics, and the
-    input the SLA planner's perf model regresses on online."""
+class FpmWindow:
+    """Sliding-window FPM aggregation, no runtime attached: feed it
+    records (`add`) and read the derived engine numbers.  The planner's
+    FpmObserver subclasses this with an event-plane subscription; a
+    worker feeds its OWN fpm ring through one so `/metrics` scrapes see
+    the headline engine numbers (prefill MFU, spec acceptance, queue
+    depth, decode tok/s) without a planner in the deployment."""
 
-    def __init__(self, runtime, namespace: str, component: str,
-                 window_s: float = 20.0):
-        self.runtime = runtime
-        self.subject = f"fpm.{namespace}.{component}"
+    def __init__(self, window_s: float = 20.0):
         self.window_s = window_s
         # per-worker deques of (recv_t, record)
         self._steps: Dict[int, Deque[Tuple[float, dict]]] = {}
-        self._cancel = asyncio.Event()
-        self._task: Optional[asyncio.Task] = None
 
-    async def start(self) -> "FpmObserver":
-        self._task = asyncio.create_task(self._loop())
-        return self
-
-    async def close(self) -> None:
-        self._cancel.set()
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
-
-    async def _loop(self) -> None:
-        try:
-            async for subj, payload in self.runtime.event_plane.subscribe(
-                self.subject, cancel=self._cancel
-            ):
-                if subj != self.subject:
-                    continue
-                w = payload.get("worker_id")
-                steps = payload.get("steps")
-                if w is None or not isinstance(steps, list):
-                    continue
-                dq = self._steps.setdefault(w, deque(maxlen=4096))
-                now = time.monotonic()
-                for rec in steps:
-                    if isinstance(rec, dict):
-                        dq.append((now, rec))
-        except asyncio.CancelledError:
-            pass
+    def add(self, worker_id: int, rec: dict) -> None:
+        if isinstance(rec, dict):
+            self._steps.setdefault(
+                worker_id, deque(maxlen=4096)
+            ).append((time.monotonic(), rec))
 
     def _window(self):
         cutoff = time.monotonic() - self.window_s
@@ -335,3 +302,72 @@ class FpmObserver:
                     total += float(rec["queue_depth"])
                     break
         return total
+
+    def decode_tokens_per_s(self) -> float:
+        """Fleet decode token rate over the window: with the pipeline
+        saturated a decode record's gap covers k steps for every lane,
+        so that burst emitted k·lanes tokens in gap seconds.  Per-worker
+        rate Σ(k·lanes)/Σgap over plausible gaps (the decode_itl_s
+        gate), summed across workers; 0.0 when idle."""
+        total_rate = 0.0
+        for dq in self._window().values():
+            toks, gaps = 0, 0.0
+            for _, rec in dq:
+                if rec.get("kind") != "decode":
+                    continue
+                gap = float(rec.get("gap_s", 0.0))
+                if not 0.0 < gap < 1.0:
+                    continue
+                toks += int(rec.get("k", 1)) * int(rec.get("lanes", 0))
+                gaps += gap
+            if toks and gaps > 0.0:
+                total_rate += toks / gaps
+        return total_rate
+
+
+class FpmObserver(FpmWindow):
+    """Forward-pass-metrics consumer (ref fpm_publisher.rs + the
+    reference's instrumented_scheduler.py): workers stream one record per
+    dispatched program on `fpm.{ns}.{component}`; this observer keeps a
+    sliding window per worker and derives the measured decode ITL
+    (Σ dispatch gaps / Σ tokens-per-lane) and prefill throughput —
+    finer-grained and fresher than the 0.5s EMA in load_metrics, and the
+    input the SLA planner's perf model regresses on online."""
+
+    def __init__(self, runtime, namespace: str, component: str,
+                 window_s: float = 20.0):
+        super().__init__(window_s=window_s)
+        self.runtime = runtime
+        self.subject = f"fpm.{namespace}.{component}"
+        self._cancel = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "FpmObserver":
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def close(self) -> None:
+        self._cancel.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            async for subj, payload in self.runtime.event_plane.subscribe(
+                self.subject, cancel=self._cancel
+            ):
+                if subj != self.subject:
+                    continue
+                w = payload.get("worker_id")
+                steps = payload.get("steps")
+                if w is None or not isinstance(steps, list):
+                    continue
+                for rec in steps:
+                    self.add(w, rec)
+        except asyncio.CancelledError:
+            pass

@@ -36,3 +36,7 @@ def test_bench_help_exits_zero(path):
     )
     assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
     assert "usage" in r.stdout.lower()
+    if os.path.basename(path) == "bench_serving.py":
+        # the timeline-tracing hook (obs/): --trace-out records the run
+        # and prints the gap-attribution line
+        assert "--trace-out" in r.stdout
