@@ -42,9 +42,44 @@ def fresh_runtime():
     return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
 
 
+# simulated accelerator peaks: nonzero so the mocker's roofline gauges
+# (dynamo_engine_mfu/mbu) light up and land in the bench JSON
+SIM_PEAK_TFLOPS = 50.0
+SIM_PEAK_HBM_GBPS = 100.0
+
+
 def engine_args(role="both"):
     return MockEngineArgs(model_name="bench", block_size=BLOCK,
-                          num_blocks=8192, speedup_ratio=1.0, role=role)
+                          num_blocks=8192, speedup_ratio=1.0, role=role,
+                          peak_tflops=SIM_PEAK_TFLOPS,
+                          peak_hbm_gbps=SIM_PEAK_HBM_GBPS)
+
+
+async def collect_roofline(rt):
+    """Scrape the run's worker gauges (one load-loop tick after the
+    replay) into the bench JSON's roofline block: per-phase MFU/MBU and
+    compile counts per program family — the same names a production
+    Prometheus would scrape, parsed with the same parser."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    await asyncio.sleep(0.4)  # let the workers' 0.25s load loops tick
+    out = {"mfu": {}, "mbu": {}, "compiles": {}}
+    for fam in text_string_to_metric_families(
+            rt.metrics.render().decode()):
+        if fam.name == "dynamo_engine_mfu":
+            for s in fam.samples:
+                out["mfu"][s.labels.get("phase", "")] = round(s.value, 4)
+        elif fam.name == "dynamo_engine_mbu":
+            for s in fam.samples:
+                out["mbu"][s.labels.get("phase", "")] = round(s.value, 4)
+        elif fam.name == "dynamo_engine_compiles":
+            for s in fam.samples:
+                if not s.name.endswith("_total"):
+                    continue
+                key = s.labels.get("family", "")
+                out["compiles"][key] = \
+                    out["compiles"].get(key, 0) + int(s.value)
+    return out
 
 
 async def bench_agg(rows, n_workers, args):
@@ -58,11 +93,12 @@ async def bench_agg(rows, n_workers, args):
     await client.wait_for_instances()
     report = await replay(client.generate, rows, block_size=BLOCK,
                           speedup=args.speedup)
+    roofline = await collect_roofline(rt)
     await client.close()
     for w in workers:
         await w.close()
     await rt.shutdown()
-    return report
+    return report, roofline
 
 
 async def bench_disagg(rows, n_prefill, n_decode, args):
@@ -94,13 +130,14 @@ async def bench_disagg(rows, n_prefill, n_decode, args):
 
     report = await replay(client_fn, rows, block_size=BLOCK,
                           speedup=args.speedup)
+    roofline = await collect_roofline(rt)
     await orch.close()
     await pclient.close()
     await dclient.close()
     for w in prefills + decodes:
         await w.close()
     await rt.shutdown()
-    return report
+    return report, roofline
 
 
 async def main():
@@ -114,6 +151,14 @@ async def main():
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--slo-ttft", type=float, default=2.0)
     p.add_argument("--slo-itl", type=float, default=0.025)
+    # ms-denominated aliases matching the frontend's --slo-* flags
+    # (obs/slo.py); when given they override the seconds-based knobs
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT SLO target in ms (overrides --slo-ttft; "
+                        "same convention as the frontend's flag)")
+    p.add_argument("--slo-itl-ms", type=float, default=None,
+                   help="mean-ITL SLO target in ms (overrides "
+                        "--slo-itl)")
     p.add_argument("--trace-out", default="",
                    help="record the run's timeline spans (obs/) and dump "
                         "a Perfetto-loadable Chrome trace here; also "
@@ -132,17 +177,37 @@ async def main():
                       input_len=args.input_len, output_len=args.output_len,
                       block_size=BLOCK, prefix_groups=args.prefix_groups,
                       seed=11)
+    slo_ttft_s = (args.slo_ttft_ms / 1000.0
+                  if args.slo_ttft_ms is not None else args.slo_ttft)
+    slo_itl_s = (args.slo_itl_ms / 1000.0
+                 if args.slo_itl_ms is not None else args.slo_itl)
 
-    agg = await bench_agg(rows, args.workers, args)
-    print(json.dumps({"config": f"agg-{args.workers}w",
-                      **agg.summary(args.slo_ttft, args.slo_itl)}))
-    dis = await bench_disagg(rows, max(1, args.workers // 2),
-                             max(1, args.workers // 2), args)
-    print(json.dumps({
-        "config": f"disagg-{max(1, args.workers // 2)}p"
-                  f"{max(1, args.workers // 2)}d",
-        **dis.summary(args.slo_ttft, args.slo_itl),
-    }))
+    def line(config, summary, roofline):
+        # stable bench JSON schema: the `slo` block mirrors the
+        # frontend SLO plane's vocabulary (targets + goodput fraction),
+        # `roofline` the worker gauges, so a scoreboard diff across
+        # rounds reads the same numbers a live scrape would
+        gp = summary.get("goodput", {})
+        total = summary.get("requests", 0)
+        return json.dumps({
+            "config": config, **summary,
+            "slo": {
+                "ttft_s": slo_ttft_s, "itl_s": slo_itl_s,
+                "goodput": (round(gp.get("good_requests", 0) / total, 4)
+                            if total else None),
+                "good_rps": gp.get("good_rps"),
+            },
+            "roofline": roofline,
+        })
+
+    agg, agg_roof = await bench_agg(rows, args.workers, args)
+    print(line(f"agg-{args.workers}w",
+               agg.summary(slo_ttft_s, slo_itl_s), agg_roof))
+    dis, dis_roof = await bench_disagg(rows, max(1, args.workers // 2),
+                                       max(1, args.workers // 2), args)
+    print(line(f"disagg-{max(1, args.workers // 2)}p"
+               f"{max(1, args.workers // 2)}d",
+               dis.summary(slo_ttft_s, slo_itl_s), dis_roof))
 
     if tracer is not None:
         from dynamo_tpu.obs.report import report_paths

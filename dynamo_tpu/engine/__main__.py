@@ -59,6 +59,10 @@ def build_args() -> argparse.ArgumentParser:
                    default=float(os.environ.get("DYN_PEAK_TFLOPS", "0")),
                    help="accelerator dense-bf16 peak, for prefill-phase "
                         "MFU in the FPM stream (v5e: 197); 0 = unknown")
+    p.add_argument("--peak-hbm-gbps", type=float,
+                   default=float(os.environ.get("DYN_PEAK_HBM_GBPS", "0")),
+                   help="accelerator peak HBM bandwidth GB/s, for the "
+                        "roofline MBU gauges (v5e: 819); 0 = unknown")
     p.add_argument("--host-cache-blocks", type=int, default=0,
                    help="G2 host-DRAM KV cache capacity (blocks); 0 off")
     p.add_argument("--disk-cache-dir", default="",
@@ -122,6 +126,7 @@ async def main() -> None:
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         prefill_packed=not args.no_packed_prefill,
         peak_tflops=args.peak_tflops,
+        peak_hbm_gbps=args.peak_hbm_gbps,
         host_cache_blocks=args.host_cache_blocks,
         disk_cache_dir=args.disk_cache_dir or None,
         disk_cache_blocks=args.disk_cache_blocks,

@@ -91,6 +91,12 @@ class EngineConfig:
     # accelerator peak (dense bf16) TFLOP/s, for prefill-phase MFU in the
     # FPM stream (v5e: 197).  0 = unknown; MFU omitted from records.
     peak_tflops: float = 0.0
+    # accelerator peak HBM bandwidth in GB/s, for the roofline plane's
+    # memory-bandwidth-utilization gauges (v5e: 819).  The cost-analysis
+    # bytes-accessed of each compiled program (obs/compile_watch.py)
+    # over the dispatch gap gives MBU — the binding axis for decode,
+    # which MFU alone cannot show.  0 = unknown; MBU gauges omitted.
+    peak_hbm_gbps: float = 0.0
 
     # speculative decoding (spec/): emit more than one ACCEPTED token per
     # weight/KV pass once decode is memory-bandwidth-bound.  "ngram" is

@@ -411,6 +411,54 @@ class JaxEngine:
                 )
                 for g in (False, True)
             }
+        # compile watchdog + roofline (obs/compile_watch.py): every jit
+        # dispatch site below goes through a WatchedProgram so a compile
+        # — warmup or the mid-serving kind the guided fork measured at
+        # 8-14s — is counted, timed, span-recorded, and costed with
+        # XLA's own cost_analysis (per-program FLOPs/bytes feed the
+        # decode/spec-verify/packed-prefill MFU+MBU gauges, replacing
+        # the hand-counted prefill-only estimate where available).
+        # Wrapper overhead per dispatch is two C++ cache-size reads.
+        from ..obs.compile_watch import CompileWatch
+
+        # timeline tracing (obs/): steps run on whatever pool thread
+        # asyncio.to_thread picked, but the step lock serializes them —
+        # pin every step-phase span (and compile spans) to ONE logical
+        # track per engine so the report's innermost-span attribution
+        # sees a well-nested timeline (co-resident engines in one
+        # process stay distinct)
+        self._obs_track = f"sched:{id(self):x}"
+        self.compile_watch = CompileWatch(
+            sink=lambda rec: self.fpm.append(rec),
+            track=self._obs_track,
+            serving=lambda: any(s is not None for s in self._slots),
+        )
+        w = self.compile_watch
+        _toks2 = lambda a: a[2].shape[-1]           # noqa: E731
+        _toks2_total = lambda a: int(               # noqa: E731
+            np.prod(a[2].shape))
+        self._jit_decode = {
+            g: w.wrap(fn, "decode") for g, fn in self._jit_decode.items()
+        }
+        self._jit_prefill = w.wrap(self._jit_prefill, "prefill", _toks2)
+        self._jit_prefill_batched = w.wrap(
+            self._jit_prefill_batched, "prefill_batched", _toks2_total)
+        self._jit_prefill_packed = w.wrap(
+            self._jit_prefill_packed, "prefill_packed", _toks2)
+        self._jit_spec_verify = w.wrap(
+            self._jit_spec_verify, "spec_verify", _toks2)
+        self._jit_prefill_ring = w.wrap(
+            self._jit_prefill_ring, "prefill_ring", _toks2)
+        self._jit_inject = w.wrap(self._jit_inject, "inject",
+                                  lambda a: a[3].shape[0])
+        self._jit_gather = w.wrap(self._jit_gather, "gather",
+                                  lambda a: a[1].shape[0])
+        if self._jit_decode_multi is not None:
+            self._jit_decode_multi = {
+                g: w.wrap(fn, "decode_multi")
+                for g, fn in self._jit_decode_multi.items()
+            }
+
         # continuation decode (steady state): the burst descriptor lives on
         # device and advances INSIDE the decode program (advance=k), so an
         # unchanged-membership burst uploads nothing — the full path
@@ -459,14 +507,12 @@ class JaxEngine:
         # The worker drains this ring onto the event plane; the SLA
         # planner regresses its perf model on it online.
         self.fpm: deque = deque(maxlen=4096)
-        # timeline tracing (obs/): steps run on whatever pool thread
-        # asyncio.to_thread picked, but the step lock serializes them —
-        # pin every step-phase span to ONE logical track per engine so
-        # the report's innermost-span attribution sees a well-nested
-        # timeline (co-resident engines in one process stay distinct)
-        self._obs_track = f"sched:{id(self):x}"
         self._fpm_last_decode_t = 0.0
         self._fpm_last_prefill_t = 0.0
+        # roofline attrs handed from a dispatch path to the span that
+        # wraps it (tracing-on only; consumed exactly once per dispatch)
+        self._obs_dispatch_extra: Optional[dict] = None
+        self._obs_decode_extra: Optional[dict] = None
         # time of the last BLOCKING device fetch (np.asarray round trip):
         # dispatch-gap MFU is only meaningful when a sync landed inside
         # the gap — pure async enqueues measure host time, not compute
@@ -946,6 +992,21 @@ class JaxEngine:
     def kv_usage(self) -> float:
         return self.allocator.usage()
 
+    def kv_occupancy(self) -> Dict[str, Dict[str, int]]:
+        """Block occupancy per storage tier, for the worker's /metrics
+        gauges: g1 = the HBM allocator (id 0 is the garbage block, so
+        capacity is num_blocks - 1), g2..g4 = the KVBM tiers when
+        enabled (kvbm/manager.py occupancy)."""
+        a = self.allocator
+        usable = a.num_blocks - 1
+        out: Dict[str, Dict[str, int]] = {"g1": {
+            "used": usable - a.num_free, "free": a.num_free,
+            "capacity": usable, "evictable": a.num_evictable,
+        }}
+        if self.kvbm is not None:
+            out.update(self.kvbm.occupancy())
+        return out
+
     @property
     def spec_enabled(self) -> bool:
         """Speculative decoding actually active: the config asked for it
@@ -1302,9 +1363,10 @@ class JaxEngine:
     def _run_embed(self, toks: np.ndarray, true_len: int) -> np.ndarray:
         jit = getattr(self, "_jit_embed", None)
         if jit is None:
-            jit = self._jit_embed = jax.jit(
+            jit = self._jit_embed = self.compile_watch.wrap(jax.jit(
                 partial(self.family.embed_text, self.params,
-                        self.model_cfg))
+                        self.model_cfg)), "embed",
+                tokens_of=lambda a: a[0].shape[0])
         with self.mesh:
             return np.asarray(
                 jit(jnp.asarray(toks), jnp.int32(true_len)), np.float32)
@@ -1753,8 +1815,10 @@ class JaxEngine:
         try:
             self._prefill_dispatch(pslots)
         finally:
+            extra = self._obs_dispatch_extra or {}
+            self._obs_dispatch_extra = None
             obs.end("prefill_dispatch", t_obs, track=self._obs_track,
-                    rows=len(pslots))
+                    rows=len(pslots), **extra)
 
     def _prefill_dispatch(self, pslots) -> None:
         """Route this step's prefilling slots to one program (see
@@ -1839,7 +1903,8 @@ class JaxEngine:
         self._fpm_prefill(
             rows=n, tokens=int(sum(chunks)), bucket=bucket,
             completing=sum(1 for s, ch in zip(pslots, chunks)
-                           if s.prefill_pos + ch >= s.prompt_len))
+                           if s.prefill_pos + ch >= s.prompt_len),
+            xla=self._jit_prefill_batched.cost(Bp * bucket))
         # fetch the sampled tokens ONLY when some row completes its
         # prompt this chunk: np.asarray is a blocking device round trip
         # (~35-100ms through the tunnel), and intermediate chunks discard
@@ -1859,7 +1924,8 @@ class JaxEngine:
                 int(firsts[i]) if firsts is not None else -1)
 
     def _fpm_prefill(self, rows: int, tokens: int, bucket: int,
-                     packed: bool = False, completing: int = 0) -> None:
+                     packed: bool = False, completing: int = 0,
+                     xla: Optional[dict] = None) -> None:
         """One FPM record per prefill program — the inputs the SLA
         planner's FpmObserver turns into prefill-phase MFU and pressure.
 
@@ -1883,7 +1949,14 @@ class JaxEngine:
           `completing` slots whose prompt this very dispatch finishes —
           the burst's final record must read 0, or the observer reports
           phantom pressure for a full window after the fleet goes
-          idle."""
+          idle.
+        - xla: the dispatched program's cost_analysis entry from the
+          compile watchdog (obs/compile_watch.py), when XLA has a cost
+          model for it.  Rides the record as xla_flops/xla_bytes (the
+          roofline gauges' inputs) and REPLACES the hand-counted dense
+          estimate in the derived mfu — the measured program includes
+          attention and the real logit rows, which the estimate
+          excludes by construction."""
         now = time.monotonic()
         gap = (now - self._fpm_last_prefill_t
                if self._fpm_last_prefill_t else 0.0)
@@ -1902,16 +1975,35 @@ class JaxEngine:
             "bucket": bucket, "packed": packed, "gap_s": gap,
             "flops": flops, "queue_depth": depth, "synced": synced,
         }
+        if xla is not None:
+            rec["xla_flops"] = xla["flops"]
+            rec["xla_bytes"] = xla["bytes"]
         if gap > 0.0 and self.config.peak_tflops > 0.0 and synced:
             # only when a blocking device fetch landed inside the gap:
             # jit dispatch is async, so a sync-free gap measures host
             # enqueue time, not chunk compute, and flops/gap would
             # overstate MFU without bound.  Clamped at 1.0 — a sync near
             # the interval's start can still leave gap short of the full
-            # device time.
-            rec["mfu"] = min(
-                flops / gap / (self.config.peak_tflops * 1e12), 1.0)
+            # device time.  `mfu` prefers the measured program's cost
+            # analysis (it includes attention + the real logit rows AND
+            # the padding the device actually executes); `est_mfu` keeps
+            # the hand count so divergence between the two is visible —
+            # obs.report's roofline table prints them side by side.
+            est = min(flops / gap / (self.config.peak_tflops * 1e12), 1.0)
+            rec["est_mfu"] = est
+            rec["mfu"] = (min(xla["flops"] / gap
+                              / (self.config.peak_tflops * 1e12), 1.0)
+                          if xla is not None else est)
         self.fpm.append(rec)
+        if obs.enabled():
+            # hand the record's roofline-relevant fields to the
+            # enclosing prefill_dispatch span (_prefill_step ends it and
+            # cannot see this path's locals); consumed exactly once
+            self._obs_dispatch_extra = {
+                k: rec[k] for k in ("tokens", "bucket", "gap_s", "synced",
+                                    "mfu", "est_mfu", "xla_flops",
+                                    "xla_bytes")
+                if k in rec}
         self._fpm_last_prefill_t = now
 
     def _prefill_packed_step(self, pslots, budget: int) -> None:
@@ -1948,7 +2040,8 @@ class JaxEngine:
             rows=len(plan.slots), tokens=plan.tokens, bucket=plan.bucket,
             packed=True,
             completing=sum(1 for s, ch in zip(plan.slots, plan.chunks)
-                           if s.prefill_pos + ch >= s.prompt_len))
+                           if s.prefill_pos + ch >= s.prompt_len),
+            xla=self._jit_prefill_packed.cost(plan.bucket))
         # blocking token fetch only when some segment completes its
         # prompt this chunk (see _prefill_step: intermediate chunks
         # discard the sample)
@@ -2020,7 +2113,8 @@ class JaxEngine:
         )
         self._fpm_prefill(
             rows=1, tokens=int(chunk), bucket=bucket,
-            completing=int(slot.prefill_pos + chunk >= slot.prompt_len))
+            completing=int(slot.prefill_pos + chunk >= slot.prompt_len),
+            xla=self._jit_prefill.cost(bucket))
         # blocking token fetch only on the completing chunk (see
         # _prefill_step: intermediate chunks discard the sample)
         if pos + chunk >= slot.prompt_len:
@@ -2535,12 +2629,19 @@ class JaxEngine:
         if gap > 1.0:
             gap = 0.0  # idle stretch, not verify latency: mark unknown
         # one FPM record per verify dispatch: the acceptance-rate input
-        # FpmObserver.spec_acceptance aggregates for the SLA planner
-        self.fpm.append({
+        # FpmObserver.spec_acceptance aggregates for the SLA planner;
+        # xla_* (cost analysis of the packed verify program) feeds the
+        # spec_verify roofline gauges
+        rec = {
             "t": now, "kind": "spec_verify", "lanes": len(plan.rows),
             "proposed": proposed_total, "accepted": accepted_total,
             "tokens": plan.tokens, "gap_s": gap,
-        })
+        }
+        vcost = self._jit_spec_verify.cost(len(a["toks"]))
+        if vcost is not None:
+            rec["xla_flops"] = vcost["flops"]
+            rec["xla_bytes"] = vcost["bytes"]
+        self.fpm.append(rec)
         self._fpm_last_spec_t = now
 
     def _spec_grow(self, s: _Slot, k: int) -> int:
@@ -2782,8 +2883,10 @@ class JaxEngine:
             lanes[s.index] = (self._seq_id(s), s.epoch)
             self._chain_owner[s.index] = lanes[s.index]
         self._inflight.append({"burst": burst, "k": k, "lanes": lanes})
+        extra = self._obs_decode_extra or {}
+        self._obs_decode_extra = None
         obs.end("decode_dispatch", t_obs, track=self._obs_track,
-                cont=cont_burst, k=k, lanes=len(active))
+                cont=cont_burst, k=k, lanes=len(active), **extra)
 
     GUIDED_TOPM = 32
     GUIDED_TOPM_WIDE = 256
@@ -2805,11 +2908,11 @@ class JaxEngine:
         """ONE lazy-init site for the guided top-M program — leader and
         follower must compile the identical collective program."""
         if getattr(self, "_jit_decode_topk", None) is None:
-            self._jit_decode_topk = jax.jit(
+            self._jit_decode_topk = self.compile_watch.wrap(jax.jit(
                 partial(self._decode_topk_impl, self.family,
                         self.model_cfg, self.mesh, self.GUIDED_TOPM),
                 donate_argnums=(1,),
-            )
+            ), "decode_topk")
         return self._jit_decode_topk
 
     def _topk_wide_jit(self):
@@ -2817,11 +2920,11 @@ class JaxEngine:
         lazily on the first time a guided slot's top-M set has no valid
         continuation, before giving up and force-closing the document."""
         if getattr(self, "_jit_decode_topk_wide", None) is None:
-            self._jit_decode_topk_wide = jax.jit(
+            self._jit_decode_topk_wide = self.compile_watch.wrap(jax.jit(
                 partial(self._decode_topk_impl, self.family,
                         self.model_cfg, self.mesh, self.GUIDED_TOPM_WIDE),
                 donate_argnums=(1,),
-            )
+            ), "decode_topk_wide")
         return self._jit_decode_topk_wide
 
     def _guided_codec(self):
@@ -2852,12 +2955,10 @@ class JaxEngine:
         if not gslots:
             return
         c = self.config
-        if getattr(self, "_jit_decode_topk", None) is None:
-            self._jit_decode_topk = jax.jit(
-                partial(self._decode_topk_impl, self.family,
-                        self.model_cfg, self.mesh, self.GUIDED_TOPM),
-                donate_argnums=(1,),
-            )
+        # ONE init site (_topk_jit): a duplicate raw jax.jit here would
+        # bypass the compile watchdog's wrapper — the guided fork's
+        # 8-14s mid-serving compile is exactly what it must see
+        self._topk_jit()
         codec = self._guided_codec()
         B = c.max_num_seqs
         t_obs = obs.begin()
@@ -3094,7 +3195,7 @@ class JaxEngine:
                if self._fpm_last_decode_t else 0.0)
         if gap > 1.0:
             gap = 0.0  # idle period, not decode latency: mark unknown
-        self.fpm.append({
+        rec = {
             "t": now, "kind": "decode", "k": k,
             "lanes": sum(1 for s in self._slots
                          if s is not None and not s.prefilling),
@@ -3102,7 +3203,18 @@ class JaxEngine:
             # IS the burst's wall time (k tokens per lane per gap);
             # 0.0 = unknown (first burst after an idle stretch)
             "gap_s": gap,
-        })
+        }
+        # roofline: the burst program's own cost analysis (fixed shape —
+        # one entry per decode variant); covers all k fused steps
+        dcost = fn.cost()
+        if dcost is not None:
+            rec["xla_flops"] = dcost["flops"]
+            rec["xla_bytes"] = dcost["bytes"]
+        self.fpm.append(rec)
+        if obs.enabled():
+            self._obs_decode_extra = {
+                key: rec[key] for key in ("gap_s", "xla_flops",
+                                          "xla_bytes") if key in rec}
         self._fpm_last_decode_t = now
         return burst
 

@@ -255,25 +255,34 @@ class JaxEngineWorker:
         async def generate_handler(payload, ctx):
             request = PreprocessedRequest.from_dict(payload)
             ntok = 0
+            # log<->trace correlation: every log record this worker
+            # emits while serving the stream carries the propagated
+            # trace_id (runtime/logging.py TraceIdFilter)
+            bind_tok = obs.bind_trace_id(
+                obs.trace_id_from_annotations(request.annotations))
             # worker-side request span: stitches to the frontend's
             # `request` span and request_end record via the propagated
             # trace_id (obs cross-process stitching)
             t_obs = obs.begin()
-            async for out in self.engine.generate(request, token=ctx.token):
-                ntok += len(out.token_ids)
-                yield out.to_dict()
-            obs.end("worker_request", t_obs,
-                    trace_id=obs.trace_id_from_annotations(
-                        request.annotations) if t_obs else None,
-                    request_id=request.request_id, tokens=ntok)
-            # trace join: the frontend's traceparent annotation makes this
-            # worker's structured log line greppable by trace_id
-            tp = next((a.split(":", 1)[1] for a in request.annotations
-                       if a.startswith("traceparent:")), None)
-            if tp is not None:
-                logger.info("request served", extra={
-                    "request_id": request.request_id, "traceparent": tp,
-                    "output_tokens": ntok})
+            try:
+                async for out in self.engine.generate(request,
+                                                      token=ctx.token):
+                    ntok += len(out.token_ids)
+                    yield out.to_dict()
+            finally:
+                obs.end("worker_request", t_obs,
+                        trace_id=obs.trace_id_from_annotations(
+                            request.annotations) if t_obs else None,
+                        request_id=request.request_id, tokens=ntok)
+                # trace join: the frontend's traceparent annotation makes
+                # this worker's structured log line greppable by trace_id
+                tp = next((a.split(":", 1)[1] for a in request.annotations
+                           if a.startswith("traceparent:")), None)
+                if tp is not None:
+                    logger.info("request served", extra={
+                        "request_id": request.request_id,
+                        "traceparent": tp, "output_tokens": ntok})
+                obs.unbind_trace_id(bind_tok)
 
         async def clear_handler(payload, ctx):
             n = await self.engine.clear_kv_blocks()
@@ -559,17 +568,20 @@ class JaxEngineWorker:
                 steps.append(self.engine.fpm.popleft())
             for rec in steps:
                 fw.add(self.served.instance_id, rec)
-            m.set("dynamo_engine_prefill_mfu",
-                  fw.prefill_mfu(self.config.peak_tflops))
-            m.set("dynamo_engine_prefill_queue_depth",
-                  fw.prefill_queue_depth())
-            m.set("dynamo_engine_prefill_tokens_per_s",
-                  fw.prefill_tokens_per_s())
-            m.set("dynamo_engine_decode_tokens_per_s",
-                  fw.decode_tokens_per_s())
-            acc = fw.spec_acceptance()
-            if acc is not None:
-                m.set("dynamo_engine_spec_acceptance", acc)
+            # compile watchdog records -> per-family compile histogram,
+            # then the shared gauge surface (planner/metrics.py
+            # export_engine_gauges): headline FPM aggregates, per-phase
+            # roofline MFU/MBU from XLA cost analysis over dispatch
+            # gaps, KV occupancy per tier — ONE definition for both
+            # workers, so mocker /metrics parity can't drift
+            from ..obs.compile_watch import observe_compile_records
+            from ..planner.metrics import export_engine_gauges
+
+            observe_compile_records(m, steps)
+            export_engine_gauges(
+                m, fw, peak_tflops=self.config.peak_tflops,
+                peak_hbm_gbps=self.config.peak_hbm_gbps,
+                occupancy=self.engine.kv_occupancy())
             if steps:
                 try:
                     await self.runtime.event_plane.publish(fpm_subject, {

@@ -34,6 +34,18 @@ def build_args() -> argparse.ArgumentParser:
         default=float(os.environ.get("DYN_SESSION_AFFINITY_TTL", 0)) or None,
         help="seconds an idle agent session stays pinned to its worker "
              "(0/unset disables sticky sessions)")
+    # SLO plane (obs/slo.py): targets drive the goodput gauge,
+    # multi-window burn rate, and the planner's slo_metrics feed
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="TTFT target in ms: a request is 'good' only if "
+                        "its first token beat this (goodput/burn-rate "
+                        "gauges light up when set)")
+    p.add_argument("--slo-itl-ms", type=float, default=None,
+                   help="per-request mean inter-token-latency target in "
+                        "ms for the goodput check")
+    p.add_argument("--slo-objective", type=float, default=0.99,
+                   help="SLO objective (good-request fraction) the "
+                        "burn-rate error budget derives from")
     return p
 
 
@@ -73,9 +85,13 @@ async def main() -> None:
         disagg_config=disagg_config,
         session_affinity_ttl=affinity_ttl,
     ).start()
+    from ..obs.slo import SloConfig
+
     service = await HttpService(
         rt, manager, host=args.host, port=args.port,
         busy_threshold=args.busy_threshold,
+        slo=SloConfig(ttft_ms=args.slo_ttft_ms, itl_ms=args.slo_itl_ms,
+                      objective=args.slo_objective),
     ).start()
     grpc_service = None
     if args.grpc_port:

@@ -247,7 +247,8 @@ class AnthropicRoutes:
             request.headers)
         tracker = RequestTracker.from_headers(
             request.headers, req.request_id, model, svc.trace_sink,
-            session_id=req.session_id, endpoint="anthropic_messages",
+            slo=svc.slo_plane, session_id=req.session_id,
+            endpoint="anthropic_messages",
             input_tokens=len(req.token_ids))
         from .. import obs
 
@@ -263,6 +264,12 @@ class AnthropicRoutes:
         svc._m_requests.inc("dynamo_frontend_requests_total", model=model)
         t0 = time.monotonic()
         t_obs = obs.begin()
+        # log<->trace correlation (same contract as the OpenAI routes):
+        # bound immediately before the try whose finally unbinds it —
+        # keep-alive requests share the connection task's context, and
+        # a binding leaked past an exception would stamp this request's
+        # id onto the next request's logs
+        bind_tok = obs.bind_trace_id(tracker.trace_id)
         try:
             if body.get("stream"):
                 return await self._stream(request, pipeline, req, model,
@@ -272,6 +279,7 @@ class AnthropicRoutes:
         finally:
             obs.end("request", t_obs, trace_id=tracker.trace_id,
                     request_id=req.request_id, model=model)
+            obs.unbind_trace_id(bind_tok)
             svc._inflight_delta(-1)
             svc._m_requests.observe(
                 "dynamo_frontend_request_duration_seconds",

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 from dataclasses import dataclass, replace
 from typing import Any, AsyncIterator, Dict, Optional
 
@@ -219,11 +220,19 @@ class ModelPipeline:
             request = await self.encoder.encode_and_attach(request,
                                                            token=token)
         if self.prefill is not None:
+            t_hop = time.monotonic()
             request = await self.prefill.maybe_prefill(request, token=token)
-            if (tracker is not None and request.disaggregated_params
-                    and request.disaggregated_params.get("instance_id")):
-                tracker.on_prefill_worker(
-                    request.disaggregated_params["instance_id"])
+            if tracker is not None and request.disaggregated_params:
+                # a remote prefill actually ran: IT was the first
+                # worker dispatch, so queue time ends where the hop
+                # began (backdated — stamping after would absorb the
+                # whole prefill as phantom admission wait).  A request
+                # conditional disagg kept local stamps via on_dispatch,
+                # keeping the decode routing wait in queue_ms.
+                tracker.mark_dispatching(at=t_hop)
+                if request.disaggregated_params.get("instance_id"):
+                    tracker.on_prefill_worker(
+                        request.disaggregated_params["instance_id"])
         detok = self.preprocessor.tokenizer.make_detokenizer()
         stops = request.stop.stop or []
         pending = ""  # holdback buffer for partial stop-string matches

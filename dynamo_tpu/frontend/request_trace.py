@@ -125,6 +125,9 @@ class RequestTracker:
     request_id: str
     model: str
     sink: Optional[TraceSink] = None
+    # SLO plane (obs/slo.py SloPlane): finish() feeds every terminal
+    # record into the frontend's latency histograms / goodput windows
+    slo: Optional[object] = None
     x_request_id: Optional[str] = None
     trace_id: Optional[str] = None
     parent_span_id: Optional[str] = None
@@ -136,6 +139,7 @@ class RequestTracker:
     received_unix_ms: int = field(
         default_factory=lambda: int(time.time() * 1000))
     _t0: float = field(default_factory=time.monotonic)
+    _dispatch_t: Optional[float] = None
     _first_token_t: Optional[float] = None
     _last_token_t: Optional[float] = None
     output_tokens: int = 0
@@ -170,6 +174,22 @@ class RequestTracker:
         self._dispatches += 1
         self.migrations = self._dispatches - 1
         self.decode_worker_id = instance_id
+        if self._dispatch_t is None:
+            # queue time = received -> FIRST dispatch (preprocessing +
+            # routing + admission wait); replays don't re-queue
+            self._dispatch_t = time.monotonic()
+
+    def mark_dispatching(self, at: Optional[float] = None) -> None:
+        """Queue time ends the moment the request leaves the frontend
+        for its FIRST worker — which in disaggregated mode is the
+        remote-prefill hop, not the decode dispatch.  The pipeline
+        calls this (backdated to the hop start via `at`) only when a
+        remote prefill actually ran, so queue_ms neither absorbs a
+        multi-second remote prefill as phantom admission wait nor
+        hides the decode routing wait on local-path requests; the
+        aggregated path stamps via on_dispatch as before."""
+        if self._dispatch_t is None:
+            self._dispatch_t = at if at is not None else time.monotonic()
 
     def on_prefill_worker(self, instance_id: int) -> None:
         self.prefill_worker_id = instance_id
@@ -237,6 +257,17 @@ class RequestTracker:
                 and self._last_token_t > self._first_token_t):
             avg_itl_ms = ((self._last_token_t - self._first_token_t)
                           * 1000.0 / (self.output_tokens - 1))
+        err_text = error or self.error
+        # explicit terminal outcome (obs/slo.py vocabulary): errored
+        # requests that never produced a first token — dispatch fail,
+        # drain reject, preprocess/encode failure — must count in every
+        # e2e/goodput denominator WITHOUT polluting the TTFT histogram,
+        # and the label is how consumers tell the cases apart
+        if err_text:
+            outcome = ("error" if self._first_token_t is not None
+                       else "no_first_token")
+        else:
+            outcome = "ok"
         request: Dict[str, Any] = {
             "request_id": self.request_id,
             "x_request_id": self.x_request_id,
@@ -245,9 +276,13 @@ class RequestTracker:
             "output_tokens": self.output_tokens,
             "request_received_ms": self.received_unix_ms,
             "total_time_ms": round(total_ms, 3),
+            "outcome": outcome,
         }
         if ttft_ms is not None:
             request["ttft_ms"] = round(ttft_ms, 3)
+        if self._dispatch_t is not None:
+            request["queue_ms"] = round(
+                (self._dispatch_t - self._t0) * 1000.0, 3)
         if avg_itl_ms is not None:
             request["avg_itl_ms"] = round(avg_itl_ms, 3)
         if self.cached_tokens is not None:
@@ -296,4 +331,9 @@ class RequestTracker:
         self._record = record
         if self.sink is not None:
             self.sink.emit(record)
+        if self.slo is not None:
+            # the one funnel every terminal path goes through: feed the
+            # SLO plane's histograms/goodput (obs/slo.py; it guards its
+            # own exceptions — a metrics bug must not fail the request)
+            self.slo.observe_finish(self, record)
         return record

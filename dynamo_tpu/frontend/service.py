@@ -300,22 +300,21 @@ class ModelWatcher:
 
 
 class _LatencyProbe:
-    """Per-request TTFT/ITL/output-token recorder over the delta stream."""
+    """Per-token ITL / output-token recorder over the delta stream.
+    (Request-level TTFT/e2e/queue moved to the SLO plane — obs/slo.py —
+    fed once per request from RequestTracker.finish; the probe keeps
+    the per-token ITL samples a request-level average can't give.)"""
 
     def __init__(self, metrics, model: str):
         self.m = metrics
         self.model = model
-        self.t0 = time.monotonic()
         self.last: Optional[float] = None
 
     def on_delta(self, token_count: int) -> None:
         if token_count <= 0:
             return
         now = time.monotonic()
-        if self.last is None:
-            self.m.observe("dynamo_frontend_ttft_seconds", now - self.t0,
-                           model=self.model)
-        else:
+        if self.last is not None:
             # a burst of n tokens arriving together = n ITL samples of
             # gap/n (token-level spacing, same convention as loadgen)
             per_tok = (now - self.last) / token_count
@@ -330,7 +329,8 @@ class _LatencyProbe:
 class HttpService:
     def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
                  host: str = "0.0.0.0", port: int = 8000,
-                 busy_threshold: Optional[int] = None):
+                 busy_threshold: Optional[int] = None,
+                 slo=None):
         self.runtime = runtime
         self.manager = manager
         self.host = host
@@ -338,6 +338,7 @@ class HttpService:
         self.busy_threshold = busy_threshold
         self.inflight = 0
         self._runner: Optional[web.AppRunner] = None
+        self._slo_task: Optional[asyncio.Task] = None
         from .request_trace import TraceConfig, TraceSink
 
         self.trace_sink = TraceSink(TraceConfig.from_env())
@@ -345,15 +346,18 @@ class HttpService:
         self._m_requests = m
         # latency surface (ref metrics.rs: the reference's frontend
         # exports TTFT/ITL/inflight so routing regressions are diagnosable
-        # from /metrics alone)
+        # from /metrics alone).  Request-level TTFT/e2e/queue histograms
+        # + goodput/burn-rate live on the SLO plane (obs/slo.py), fed
+        # from RequestTracker.finish; the per-token ITL histogram stays
+        # here on the delta-stream probe.
         _lat_buckets = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                         0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
-        m.histogram("dynamo_frontend_ttft_seconds",
-                    "time to first streamed token", ("model",),
-                    buckets=_lat_buckets)
         m.histogram("dynamo_frontend_itl_seconds",
                     "inter-token latency (per-token delta gaps)",
                     ("model",), buckets=_lat_buckets)
+        from ..obs.slo import SloConfig, SloPlane
+
+        self.slo_plane = SloPlane(m, slo or SloConfig())
         self.app = web.Application()
         self.app.router.add_get("/v1/models", self.h_models)
         self.app.router.add_post("/v1/chat/completions", self.h_chat)
@@ -396,6 +400,9 @@ class HttpService:
         )
 
     async def h_metrics(self, request: web.Request) -> web.Response:
+        # age the goodput/burn windows on scrape, so an idle frontend's
+        # gauges roll past a breach instead of freezing on it
+        self.slo_plane.refresh()
         return web.Response(body=self.runtime.metrics.render(),
                             content_type="text/plain")
 
@@ -572,12 +579,21 @@ class HttpService:
 
         tracker = RequestTracker.from_headers(
             request.headers, req.request_id, model, self.trace_sink,
-            session_id=req.session_id,
+            slo=self.slo_plane, session_id=req.session_id,
             endpoint="chat" if chat else "completions",
             input_tokens=len(req.token_ids))
         # mint/propagate the trace context (request_trace.propagate):
         # worker logs and timeline spans join the same trace_id
         tracker.propagate(req)
+        # log<->trace correlation: every log record emitted while this
+        # handler runs carries the trace_id (runtime/logging.py
+        # TraceIdFilter), so log lines, spans, and the request_end
+        # record all join on one id.  Unbound in the finally below:
+        # keep-alive requests share the connection's task context, and
+        # a leaked binding would stamp THIS request's id onto the next
+        # request's logs.  Bound just before the try whose finally
+        # unbinds it — the encoder block below has early returns that
+        # would otherwise leak the binding.
         if req.multimodal and pipeline.encoder is not None:
             # encode here (not inside the pipeline) so usage accounting
             # and conditional disagg see the spliced placeholder tokens
@@ -614,6 +630,7 @@ class HttpService:
         self._m_requests.inc("dynamo_frontend_requests_total", model=model)
         t0 = time.monotonic()
         t_obs = obs.begin()
+        bind_tok = obs.bind_trace_id(tracker.trace_id)
         try:
             if body.get("stream"):
                 return await self._stream_response(
@@ -626,6 +643,7 @@ class HttpService:
         finally:
             obs.end("request", t_obs, trace_id=tracker.trace_id,
                     request_id=req.request_id, model=model)
+            obs.unbind_trace_id(bind_tok)
             self._inflight_delta(-1)
             self._m_requests.observe(
                 "dynamo_frontend_request_duration_seconds",
@@ -896,13 +914,37 @@ class HttpService:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
+        if self.slo_plane.config.targets_set:
+            self._slo_task = asyncio.create_task(self._slo_publish_loop())
         logger.info("HTTP service on %s:%d", self.host, self.port)
         return self
+
+    async def _slo_publish_loop(self) -> None:
+        """Periodic SLO summary onto the event plane, one publish per
+        namespace currently serving models — the planner's SloObserver
+        folds it into SLA tick diag (the item-4 controller's breach
+        input)."""
+        try:
+            while True:
+                await asyncio.sleep(self.slo_plane.config.publish_interval_s)
+                namespaces = {p.mdc.namespace
+                              for p in self.manager.models.values()}
+                if namespaces:
+                    await self.slo_plane.publish(self.runtime, namespaces)
+        except asyncio.CancelledError:
+            pass
 
     async def close(self) -> None:
         # cancel in-flight batch jobs BEFORE tearing the pipelines down
         # (a running batch would keep calling handlers on a dead service)
         await self.extra.close()
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+            try:
+                await self._slo_task
+            except asyncio.CancelledError:
+                pass
+            self._slo_task = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

@@ -74,6 +74,26 @@ class TieredKvManager:
         if self.g3 is not None:
             self.g3.close()
 
+    def occupancy(self) -> dict:
+        """Per-tier block occupancy for /metrics gauges (the engine's
+        kv_occupancy merges this under the g1 allocator's).  G4 is the
+        shared object store: capacity-unbounded (TTL-swept), so only
+        `used` is reported — and counting it lists the shared directory,
+        which is why occupancy() is called from the worker's 0.5s load
+        loop, never from the scheduler step."""
+        out = {"g2": {"used": len(self.g2), "capacity": self.g2.capacity,
+                      "free": max(0, self.g2.capacity - len(self.g2))}}
+        if self.g3 is not None:
+            out["g3"] = {"used": len(self.g3),
+                         "capacity": self.g3.capacity,
+                         "free": max(0, self.g3.capacity - len(self.g3))}
+        if self.g4 is not None:
+            try:
+                out["g4"] = {"used": sum(1 for _ in self.g4.keys())}
+            except OSError:
+                pass  # shared dir raced a sweep; next tick reads it
+        return out
+
     def _mark_dropped(self, h: int) -> None:
         self._dropped[h] = None
         self._dropped.move_to_end(h)

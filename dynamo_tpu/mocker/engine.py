@@ -78,6 +78,23 @@ class MockEngineArgs:
     # in the MDC exactly like the JAX worker, so router/planner tier-1
     # tests cover the 2x-blocks regime without a TPU
     kv_cache_dtype: str = "bf16"
+    # -- simulated device-performance plane (obs satellites) --------------
+    # the first dispatch of each program family emits a `compile` FPM
+    # record of this duration — the exact record shape the JAX engine's
+    # compile watchdog (obs/compile_watch.py) produces — so the
+    # dynamo_engine_compile_seconds{family} histogram and the planner's
+    # compile diag are tier-1 testable CPU-only.  First compiles are
+    # marked serving=False (the warmup analogue); 0 disables.
+    sim_compile_s: float = 0.002
+    # additionally emit a MID-SERVING compile record every N scheduler
+    # steps (serving=True) — drives the planner's recompile-storm diag
+    # and the flight-recorder path in tests; 0 = off
+    sim_recompile_every: int = 0
+    # simulated accelerator peaks: when > 0, prefill/decode FPM records
+    # carry xla_flops/xla_bytes (+ mfu) from the simulated cost model,
+    # so the worker's roofline MFU/MBU gauges light up without a TPU
+    peak_tflops: float = 0.0
+    peak_hbm_gbps: float = 0.0
     # -- fault modes (chaos plane satellites) -----------------------------
     # die (error every stream with the migratable DEATH_ERROR marker,
     # reject everything after) once this many decode tokens have been
@@ -157,6 +174,68 @@ class MockEngine:
         # tests exercise the whole timeline plane CPU-only.  One logical
         # track per engine (several mockers share one event loop).
         self._obs_track = f"sched:{id(self):x}"
+        # simulated device-performance plane: which program families
+        # have "compiled", and the per-phase dispatch-gap clocks for the
+        # prefill/decode FPM records (the JAX engine's record shapes)
+        self._compiled_families: set = set()
+        self._fpm_last_prefill_t = 0.0
+        self._fpm_last_decode_t = 0.0
+
+    # simulated cost model: nominal FLOPs / HBM bytes per token — the
+    # values only need to be self-consistent (gauge math and record
+    # plumbing are what tier-1 asserts, not a real chip's numbers)
+    SIM_FLOPS_PER_TOKEN = 2e9
+    SIM_BYTES_PER_TOKEN = 1e6
+
+    def _sim_compile(self, family: str, tokens: int,
+                     serving: bool = False) -> None:
+        """Emit one compile FPM record (obs/compile_watch.py shape) the
+        first time `family` dispatches — or an explicit mid-serving one
+        (the recompile-storm sim)."""
+        a = self.args
+        if not a.sim_compile_s:
+            return
+        if family in self._compiled_families and not serving:
+            return
+        self._compiled_families.add(family)
+        self.fpm.append({
+            "t": time.monotonic(), "kind": "compile", "family": family,
+            "seconds": a.sim_compile_s, "tokens": tokens,
+            "serving": serving,
+            "flops": tokens * self.SIM_FLOPS_PER_TOKEN,
+            "bytes": tokens * self.SIM_BYTES_PER_TOKEN,
+        })
+
+    def _fpm_dispatch(self, kind: str, tokens: int, lanes: int,
+                      queue_depth: int = 0) -> None:
+        """One prefill/decode FPM record per simulated dispatch — the
+        same fields the JAX engine emits, so FpmWindow derivations,
+        worker gauges, and planner diag run identically against the
+        mocker."""
+        now = time.monotonic()
+        last = (self._fpm_last_prefill_t if kind == "prefill"
+                else self._fpm_last_decode_t)
+        gap = now - last if last else 0.0
+        if gap > 1.0:
+            gap = 0.0  # idle stretch, not dispatch latency
+        flops = tokens * self.SIM_FLOPS_PER_TOKEN
+        rec = {
+            "t": now, "kind": kind, "gap_s": gap,
+            "xla_flops": flops,
+            "xla_bytes": tokens * self.SIM_BYTES_PER_TOKEN,
+        }
+        if kind == "prefill":
+            rec.update(rows=lanes, tokens=tokens, bucket=tokens,
+                       flops=flops, queue_depth=queue_depth, synced=True)
+            if gap > 0.0 and self.args.peak_tflops > 0.0:
+                rec["mfu"] = min(
+                    flops / gap / (self.args.peak_tflops * 1e12), 1.0)
+                rec["est_mfu"] = rec["mfu"]  # sim: one cost model
+            self._fpm_last_prefill_t = now
+        else:
+            rec.update(k=1, lanes=lanes)
+            self._fpm_last_decode_t = now
+        self.fpm.append(rec)
 
     # -- public API -------------------------------------------------------
     def start(self) -> None:
@@ -374,6 +453,7 @@ class MockEngine:
 
         budget = self.args.max_batch_tokens
         prefill_tokens = 0
+        prefill_rows = 0
         decode_seqs: List[_Seq] = []
 
         t_obs = obs.begin()
@@ -389,12 +469,19 @@ class MockEngine:
                     continue
                 seq.prefill_pos += chunk
                 prefill_tokens += chunk
+                prefill_rows += 1
                 budget -= chunk
             else:
                 decode_seqs.append(seq)
         if prefill_tokens:
             obs.end("prefill_dispatch", t_obs, track=self._obs_track,
                     tokens=prefill_tokens)
+            self._sim_compile("prefill", prefill_tokens)
+            self._fpm_dispatch(
+                "prefill", prefill_tokens, lanes=prefill_rows,
+                queue_depth=len(self.waiting) + sum(
+                    1 for s in self.running
+                    if s.prefill_pos < s.num_prompt_tokens))
 
         # simulated step latency
         step_s = (
@@ -511,6 +598,16 @@ class MockEngine:
         if decode_seqs:
             obs.end("decode_dispatch", t_obs, track=self._obs_track,
                     cont=False, k=1, lanes=len(decode_seqs))
+            self._sim_compile("decode", len(decode_seqs))
+            self._fpm_dispatch("decode", len(decode_seqs),
+                               lanes=len(decode_seqs))
+        if (self.args.sim_recompile_every
+                and self.metrics["steps"] % self.args.sim_recompile_every
+                == 0):
+            # simulated recompile storm: a mid-serving compile record
+            # (serving=True — the planner's storm diag input)
+            self._sim_compile("decode", len(decode_seqs) or 1,
+                              serving=True)
         obs.end("step", t_step, track=self._obs_track,
                 active=len(self.running), waiting=len(self.waiting))
 

@@ -99,24 +99,28 @@ class MockerWorker:
             request = PreprocessedRequest.from_dict(payload)
             eng = self.engines[request.dp_rank % len(self.engines)]
             ntok = 0
-            # worker-side request span (same stitching contract as the
-            # JAX engine worker: trace_id from the propagated
-            # traceparent annotation)
+            # log<->trace correlation + worker-side request span (same
+            # contract as the JAX engine worker: trace_id from the
+            # propagated traceparent annotation)
+            bind_tok = obs.bind_trace_id(
+                obs.trace_id_from_annotations(request.annotations))
             t_obs = obs.begin()
-            async for out in eng.generate(request, token=ctx.token):
-                ntok += len(out.token_ids)
-                yield out.to_dict()
-            obs.end("worker_request", t_obs,
-                    trace_id=obs.trace_id_from_annotations(
-                        request.annotations) if t_obs else None,
-                    request_id=request.request_id, tokens=ntok)
-            # trace join (same contract as the JAX engine worker)
-            tp = next((a.split(":", 1)[1] for a in request.annotations
-                       if a.startswith("traceparent:")), None)
-            if tp is not None:
-                logger.info("request served", extra={
-                    "request_id": request.request_id, "traceparent": tp,
-                    "output_tokens": ntok})
+            try:
+                async for out in eng.generate(request, token=ctx.token):
+                    ntok += len(out.token_ids)
+                    yield out.to_dict()
+            finally:
+                obs.end("worker_request", t_obs,
+                        trace_id=obs.trace_id_from_annotations(
+                            request.annotations) if t_obs else None,
+                        request_id=request.request_id, tokens=ntok)
+                tp = next((a.split(":", 1)[1] for a in request.annotations
+                           if a.startswith("traceparent:")), None)
+                if tp is not None:
+                    logger.info("request served", extra={
+                        "request_id": request.request_id,
+                        "traceparent": tp, "output_tokens": ntok})
+                obs.unbind_trace_id(bind_tok)
 
         async def clear_handler(payload, ctx):
             n = 0
@@ -196,9 +200,22 @@ class MockerWorker:
                     steps.append(eng.fpm.popleft())
             for rec in steps:
                 fw.add(self.served.instance_id, rec)
-            acc = fw.spec_acceptance()
-            if acc is not None:
-                m.set("dynamo_engine_spec_acceptance", acc)
+            # same compile histogram + the SHARED gauge surface
+            # (planner/metrics.py export_engine_gauges — one definition
+            # with the JAX worker is what keeps the CPU-only export
+            # byte-name-compatible).  Simulated occupancy: the dp ranks
+            # each own a pool, so g1 sums them.
+            from ..obs.compile_watch import observe_compile_records
+            from ..planner.metrics import export_engine_gauges
+
+            observe_compile_records(m, steps)
+            used = sum(e.cache.used_blocks for e in self.engines)
+            cap = sum(e.cache.num_blocks for e in self.engines)
+            export_engine_gauges(
+                m, fw, peak_tflops=self.args.peak_tflops,
+                peak_hbm_gbps=self.args.peak_hbm_gbps,
+                occupancy={"g1": {"used": used, "free": cap - used,
+                                  "capacity": cap}})
             if steps:
                 try:
                     await self.runtime.event_plane.publish(fpm_subject, {

@@ -351,6 +351,36 @@ def flight_dump(reason: str) -> Optional[str]:
     return tr.flight_dump(reason)
 
 
+# -- log<->trace correlation -------------------------------------------------
+# The frontend binds the request's trace_id for the duration of its
+# handler task; workers bind it around one generate() stream.  The
+# logging filter (runtime/logging.py TraceIdFilter) stamps it onto every
+# record emitted inside that context, so a request's log lines join its
+# spans and request_end record on one id.  ContextVars follow asyncio
+# task context, so concurrent requests never see each other's ids.
+from contextvars import ContextVar as _ContextVar
+
+_TRACE_ID_VAR: "_ContextVar[Optional[str]]" = _ContextVar(
+    "dyn_trace_id", default=None)
+
+
+def bind_trace_id(trace_id: Optional[str]):
+    """Bind `trace_id` to the current (task) context for log
+    correlation; None is a no-op.  Returns a reset token (or None)."""
+    if trace_id is None:
+        return None
+    return _TRACE_ID_VAR.set(trace_id)
+
+
+def unbind_trace_id(token) -> None:
+    if token is not None:
+        _TRACE_ID_VAR.reset(token)
+
+
+def current_trace_id() -> Optional[str]:
+    return _TRACE_ID_VAR.get()
+
+
 def trace_id_from_annotations(annotations) -> Optional[str]:
     """The trace_id the frontend propagated via a
     ``traceparent:00-<trace>-<span>-01`` request annotation — how worker
@@ -387,6 +417,8 @@ __all__ = [
     "STEP_PHASES",
     "Tracer",
     "begin",
+    "bind_trace_id",
+    "current_trace_id",
     "enabled",
     "end",
     "flight_dump",
@@ -394,4 +426,5 @@ __all__ = [
     "span",
     "trace_id_from_annotations",
     "tracer",
+    "unbind_trace_id",
 ]

@@ -1,0 +1,230 @@
+"""Compile watchdog + XLA cost-analysis roofline for the engine's jit
+dispatch sites.
+
+The engine's own comments record *measured* 8-14s guided-fork compiles
+landing mid-serving with zero telemetry — an invisible latency cliff
+that no span, metric, or FPM record could attribute.  This module makes
+every XLA compile an observed event, and harvests each compiled
+program's FLOPs / bytes-accessed so decode, spec-verify, and packed
+prefill all get live MFU *and* memory-bandwidth-utilization instead of
+the hand-counted prefill-only estimate.
+
+Mechanism (no second compile, no steady-state cost):
+
+  * ``WatchedProgram`` wraps a ``jax.jit`` callable.  Per call it reads
+    the pjit C++ cache size before and after — a growth means THIS call
+    traced+compiled a new executable, and the call's wall time is the
+    compile time (jit dispatch is async; only a compiling call blocks).
+    Steady-state overhead is two cache-size reads and two clock reads
+    per dispatch — nanoseconds next to the descriptor uploads the
+    dispatch already does.  Unlike the span tracer there is no off
+    switch: an unobserved mid-serving compile is exactly the blind spot
+    this exists to close, and the steady-state cost is negligible.
+
+  * On a compile event the watchdog re-lowers the traced call on
+    ``jax.ShapeDtypeStruct`` avals (tracing is cached; donated buffers
+    are already consumed but their aval metadata survives) and runs
+    ``Lowered.cost_analysis()`` — XLA's HLO cost analysis, **without**
+    compiling again.  FLOPs and bytes-accessed are stored per
+    (program, token-bucket) so dispatch sites can stamp them onto FPM
+    records with one dict lookup.
+
+  * Every compile emits: a ``compile`` span on the engine's logical
+    track (Perfetto shows the cliff in the timeline), a ``compile`` FPM
+    record (``family``, ``seconds``, ``tokens``, ``flops``, ``bytes``,
+    ``serving``) the worker turns into
+    ``dynamo_engine_compile_seconds{family}`` and the planner's
+    recompile-storm diag, and — when the compile landed **mid-serving**
+    (active sequences exist; warmup compiles don't) — a flight-recorder
+    snapshot plus a warning, because a steady-state recompile means a
+    shape leaked past warmup.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# one place defines the compile FPM record's kind string; engine, mocker,
+# workers, FpmWindow and the report all join on it
+COMPILE_KIND = "compile"
+
+
+def _sds_of(x):
+    """Aval stand-in for one call argument: lowering needs shapes/dtypes
+    only, and a donated (already-deleted) jax.Array keeps its metadata."""
+    import jax
+
+    if x is None or isinstance(x, (bool, int, float)):
+        return x
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+def xla_costs(fn, args) -> Optional[Dict[str, float]]:
+    """FLOPs / bytes-accessed of the program ``fn(*args)`` compiled, via
+    ``Lowered.cost_analysis()`` on aval stand-ins — re-traces (cached)
+    but does NOT re-compile.  None when the backend has no cost model
+    for this program (the roofline is best-effort by design)."""
+    import jax
+
+    try:
+        sds = jax.tree_util.tree_map(_sds_of, args)
+        ca = fn.lower(*sds).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        byts = float(ca.get("bytes accessed", 0.0))
+        if flops <= 0.0 and byts <= 0.0:
+            return None
+        return {"flops": flops, "bytes": byts}
+    except Exception:  # observability must never take down serving
+        logger.debug("xla cost analysis unavailable", exc_info=True)
+        return None
+
+
+class WatchedProgram:
+    """One jit callable under the watchdog.  Call syntax is unchanged;
+    ``cost(key)`` returns the XLA cost entry for the token-bucket key
+    the dispatch site computes (0 for fixed-shape programs)."""
+
+    __slots__ = ("fn", "family", "watch", "tokens_of", "costs")
+
+    def __init__(self, fn, family: str, watch: "CompileWatch",
+                 tokens_of: Optional[Callable] = None):
+        self.fn = fn
+        self.family = family
+        self.watch = watch
+        # tokens_of(args) -> int key grouping compiled variants (e.g. the
+        # prefill bucket = the token array's padded length); None = one
+        # fixed shape per program (decode: always [max_num_seqs])
+        self.tokens_of = tokens_of
+        self.costs: Dict[int, Dict[str, float]] = {}
+
+    def __call__(self, *args):
+        fn = self.fn
+        try:
+            n0 = fn._cache_size()
+        except AttributeError:
+            # not a pjit function (test stand-in): pass through unwatched
+            return fn(*args)
+        t0 = time.monotonic()
+        out = fn(*args)
+        if fn._cache_size() > n0:
+            self.watch.on_compile(self, time.monotonic() - t0, args)
+        return out
+
+    def cost(self, tokens: int = 0) -> Optional[Dict[str, float]]:
+        return self.costs.get(int(tokens))
+
+    def lower(self, *args, **kw):
+        return self.fn.lower(*args, **kw)
+
+
+class CompileWatch:
+    """Per-engine compile observer: counts/times every compile per
+    program family and owns the roofline cost registry."""
+
+    def __init__(self, sink: Optional[Callable[[dict], None]] = None,
+                 track: Optional[str] = None,
+                 serving: Optional[Callable[[], bool]] = None,
+                 cost_analysis: bool = True):
+        self.sink = sink          # fpm ring append (engine.fpm.append)
+        self.track = track        # obs logical track for compile spans
+        self._serving = serving or (lambda: False)
+        self.cost_analysis = cost_analysis
+        self.counts: Dict[str, int] = {}
+        self.seconds: Dict[str, float] = {}
+        self.serving_compiles = 0
+        self.events: deque = deque(maxlen=256)
+
+    def wrap(self, fn, family: str,
+             tokens_of: Optional[Callable] = None):
+        """Wrap one jit callable; None passes through (families gated off
+        for this worker keep their `is None` checks working)."""
+        if fn is None:
+            return None
+        return WatchedProgram(fn, family, self, tokens_of)
+
+    def on_compile(self, wp: WatchedProgram, seconds: float,
+                   args: Tuple[Any, ...]) -> None:
+        t1 = time.monotonic()
+        family = wp.family
+        serving = bool(self._serving())
+        key = 0
+        if wp.tokens_of is not None:
+            try:
+                key = int(wp.tokens_of(args))
+            except Exception:
+                key = 0
+        costs = xla_costs(wp.fn, args) if self.cost_analysis else None
+        if costs is not None:
+            wp.costs[key] = costs
+        self.counts[family] = self.counts.get(family, 0) + 1
+        self.seconds[family] = self.seconds.get(family, 0.0) + seconds
+        if serving:
+            self.serving_compiles += 1
+        ev = {
+            "t": t1, "kind": COMPILE_KIND, "family": family,
+            "seconds": round(seconds, 6), "tokens": key,
+            "serving": serving,
+        }
+        if costs is not None:
+            ev["flops"] = costs["flops"]
+            ev["bytes"] = costs["bytes"]
+        self.events.append(ev)
+        if self.sink is not None:
+            self.sink(dict(ev))
+        from . import flight_dump, tracer
+
+        tr = tracer()
+        if tr is not None:
+            # the span covers the compiling call itself (cost analysis
+            # above ran after it and is not part of the compile)
+            tr.record(COMPILE_KIND, t1 - seconds, t1,
+                      {k: v for k, v in ev.items()
+                       if k not in ("t", "kind")},
+                      None, self.track)
+        if serving:
+            # a compile the warmup didn't cover landed while requests
+            # were in flight: every active stream just stalled behind it
+            logger.warning(
+                "XLA compile of %r (%d tokens) landed mid-serving: "
+                "%.2fs stall", family, key, seconds)
+            flight_dump(f"compile-{family}")
+
+
+# compiles range from ms (CPU test programs) to 8-14s (measured TPU
+# guided forks); the default prometheus buckets top out at 10s
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   20.0, 60.0)
+
+
+def observe_compile_records(metrics, records) -> None:
+    """Fold a drained FPM batch's compile records onto a worker's
+    /metrics: the dynamo_engine_compile_seconds{family} histogram and
+    compile counters.  Shared by the JAX and mocker workers so both
+    export the same families (the plane stays tier-1 testable
+    CPU-only)."""
+    hist = None
+    for rec in records:
+        if rec.get("kind") != COMPILE_KIND:
+            continue
+        if hist is None:
+            hist = metrics.histogram(
+                "dynamo_engine_compile_seconds",
+                "XLA compile wall time per program family", ("family",),
+                buckets=COMPILE_BUCKETS)
+        family = str(rec.get("family", ""))
+        hist.labels(**metrics.labels, family=family).observe(
+            float(rec.get("seconds", 0.0)))
+        metrics.inc("dynamo_engine_compiles_total", 1.0,
+                    "XLA compiles per program family", family=family)
+        if rec.get("serving"):
+            metrics.inc("dynamo_engine_serving_compiles_total", 1.0,
+                        "compiles that landed while requests were "
+                        "in flight (each one is a serving stall)",
+                        family=family)

@@ -303,6 +303,90 @@ class FpmWindow:
                     break
         return total
 
+    # -- roofline (obs/compile_watch.py cost-analysis fields) -------------
+    _PHASE_GATES = {
+        # prefill gaps measure device time only when a blocking fetch
+        # landed inside (the engine marks those `synced`); decode and
+        # spec-verify gaps are device time whenever plausible (decode:
+        # saturated pipeline convention; spec: the verify fetch blocks)
+        "prefill": lambda rec: rec.get("synced"),
+        "decode": lambda rec: True,
+        "spec_verify": lambda rec: True,
+    }
+
+    def _phase_rates(self, kind: str):
+        """(flops/s, bytes/s) for one dispatch kind over the window,
+        from the records' XLA cost-analysis fields — per-worker
+        Σcost/Σgap summed across workers, same gap plausibility gates
+        as the token-rate derivations.  (0, 0) when nothing qualifies."""
+        gate = self._PHASE_GATES.get(kind, lambda rec: True)
+        flops_rate = bytes_rate = 0.0
+        for dq in self._window().values():
+            flops = byts = gaps = 0.0
+            for _, rec in dq:
+                if rec.get("kind") != kind or "xla_flops" not in rec:
+                    continue
+                gap = float(rec.get("gap_s", 0.0))
+                if not 0.0 < gap < 1.0 or not gate(rec):
+                    continue
+                flops += float(rec["xla_flops"])
+                byts += float(rec.get("xla_bytes", 0.0))
+                gaps += gap
+            if gaps > 0.0:
+                flops_rate += flops / gaps
+                bytes_rate += byts / gaps
+        return flops_rate, bytes_rate
+
+    def phase_mfu(self, kind: str, peak_tflops: float) -> float:
+        """Window MFU for one dispatch kind from XLA cost-analysis FLOPs
+        (fleet flops/s over the accelerator peak, clamped to 1.0).  0.0
+        when the peak is unknown or nothing in the window carries
+        costs — decode and spec-verify get a live MFU here for the
+        first time (the hand count only ever covered prefill)."""
+        if peak_tflops <= 0.0:
+            return 0.0
+        flops_rate, _ = self._phase_rates(kind)
+        return min(flops_rate / (peak_tflops * 1e12), 1.0) \
+            if flops_rate else 0.0
+
+    def phase_mbu(self, kind: str, peak_hbm_gbps: float) -> float:
+        """Window memory-bandwidth utilization for one dispatch kind
+        (cost-analysis bytes-accessed over peak HBM bandwidth) — the
+        binding roofline axis for decode, which is bandwidth-bound long
+        before it is FLOPs-bound."""
+        if peak_hbm_gbps <= 0.0:
+            return 0.0
+        _, bytes_rate = self._phase_rates(kind)
+        return min(bytes_rate / (peak_hbm_gbps * 1e9), 1.0) \
+            if bytes_rate else 0.0
+
+    def compile_stats(self) -> dict:
+        """Compile events in the window (obs/compile_watch.py records):
+        total count, how many landed mid-serving, and per-family
+        count/seconds/serving.  The planner surfaces this per tick —
+        repeated steady-state compiles are a recompile storm (a shape
+        leaking past warmup) stalling the fleet invisibly to token
+        metrics; the per-family `serving` split is what lets the storm
+        diag name the guilty family instead of a restarting worker's
+        innocent warmup programs."""
+        families: Dict[str, dict] = {}
+        total = serving = 0
+        for dq in self._window().values():
+            for _, rec in dq:
+                if rec.get("kind") != "compile":
+                    continue
+                total += 1
+                fam = str(rec.get("family", ""))
+                f = families.setdefault(
+                    fam, {"count": 0, "seconds": 0.0, "serving": 0})
+                f["count"] += 1
+                f["seconds"] = round(
+                    f["seconds"] + float(rec.get("seconds", 0.0)), 6)
+                if rec.get("serving"):
+                    serving += 1
+                    f["serving"] += 1
+        return {"total": total, "serving": serving, "families": families}
+
     def decode_tokens_per_s(self) -> float:
         """Fleet decode token rate over the window: with the pipeline
         saturated a decode record's gap covers k steps for every lane,
@@ -323,6 +407,49 @@ class FpmWindow:
             if toks and gaps > 0.0:
                 total_rate += toks / gaps
         return total_rate
+
+
+def export_engine_gauges(metrics, fw: FpmWindow, peak_tflops: float = 0.0,
+                         peak_hbm_gbps: float = 0.0,
+                         occupancy: Optional[dict] = None) -> None:
+    """One shared /metrics gauge surface for BOTH workers' load loops
+    (engine/worker.py, mocker/worker.py): the headline FPM aggregates,
+    the per-phase roofline MFU/MBU, and KV occupancy by tier.  A single
+    definition is what keeps the mocker's CPU-only export byte-name-
+    compatible with the JAX worker — the parity the scrape-contract
+    test pins."""
+    metrics.set("dynamo_engine_prefill_mfu", fw.prefill_mfu(peak_tflops))
+    metrics.set("dynamo_engine_prefill_queue_depth",
+                fw.prefill_queue_depth())
+    metrics.set("dynamo_engine_prefill_tokens_per_s",
+                fw.prefill_tokens_per_s())
+    metrics.set("dynamo_engine_decode_tokens_per_s",
+                fw.decode_tokens_per_s())
+    acc = fw.spec_acceptance()
+    if acc is not None:
+        metrics.set("dynamo_engine_spec_acceptance", acc)
+    # roofline: gate on the PEAK being configured, not on the value —
+    # an idle window must drive the gauge to 0.0, or a dashboard reads
+    # the last busy minute's utilization forever.  One window scan per
+    # phase serves BOTH gauges (_phase_rates returns the pair; calling
+    # phase_mfu + phase_mbu would scan twice).
+    for phase in ("prefill", "decode", "spec_verify"):
+        if peak_tflops <= 0.0 and peak_hbm_gbps <= 0.0:
+            continue
+        flops_rate, bytes_rate = fw._phase_rates(phase)
+        if peak_tflops > 0.0:
+            metrics.set("dynamo_engine_mfu",
+                        min(flops_rate / (peak_tflops * 1e12), 1.0),
+                        phase=phase)
+        if peak_hbm_gbps > 0.0:
+            metrics.set("dynamo_engine_mbu",
+                        min(bytes_rate / (peak_hbm_gbps * 1e9), 1.0),
+                        phase=phase)
+    for tier, occ in (occupancy or {}).items():
+        for state in ("used", "free", "capacity"):
+            if state in occ:
+                metrics.set(f"dynamo_engine_kv_blocks_{state}",
+                            occ[state], tier=tier)
 
 
 class FpmObserver(FpmWindow):
@@ -371,3 +498,85 @@ class FpmObserver(FpmWindow):
                     self.add(w, rec)
         except asyncio.CancelledError:
             pass
+
+
+@dataclass
+class SloSample:
+    goodput: float = 1.0
+    max_burn: float = 0.0
+    requests: int = 0
+    seen_t: float = field(default_factory=time.monotonic)
+
+
+class SloObserver:
+    """Frontend SLO telemetry consumer: frontends publish their rolling
+    goodput / burn-rate summary on ``slo_metrics.{namespace}``
+    (obs/slo.py SloPlane.publish) and the planner reads the aggregate
+    into its tick diag — the SLA controller's breach signal, observed at
+    the only place TTFT/ITL are really measured (the client-facing
+    edge), not inferred from worker-side proxies."""
+
+    def __init__(self, runtime, namespace: str, stale_after_s: float = 10.0):
+        self.runtime = runtime
+        self.subject = f"slo_metrics.{namespace}"
+        self.stale_after_s = stale_after_s
+        self.samples: Dict[int, SloSample] = {}
+        self._cancel = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "SloObserver":
+        self._task = asyncio.create_task(self._loop())
+        return self
+
+    async def close(self) -> None:
+        self._cancel.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            async for subj, payload in self.runtime.event_plane.subscribe(
+                self.subject, cancel=self._cancel
+            ):
+                if subj != self.subject:
+                    continue
+                fid = payload.get("frontend_id")
+                if fid is None:
+                    continue
+                burns = payload.get("burn") or {}
+                self.samples[fid] = SloSample(
+                    goodput=float(payload.get("goodput", 1.0)),
+                    max_burn=max((float(v) for v in burns.values()),
+                                 default=0.0),
+                    requests=int(payload.get("requests", 0)),
+                )
+        except asyncio.CancelledError:
+            pass
+
+    def aggregate(self) -> Optional[dict]:
+        """Request-weighted goodput and worst burn rate across live
+        frontends; None when no frontend reported recently (an SLO
+        plane that is off must not read as 'all requests good')."""
+        now = time.monotonic()
+        for fid in [f for f, s in self.samples.items()
+                    if now - s.seen_t > self.stale_after_s]:
+            del self.samples[fid]
+        live = list(self.samples.values())
+        if not live:
+            return None
+        total = sum(s.requests for s in live)
+        if total:
+            goodput = sum(s.goodput * s.requests for s in live) / total
+        else:
+            goodput = min(s.goodput for s in live)
+        return {
+            "goodput": round(goodput, 4),
+            "max_burn": round(max(s.max_burn for s in live), 4),
+            "requests": total,
+            "frontends": len(live),
+        }

@@ -27,7 +27,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .connectors import Connector
-from .metrics import FpmObserver, LoadObserver
+from .metrics import FpmObserver, LoadObserver, SloObserver
 from .predictor import make_predictor
 
 logger = logging.getLogger(__name__)
@@ -71,6 +71,12 @@ class Planner:
         self.fpm: Optional[FpmObserver] = (
             FpmObserver(runtime, namespace, component)
             if self.config.consume_fpm else None)
+        # frontend SLO telemetry (obs/slo.py publish): goodput/burn-rate
+        # measured at the client edge — the breach signal the SLA
+        # controller actuates on (ROADMAP item 4's observation input)
+        self.slo: Optional[SloObserver] = (
+            SloObserver(runtime, namespace) if runtime is not None
+            else None)
         self.predictor = make_predictor(self.config.predictor,
                                         self.config.predictor_window)
         # second forecast stream for SLA mode: request arrival rate
@@ -91,6 +97,10 @@ class Planner:
         self._task: Optional[asyncio.Task] = None
         self._last_action_t = 0.0
         self._low_ticks = 0
+        # serving-compile count at the last storm warning: re-warn only
+        # when NEW mid-serving compiles appear, not per tick while one
+        # event ages through the FPM window
+        self._storm_warned = 0
         # audit trail (observability); bounded like the predictor window
         self.decisions: deque = deque(maxlen=256)
 
@@ -98,6 +108,8 @@ class Planner:
         await self.observer.start()
         if self.fpm is not None:
             await self.fpm.start()
+        if self.slo is not None:
+            await self.slo.start()
         self._task = asyncio.create_task(self._loop())
         return self
 
@@ -111,6 +123,8 @@ class Planner:
             self._task = None
         if self.fpm is not None:
             await self.fpm.close()
+        if self.slo is not None:
+            await self.slo.close()
         await self.observer.close()
 
     async def _loop(self) -> None:
@@ -227,6 +241,41 @@ class Planner:
             spec = self.fpm.spec_acceptance()
             if spec is not None:
                 diag["spec_acceptance"] = spec
+            # compile watchdog off the same stream: steady-state
+            # recompiles stall every in-flight request for the compile's
+            # full wall time while staying invisible to token metrics —
+            # repeated serving-time compiles in one window are a storm
+            # (a shape leaking past warmup) the operator must see here
+            comp = self.fpm.compile_stats()
+            if comp["total"]:
+                diag["compiles"] = comp["families"]
+            if comp["serving"]:
+                diag["recompile_storm"] = {
+                    "serving_compiles": comp["serving"],
+                    # only families whose compiles landed MID-SERVING:
+                    # a restarting worker's warmup programs share the
+                    # window and must not be named as culprits
+                    "families": sorted(
+                        f for f, v in comp["families"].items()
+                        if v.get("serving")),
+                }
+                # warn when NEW serving compiles appeared, not on every
+                # tick the same event spends inside the 20s window
+                if comp["serving"] > self._storm_warned:
+                    logger.warning(
+                        "planner: %d compile(s) landed mid-serving "
+                        "this window (%s) — warmup is not covering a "
+                        "served shape", comp["serving"],
+                        diag["recompile_storm"])
+                self._storm_warned = comp["serving"]
+            else:
+                self._storm_warned = 0
+        # frontend SLO plane: goodput/burn measured at the client edge —
+        # the direct breach signal next to the worker-side capacity math
+        slo = self.slo.aggregate() if self.slo is not None else None
+        if slo is not None:
+            diag["slo_goodput"] = slo["goodput"]
+            diag["slo_burn"] = slo["max_burn"]
 
         # decode bound: ITL capacity when targeted, else the load-mode
         # constant — an arrival lull must never scale away a fleet that is

@@ -26,6 +26,24 @@ _STD_KEYS = frozenset(logging.LogRecord(
                                              "taskName"}
 
 
+class TraceIdFilter(logging.Filter):
+    """Log<->trace correlation: stamp the context-bound trace_id
+    (obs.bind_trace_id — the frontend binds it per request handler,
+    workers per generate() stream) onto every record, so a request's
+    log lines are greppable by the same id that joins its timeline
+    spans and its request_end record.  Explicit `extra={"trace_id":}`
+    on a call wins over the ambient context."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "trace_id"):
+            from .. import obs
+
+            tid = obs.current_trace_id()
+            if tid is not None:
+                record.trace_id = tid
+        return True
+
+
 class JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out = {
@@ -69,7 +87,10 @@ def setup_logging(level: Optional[int] = None,
         for h in root.handlers:
             if json_lines != isinstance(h.formatter, JsonFormatter):
                 h.setFormatter(formatter())
+            if not any(isinstance(f, TraceIdFilter) for f in h.filters):
+                h.addFilter(TraceIdFilter())
         return
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(formatter())
+    handler.addFilter(TraceIdFilter())
     root.addHandler(handler)

@@ -8,6 +8,7 @@ seconds on CPU — cheap enough for tier-1, and it catches exactly the
 drift class that cost round 5 a bench session."""
 
 import glob
+import json
 import os
 import subprocess
 import sys
@@ -40,3 +41,38 @@ def test_bench_help_exits_zero(path):
         # the timeline-tracing hook (obs/): --trace-out records the run
         # and prints the gap-attribution line
         assert "--trace-out" in r.stdout
+        # SLO plane flags (obs/slo.py vocabulary, ms like the frontend)
+        assert "--slo-ttft-ms" in r.stdout
+        assert "--slo-itl-ms" in r.stdout
+
+
+def test_bench_serving_json_carries_slo_and_roofline_blocks():
+    """The bench JSON schema's `slo` + `roofline` blocks must actually
+    serialize from a (tiny, sped-up) run: the scoreboard the rounds are
+    diffed on, not just flags in --help."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_serving.py"),
+         "--requests", "8", "--rate", "40", "--speedup", "20",
+         "--workers", "2", "--slo-ttft-ms", "2000", "--slo-itl-ms", "25"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    reps = [json.loads(line) for line in r.stdout.splitlines()
+            if line.startswith("{")]
+    configs = {rep["config"] for rep in reps}
+    assert any(c.startswith("agg-") for c in configs), configs
+    assert any(c.startswith("disagg-") for c in configs), configs
+    for rep in reps:
+        if rep["config"] == "trace":
+            continue
+        # ms flags override the seconds-based defaults
+        assert rep["slo"]["ttft_s"] == 2.0
+        assert rep["slo"]["itl_s"] == 0.025
+        assert 0.0 <= rep["slo"]["goodput"] <= 1.0
+        roof = rep["roofline"]
+        # the mocker sim compiled prefill+decode and the gauges lit up
+        assert roof["compiles"].get("prefill", 0) >= 1
+        assert roof["compiles"].get("decode", 0) >= 1
+        assert "decode" in roof["mfu"] and "decode" in roof["mbu"]

@@ -150,6 +150,8 @@ def _sla_planner(cfg, conn, pm):
     p.connector = conn
     p.observer = _FakeObserver()
     p.fpm = None
+    p.slo = None
+    p._storm_warned = 0
     p.predictor = make_predictor("constant")
     p.rate_predictor = make_predictor("constant")
     p.perf_model = pm
