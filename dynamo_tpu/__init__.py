@@ -25,6 +25,9 @@ Layer map (mirrors reference layers L0..L8, see SURVEY.md §1):
                 (ref: lib/kvbm-*)
   planner/    — SLA autoscaler OBSERVE→PREDICT→PROPOSE→EXECUTE
                 (ref: components/src/dynamo/planner)
+  lint/       — "dynlint": AST project lint turning shipped bug classes
+                into enforced invariants (the rustc/clippy analogue the
+                reference leans on; tier-1 gate in tests/test_lint.py)
 """
 
 __version__ = "0.1.0"

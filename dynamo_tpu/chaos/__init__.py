@@ -77,6 +77,24 @@ logger = logging.getLogger(__name__)
 # executed by hit()/ahit() themselves
 ACTIONS = ("fail", "delay", "wedge", "drop", "truncate")
 
+# THE canonical seam registry: every hit()/ahit() call site names one of
+# these, ChaosPlane.rule() rejects anything else, and the DYN006 lint
+# (lint/rules.py) checks the literals at the call sites statically.  A
+# typo'd seam in a scenario used to be a rule that silently never fired
+# — the scenario "passed" by injecting nothing; now it is a loud
+# ValueError at rule() time and a lint finding at the seam site.  Keep
+# this set, the docstring registry above, and the wired call sites in
+# lockstep when adding a seam.
+SEAMS = frozenset({
+    "request_plane.dispatch",
+    "request_plane.frame",
+    "discovery.op",
+    "discovery.lease",
+    "disagg.pull.chunk",
+    "kvbm.remote_pull",
+    "engine.step",
+})
+
 # how long a "wedge" blocks when no delay_s is given: effectively
 # forever at test/canary timescales, finite so a wedged thread can
 # still unwind on interpreter shutdown
@@ -145,6 +163,11 @@ class ChaosPlane:
              match: str = "") -> "ChaosPlane":
         if action not in ACTIONS:
             raise ValueError(f"unknown chaos action {action!r}")
+        if seam not in SEAMS:
+            raise ValueError(
+                f"unknown chaos seam {seam!r}: a rule on an unregistered "
+                f"seam would silently never fire; known seams: "
+                f"{sorted(SEAMS)}")
         r = Rule(seam=seam, action=action, p=p, after=after, times=times,
                  delay_s=delay_s, error=error, match=match)
         # deterministic per-rule stream: seed ⊕ rule identity.  The
@@ -264,6 +287,7 @@ async def ahit(seam: str, key: Optional[str] = None) -> Optional[str]:
 
 __all__ = [
     "ACTIONS",
+    "SEAMS",
     "ChaosError",
     "ChaosPlane",
     "Injection",

@@ -293,27 +293,55 @@ class JaxEngine:
                 self.params = shard_params(params, self.mesh)
             self.kv = self._init_kv_cache()
 
+        # compile watchdog + roofline (obs/compile_watch.py) is
+        # constructed FIRST so every jit below is a WatchedProgram from
+        # the moment it exists — a compile (warmup or the mid-serving
+        # kind the guided fork measured at 8-14s) is counted, timed,
+        # span-recorded, and costed with XLA's own cost_analysis
+        # (per-program FLOPs/bytes feed the decode/spec-verify/
+        # packed-prefill MFU+MBU gauges).  Wrap-at-definition is the
+        # DYN001 lint invariant: a raw jax.jit that dispatches unwatched
+        # cannot be written here without a suppression.  Wrapper
+        # overhead per dispatch is two C++ cache-size reads.
+        from ..obs.compile_watch import CompileWatch
+
+        # timeline tracing (obs/): steps run on whatever pool thread
+        # asyncio.to_thread picked, but the step lock serializes them —
+        # pin every step-phase span (and compile spans) to ONE logical
+        # track per engine so the report's innermost-span attribution
+        # sees a well-nested timeline (co-resident engines in one
+        # process stay distinct)
+        self._obs_track = f"sched:{id(self):x}"
+        self.compile_watch = CompileWatch(
+            sink=lambda rec: self.fpm.append(rec),
+            track=self._obs_track,
+            serving=lambda: any(s is not None for s in self._slots),
+        )
+        w = self.compile_watch
+        _toks2 = lambda a: a[2].shape[-1]           # noqa: E731
+        _toks2_total = lambda a: int(               # noqa: E731
+            np.prod(a[2].shape))
         # decode variants: {greedy: jitted} — an all-greedy batch takes the
         # argmax specialization (sampling machinery measurably costs on
         # large vocabs even top-k-capped)
         # donate kv + the advancing descriptor arrays (positions/ctx/steps
         # are returned advanced for the next burst's continuation)
         self._jit_decode = {
-            g: jax.jit(
+            g: w.wrap(jax.jit(
                 partial(self._decode_impl, self.family, self.model_cfg,
                         self.mesh, g),
                 donate_argnums=(1, 5, 7, 9),
-            )
+            ), "decode")
             for g in (False, True)
         }
-        self._jit_prefill = jax.jit(
+        self._jit_prefill = w.wrap(jax.jit(
             partial(self._prefill_impl, self.family, self.model_cfg),
             donate_argnums=(1,),
-        )
-        self._jit_prefill_batched = jax.jit(
+        ), "prefill", _toks2)
+        self._jit_prefill_batched = w.wrap(jax.jit(
             partial(self._prefill_batched_impl, self.family, self.model_cfg),
             donate_argnums=(1,),
-        )
+        ), "prefill_batched", _toks2_total)
         # packed chunked prefill (engine/prefill.py planner +
         # ops/packed_prefill.py): the padding-free multi-sequence path.
         # Gated off for families without prefill_packed (MLA) and for
@@ -332,22 +360,22 @@ class JaxEngine:
         # including prefill_packed
         self._jit_prefill_packed = None
         if hasattr(self.family, "prefill_packed"):
-            self._jit_prefill_packed = jax.jit(
+            self._jit_prefill_packed = w.wrap(jax.jit(
                 partial(self._prefill_packed_impl, self.family,
                         self.model_cfg),
                 donate_argnums=(1,),
-            )
+            ), "prefill_packed", _toks2)
         # speculative decoding (spec/): like prefill_packed, the verify
         # jit exists whenever the FAMILY supports it — a multi-host
         # follower replays whatever step kinds its leader broadcasts,
         # spec_verify included, regardless of this worker's own config
         self._jit_spec_verify = None
         if hasattr(self.family, "spec_verify_packed"):
-            self._jit_spec_verify = jax.jit(
+            self._jit_spec_verify = w.wrap(jax.jit(
                 partial(self._spec_verify_impl, self.family,
                         self.model_cfg),
                 donate_argnums=(1,),
-            )
+            ), "spec_verify", _toks2)
         self.proposer = None
         self._spec_ok = False
         if config.spec_decode != "off":
@@ -370,7 +398,11 @@ class JaxEngine:
                         "use spec_decode='ngram' on multi-host slices")
                 from ..spec import make_proposer
 
-                self.proposer = make_proposer(config, self.mesh)
+                # the draft model's own prefill/propose programs are jit
+                # dispatch sites like any other: watched, so a draft
+                # recompile mid-serving is as visible as a target one
+                self.proposer = make_proposer(config, self.mesh,
+                                              compile_watch=w)
                 self._spec_ok = True
         # slot indexes that speculated this scheduler step (they emitted
         # synchronously and must skip the pipelined decode dispatch)
@@ -393,70 +425,26 @@ class JaxEngine:
         # beyond the largest bucket when the mesh has an sp axis
         self._jit_prefill_ring = None
         if config.sp > 1 and hasattr(self.family, "prefill_ring"):
-            self._jit_prefill_ring = jax.jit(
+            self._jit_prefill_ring = w.wrap(jax.jit(
                 partial(self._prefill_ring_impl, self.family,
                         self.model_cfg, self.mesh),
                 donate_argnums=(1,),
-            )
-        self._jit_inject = jax.jit(self._inject_impl, donate_argnums=(0,))
-        self._jit_gather = jax.jit(self._gather_impl)
+            ), "prefill_ring", _toks2)
+        self._jit_inject = w.wrap(
+            jax.jit(self._inject_impl, donate_argnums=(0,)), "inject",
+            lambda a: a[3].shape[0])
+        self._jit_gather = w.wrap(
+            jax.jit(self._gather_impl), "gather", lambda a: a[1].shape[0])
         self._jit_decode_multi = None
         if config.decode_fused_steps > 1:
             self._jit_decode_multi = {
-                g: jax.jit(
+                g: w.wrap(jax.jit(
                     partial(self._decode_multi_impl, self.family,
                             self.model_cfg, self.mesh, g,
                             config.decode_fused_steps),
                     donate_argnums=(1, 5, 7, 9),
-                )
+                ), "decode_multi")
                 for g in (False, True)
-            }
-        # compile watchdog + roofline (obs/compile_watch.py): every jit
-        # dispatch site below goes through a WatchedProgram so a compile
-        # — warmup or the mid-serving kind the guided fork measured at
-        # 8-14s — is counted, timed, span-recorded, and costed with
-        # XLA's own cost_analysis (per-program FLOPs/bytes feed the
-        # decode/spec-verify/packed-prefill MFU+MBU gauges, replacing
-        # the hand-counted prefill-only estimate where available).
-        # Wrapper overhead per dispatch is two C++ cache-size reads.
-        from ..obs.compile_watch import CompileWatch
-
-        # timeline tracing (obs/): steps run on whatever pool thread
-        # asyncio.to_thread picked, but the step lock serializes them —
-        # pin every step-phase span (and compile spans) to ONE logical
-        # track per engine so the report's innermost-span attribution
-        # sees a well-nested timeline (co-resident engines in one
-        # process stay distinct)
-        self._obs_track = f"sched:{id(self):x}"
-        self.compile_watch = CompileWatch(
-            sink=lambda rec: self.fpm.append(rec),
-            track=self._obs_track,
-            serving=lambda: any(s is not None for s in self._slots),
-        )
-        w = self.compile_watch
-        _toks2 = lambda a: a[2].shape[-1]           # noqa: E731
-        _toks2_total = lambda a: int(               # noqa: E731
-            np.prod(a[2].shape))
-        self._jit_decode = {
-            g: w.wrap(fn, "decode") for g, fn in self._jit_decode.items()
-        }
-        self._jit_prefill = w.wrap(self._jit_prefill, "prefill", _toks2)
-        self._jit_prefill_batched = w.wrap(
-            self._jit_prefill_batched, "prefill_batched", _toks2_total)
-        self._jit_prefill_packed = w.wrap(
-            self._jit_prefill_packed, "prefill_packed", _toks2)
-        self._jit_spec_verify = w.wrap(
-            self._jit_spec_verify, "spec_verify", _toks2)
-        self._jit_prefill_ring = w.wrap(
-            self._jit_prefill_ring, "prefill_ring", _toks2)
-        self._jit_inject = w.wrap(self._jit_inject, "inject",
-                                  lambda a: a[3].shape[0])
-        self._jit_gather = w.wrap(self._jit_gather, "gather",
-                                  lambda a: a[1].shape[0])
-        if self._jit_decode_multi is not None:
-            self._jit_decode_multi = {
-                g: w.wrap(fn, "decode_multi")
-                for g, fn in self._jit_decode_multi.items()
             }
 
         # continuation decode (steady state): the burst descriptor lives on
@@ -474,6 +462,10 @@ class JaxEngine:
 
         self.waiting: List[_Slot] = []
         self._sched_calls: List[tuple] = []  # (fn, future) run between steps
+        # async KV-event sink dispatches in flight: the loop only holds a
+        # weak ref to a task, so fire-and-forget publications could be
+        # gc'd mid-flight with their exceptions never observed (DYN005)
+        self._event_tasks: set = set()
         self._parked: Dict[str, _Parked] = {}
         self.parked_ttl_s = 120.0
         # identity advertised in kv_transfer_params (set by the worker)
@@ -530,8 +522,10 @@ class JaxEngine:
         k_shape, v_shape = self.family.kv_cache_shapes(
             m, c.num_blocks, c.block_size)
         k_spec, v_spec = self.family.kv_cache_specs()
+        # dynlint: disable=DYN001 one-shot sharded-zeros allocation at init, never dispatched while serving
         k = jax.jit(partial(jnp.zeros, k_shape, dtype),
                     out_shardings=NamedSharding(self.mesh, k_spec))()
+        # dynlint: disable=DYN001 one-shot sharded-zeros allocation at init, never dispatched while serving
         v = jax.jit(partial(jnp.zeros, v_shape, dtype),
                     out_shardings=NamedSharding(self.mesh, v_spec))()
         if self.kv_dtype != "int8":
@@ -539,8 +533,10 @@ class JaxEngine:
         ks_shape, vs_shape = self.family.kv_cache_scale_shapes(
             m, c.num_blocks, c.block_size)
         ks_spec, vs_spec = self.family.kv_cache_scale_specs()
+        # dynlint: disable=DYN001 one-shot sharded-zeros allocation at init, never dispatched while serving
         ks = jax.jit(partial(jnp.zeros, ks_shape, jnp.float32),
                      out_shardings=NamedSharding(self.mesh, ks_spec))()
+        # dynlint: disable=DYN001 one-shot sharded-zeros allocation at init, never dispatched while serving
         vs = jax.jit(partial(jnp.zeros, vs_shape, jnp.float32),
                      out_shardings=NamedSharding(self.mesh, vs_spec))()
         return (k, v, ks, vs)
@@ -1206,7 +1202,9 @@ class JaxEngine:
         def dispatch():
             r = call()
             if inspect.isawaitable(r):
-                asyncio.ensure_future(r)
+                from ..runtime.aio import spawn_retained
+
+                spawn_retained(r, self._event_tasks)
 
         if self._loop_ref is not None:
             self._loop_ref.call_soon_threadsafe(dispatch)

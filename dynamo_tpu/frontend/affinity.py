@@ -30,6 +30,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
+from ..runtime.aio import spawn_retained
+
 logger = logging.getLogger(__name__)
 
 # ref session_affinity/mod.rs limits
@@ -118,6 +120,9 @@ class AffinityCoordinator:
         self.metrics = metrics
         self._reaper: Optional[asyncio.Task] = None
         self._sync_pub = None  # async callable(payload) | None
+        # replica-sync publications in flight: the loop weak-refs tasks,
+        # so an unreferenced publish could be gc'd mid-send (DYN005)
+        self._pub_tasks: set = set()
         self._closed = False
 
     # -- lifecycle --------------------------------------------------------
@@ -281,7 +286,7 @@ class AffinityCoordinator:
 
     def _publish(self, payload: dict) -> None:
         if self._sync_pub is not None:
-            asyncio.get_running_loop().create_task(self._sync_pub(payload))
+            spawn_retained(self._sync_pub(payload), self._pub_tasks)
 
     def _count(self, what: str) -> None:
         if self.metrics is not None:

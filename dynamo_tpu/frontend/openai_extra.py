@@ -109,7 +109,11 @@ class FileStore:
         loop = asyncio.get_running_loop()
         n = 0
         try:
-            with open(tmp, "wb") as f:
+            # open/close join the writes in the executor: creating (and
+            # flushing, on close) a file on a slow disk is sync I/O the
+            # event loop must not absorb either (DYN004)
+            f = await loop.run_in_executor(None, open, tmp, "wb")
+            try:
                 while True:
                     chunk = await part.read_chunk(self.UPLOAD_CHUNK)
                     if not chunk:
@@ -118,6 +122,8 @@ class FileStore:
                     if n > self.max_upload_bytes:
                         raise UploadTooLarge(self.max_upload_bytes)
                     await loop.run_in_executor(None, f.write, chunk)
+            finally:
+                await loop.run_in_executor(None, f.close)
         except BaseException:
             self.discard_staged(tmp)
             raise

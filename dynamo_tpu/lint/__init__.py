@@ -1,0 +1,60 @@
+"""dynlint — AST-based project lint enforcing this repo's shipped-bug
+invariants.
+
+Three of the worst bugs in this repo's history were invariant violations
+invisible to pytest: a raw ``jax.jit`` in guided decoding that bypassed
+the compile watchdog (PR 7), builtin ``hash()`` used for cross-process
+token-replay identity (PR 4 — PYTHONHASHSEED broke migration), and
+drain-marker literals duplicated across engines (PR 4 — a reword would
+silently break real-engine migration while mocker tests stayed green).
+The reference stack leans on rustc + clippy for this class of hot-path
+contract enforcement; dynlint is the Python/JAX rebuild's equivalent —
+each rule (DYN001–DYN010, rules.py) is distilled from a bug that
+actually shipped, and the tier-1 gate (tests/test_lint.py) fails on any
+new unsuppressed finding repo-wide.
+
+Layout:
+  core.py     — Module/Finding/registry, per-line suppression comments
+                (``dynlint: disable=DYNxxx`` + a mandatory reason)
+  rules.py    — the rule set
+  baseline.py — grandfathered findings (stale entries fail the gate)
+  cli.py      — ``python -m dynamo_tpu.lint [paths] [--json]``
+
+Pure stdlib (``ast``); importing this package never imports jax, so the
+lint runs anywhere the repo checks out.
+"""
+
+from .baseline import apply as apply_baseline
+from .baseline import load as load_baseline
+from .baseline import render as render_baseline
+from .core import (
+    RULES,
+    Finding,
+    LintResult,
+    Module,
+    check_module,
+)
+from .cli import main, run_paths
+from . import rules as _rules  # noqa: F401  — populate RULES at import
+
+
+def run_source(source: str, path: str = "dynamo_tpu/snippet.py",
+               rules=None):
+    """Lint one source string as if it lived at `path` (rule scoping is
+    path-based) — the fixture-test entrypoint."""
+    return check_module(Module(source, path), rules)
+
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "LintResult",
+    "Module",
+    "apply_baseline",
+    "check_module",
+    "load_baseline",
+    "main",
+    "render_baseline",
+    "run_paths",
+    "run_source",
+]

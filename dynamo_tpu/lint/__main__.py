@@ -1,0 +1,12 @@
+import sys
+
+from .cli import main
+
+try:
+    sys.exit(main())
+except BrokenPipeError:
+    # stdout went away mid-report (`... | head`): suppress the
+    # traceback, but the gate's verdict was NOT delivered — exit
+    # non-zero so a pipefail CI step never reads a truncated report as
+    # a clean run (128+SIGPIPE, the conventional code)
+    sys.exit(141)

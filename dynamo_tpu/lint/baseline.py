@@ -1,0 +1,83 @@
+"""dynlint baseline: grandfathered findings, checked in at the repo root.
+
+Semantics (kept deliberately strict so the baseline shrinks and never
+silently grows):
+
+  * One line per grandfathered finding, ``RULE|path|stripped source
+    line`` — the same key as :attr:`core.Finding.key`.  Keys are
+    line-CONTENT based, so unrelated edits above a finding do not churn
+    the file; editing the flagged line itself invalidates its entry
+    (you fixed it or you changed it — either way, re-justify).
+  * Multiset matching: a key appearing N times grandfathers at most N
+    findings with that key.
+  * **Stale entries fail the gate.**  When a baselined finding is fixed,
+    its line must leave the file (tests/test_lint.py asserts this), so
+    the baseline monotonically decreases and never hides a regression
+    that happens to produce the same key later.
+
+``python -m dynamo_tpu.lint --write-baseline`` regenerates the file from
+the current findings; review the diff like any other code change.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterable, List, Tuple
+
+from .core import SUPPRESS_NO_REASON, Finding
+
+HEADER = (
+    "# dynlint baseline — grandfathered findings (see README 'Static "
+    "analysis').\n"
+    "# One `RULE|path|source line` per finding; stale entries fail the "
+    "gate.\n")
+
+
+def load(path: str) -> Counter:
+    keys: Counter = Counter()
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    keys[line] += 1
+    except FileNotFoundError:
+        pass
+    return keys
+
+
+def apply(findings: Iterable[Finding], baseline: Counter
+          ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """Split findings into (new, baselined); the third element is the
+    stale baseline keys no current finding matched."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if f.rule == SUPPRESS_NO_REASON:
+            # suppression hygiene is not baselineable (see render())
+            new.append(f)
+            continue
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in remaining.items() if n > 0 for _ in range(n))
+    return new, old, stale
+
+
+def key_path(key: str) -> str:
+    """The path component of a `RULE|path|snippet` baseline key."""
+    parts = key.split("|", 2)
+    return parts[1] if len(parts) >= 2 else ""
+
+
+def render(findings: Iterable[Finding]) -> str:
+    """Baseline text for `findings`.  DYN000 (suppression hygiene) is
+    never written: a reasonless or dead disable is fixed by editing the
+    comment, not grandfathered — baselining it would launder the
+    'reason mandatory' contract."""
+    body = "".join(sorted(f.key + "\n" for f in findings
+                          if f.rule != SUPPRESS_NO_REASON))
+    return HEADER + body

@@ -1,0 +1,216 @@
+"""dynlint CLI: ``python -m dynamo_tpu.lint [paths...] [--json]``.
+
+Exit codes: 0 clean (suppressed/baselined findings are clean), 1 when
+any new finding, reasonless suppression, stale baseline entry, or parse
+failure exists, 2 on usage errors.  ``--json`` emits the machine form
+(tests/test_lint.py smoke-tests it; CI diffing tools consume it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from . import baseline as baseline_mod
+from .core import RULES, LintResult, Module, canon_path, check_module
+
+DEFAULT_BASELINE = "dynlint.baseline"
+
+
+def iter_py_files(paths: Sequence[str],
+                  errors: Optional[List[str]] = None) -> List[str]:
+    out: List[str] = []
+    seen: set = set()  # realpaths: overlapping args (`. dynamo_tpu`)
+    #                    must not lint a file twice — a duplicate
+    #                    finding would escape the baseline's multiset
+    for p in paths:
+        if os.path.isfile(p):
+            found = [p]
+        else:
+            found = []
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                found.extend(os.path.join(root, f) for f in sorted(files)
+                             if f.endswith(".py"))
+            if not found and errors is not None:
+                # a typo'd or since-renamed path must not read as a
+                # green gate: linting nothing is an error, not a clean
+                # run
+                errors.append(f"{p}: no Python files found "
+                              "(missing or empty path)")
+        for f in found:
+            rp = os.path.realpath(f)
+            if rp not in seen:
+                seen.add(rp)
+                out.append(f)
+    return out
+
+
+_NAMESPACES = ("dynamo_tpu/", "tests/", "benchmarks/")
+
+
+def _scope_roots(paths: Sequence[str], linted: set) -> tuple:
+    """The canonical dir prefixes this run's directory arguments
+    enclose.  A marker-bearing argument (`dynamo_tpu/mocker`) covers
+    exactly its own subtree; an unmarked enclosing root (`.`, an
+    absolute repo path) covers every canonical namespace its walk
+    actually produced files in — so `dynlint .` and `dynlint
+    dynamo_tpu tests` make identical stale-baseline verdicts, while a
+    subset run never declares out-of-subtree entries stale."""
+    roots = []
+    for p in paths:
+        if os.path.isfile(p):
+            continue
+        c = canon_path(p).rstrip("/") + "/"
+        if c.startswith(_NAMESPACES):
+            roots.append(c)
+        else:
+            roots.extend(ns for ns in _NAMESPACES
+                         if any(l.startswith(ns) for l in linted))
+    return tuple(dict.fromkeys(roots))
+
+
+def run_paths(paths: Sequence[str],
+              baseline_path: Optional[str] = None,
+              rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint every .py under `paths`; the library entrypoint the tier-1
+    gate (tests/test_lint.py) and the CLI share."""
+    res = LintResult()
+    findings = []
+    linted: set = set()
+    for path in iter_py_files(paths, res.errors):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            mod = Module(src, path)
+        except (OSError, SyntaxError, ValueError) as e:
+            res.errors.append(f"{path}: {e}")
+            continue
+        res.files += 1
+        linted.add(mod.path)
+        findings.extend(check_module(mod, rules))
+        res.suppressed.extend(getattr(mod, "suppressed_findings", ()))
+    res.linted = linted
+    res.scope_roots = _scope_roots(paths, linted)
+    base = baseline_mod.load(baseline_path) if baseline_path else None
+    if base:
+        new, old, stale = baseline_mod.apply(findings, base)
+        # stale detection only makes sense for entries this run could
+        # have re-produced: a rule-restricted run emits only the
+        # selected rules' findings, and a path-subset run only the
+        # linted files' — flagging the rest "stale" would instruct the
+        # developer to delete still-valid entries.  An entry is in
+        # scope when its file was linted OR lives UNDER one of the
+        # covered roots (a deleted file's entry must still go stale —
+        # otherwise it lingers to grandfather a later regression in a
+        # re-created file)
+        if rules is not None:
+            stale = []
+        else:
+            stale = [k for k in stale
+                     if res.in_scope(baseline_mod.key_path(k))]
+        res.findings, res.baselined, res.stale_baseline = new, old, stale
+    else:
+        res.findings = findings
+        # a configured-but-empty baseline has nothing to go stale
+    res.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return res
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.lint",
+        description="dynlint: AST lint enforcing this repo's "
+                    "shipped-bug invariants (DYN001-DYN010)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: dynamo_tpu tests, "
+                         "when present in the cwd)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: ./{DEFAULT_BASELINE} "
+                         "when it exists)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--rule", action="append", dest="rules",
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule registry and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from . import rules as _r  # noqa: F401
+
+        for rid in sorted(RULES):
+            r = RULES[rid]
+            print(f"{rid}  {r.title}\n       bug: {r.bug}")
+        return 0
+
+    paths = args.paths or [p for p in ("dynamo_tpu", "tests")
+                           if os.path.isdir(p)]
+    if not paths:
+        ap.error("no paths given and no dynamo_tpu/ or tests/ in cwd")
+    if args.rules:
+        from . import rules as _r  # noqa: F401
+
+        args.rules = [r.upper() for r in args.rules]
+        unknown = [r for r in args.rules if r not in RULES]
+        if unknown:
+            ap.error(f"unknown rule id(s) {unknown}; "
+                     f"known: {sorted(RULES)}")
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    if args.write_baseline:
+        if args.rules:
+            # a baseline is a full-rule-set artifact: regenerating it
+            # from a rule subset would silently delete every other
+            # rule's grandfathered entries
+            ap.error("--write-baseline cannot be combined with --rule")
+        res = run_paths(paths, baseline_path=None)
+        target = args.baseline or DEFAULT_BASELINE
+        # merge, don't overwrite: entries OUTSIDE this run's scope (a
+        # path-subset invocation) are preserved verbatim — only the
+        # covered subtree's entries are regenerated
+        from .core import SUPPRESS_NO_REASON
+
+        existing = baseline_mod.load(target)
+        kept = [k for k, n in sorted(existing.items())
+                for _ in range(n)
+                if not res.in_scope(baseline_mod.key_path(k))]
+        new = [f.key for f in res.findings
+               if f.rule != SUPPRESS_NO_REASON]
+        with open(target, "w") as f:
+            f.write(baseline_mod.HEADER
+                    + "".join(k + "\n" for k in sorted(new + kept)))
+        print(f"dynlint: wrote {len(new)} baseline entries to {target}"
+              + (f" (kept {len(kept)} out-of-scope entries)"
+                 if kept else ""))
+        return 0
+
+    res = run_paths(paths, baseline_path=baseline_path, rules=args.rules)
+    if args.as_json:
+        json.dump(res.to_dict(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in res.findings:
+            print(f.render())
+        for key in res.stale_baseline:
+            print(f"stale baseline entry (fixed? delete its line): {key}")
+        for e in res.errors:
+            print(f"parse error: {e}")
+        print(f"dynlint: {len(res.findings)} finding(s) in {res.files} "
+              f"file(s); {len(res.suppressed)} suppressed, "
+              f"{len(res.baselined)} baselined, "
+              f"{len(res.stale_baseline)} stale baseline entr(ies)")
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

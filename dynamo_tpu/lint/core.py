@@ -1,0 +1,365 @@
+"""dynlint core: AST module loading, rule registry, suppressions, results.
+
+The framework half of the lint (rules live in rules.py): parse a file
+once into a :class:`Module` with parent links, run every registered rule
+whose path predicate matches, then fold in the two escape hatches —
+per-line ``# dynlint: disable=DYNxxx <reason>`` suppressions (reason
+mandatory, its absence is itself a finding) and the checked-in baseline
+of grandfathered findings (baseline.py).
+
+A finding's identity is ``rule|path|stripped-source-line`` rather than a
+line NUMBER, so baselines and suppressions survive unrelated edits above
+the flagged line; the path is canonicalized to the repo-relative form
+(``dynamo_tpu/...`` / ``tests/...``) so the same baseline works from any
+invocation directory.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# the meta-rule for suppression hygiene: a disable with no reason, or a
+# disable no finding matched (both mean the comment lies about the
+# code).  Not itself suppressible or baselineable — the whole point is
+# that every disable carries its why and earns its keep.
+SUPPRESS_NO_REASON = "DYN000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dynlint:\s*disable=([A-Za-z0-9,\s]+?)(?:\s+(\S.*))?$")
+
+
+def canon_path(path: str) -> str:
+    """Repo-relative posix path: cut everything before the last
+    ``dynamo_tpu/`` or ``tests/`` segment so absolute and relative
+    invocations produce identical finding keys."""
+    p = str(path).replace("\\", "/")
+    while p.startswith("./"):
+        p = p[2:]
+    for seg in ("dynamo_tpu/", "tests/", "benchmarks/"):
+        i = p.rfind("/" + seg)
+        if i >= 0:
+            return p[i + 1:]
+        if p.startswith(seg):
+            return p
+        # the marker directory itself (a root argument like
+        # `/repo/dynamo_tpu`): canonical form is the bare segment
+        bare = seg[:-1]
+        if p == bare or p.endswith("/" + bare):
+            return bare
+    return p
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str        # canonical repo-relative path
+    line: int        # 1-based
+    message: str
+    snippet: str     # stripped source line (part of the baseline key)
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.snippet}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "snippet": self.snippet}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass(frozen=True)
+class LintRule:
+    """One registered rule.  `check(module)` yields findings; `applies`
+    gates by canonical path (rules are scoped — e.g. DYN010 does not
+    police prints in CLI entrypoints or tests)."""
+
+    rule_id: str
+    title: str
+    bug: str  # the shipped bug this rule encodes (README table)
+    check: Callable[["Module"], Iterable[Finding]]
+    applies: Callable[[str], bool]
+
+
+RULES: Dict[str, LintRule] = {}
+
+
+def register(rule_id: str, title: str, bug: str,
+             applies: Optional[Callable[[str], bool]] = None):
+    """Decorator adding a rule to the registry.  Adding a rule is:
+    write the checker here, register it, add fixture tests, and run the
+    sweep (README "Static analysis" walks through it)."""
+
+    def deco(fn: Callable[["Module"], Iterable[Finding]]):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate lint rule {rule_id}")
+        RULES[rule_id] = LintRule(rule_id=rule_id, title=title, bug=bug,
+                                  check=fn, applies=applies or (lambda p: True))
+        return fn
+
+    return deco
+
+
+class Module:
+    """One parsed source file plus the helpers rules need: parent links,
+    enclosing-scope lookups, dotted-name resolution."""
+
+    def __init__(self, source: str, path: str):
+        self.source = source
+        self.path = canon_path(path)
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    # -- tree navigation --------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        n = self._parents.get(node)
+        while n is not None:
+            yield n
+            n = self._parents.get(n)
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing (async or sync) function def, else None."""
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def in_async_def(self, node: ast.AST) -> bool:
+        """True when the nearest enclosing function is ``async def`` —
+        i.e. the node runs on the event loop (a nested sync def is
+        somebody's callback/executor target, judged separately)."""
+        return isinstance(self.enclosing_function(node),
+                          ast.AsyncFunctionDef)
+
+    # -- emission ---------------------------------------------------------
+    def finding(self, rule_id: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        return Finding(rule=rule_id, path=self.path, line=line,
+                       message=message, snippet=snippet)
+
+
+# -- dotted-name helpers (shared by most rules) ------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None (calls, subscripts
+    and other computed bases have no stable dotted form)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base is not None else None
+    return None
+
+
+def terminal(node: ast.AST) -> Optional[str]:
+    """The last path segment: ``c`` for ``a.b.c``, ``x`` for ``x``,
+    ``attr`` for ``<anything>.attr``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def str_arg(call: ast.Call, i: int = 0) -> Optional[str]:
+    """The i-th positional argument when it is a string literal."""
+    if len(call.args) > i:
+        a = call.args[i]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+# -- suppressions ------------------------------------------------------------
+
+@dataclass
+class _Suppression:
+    rules: Tuple[str, ...]
+    reason: str
+    line: int          # line the suppression applies to
+    comment_line: int  # line the comment itself sits on
+    snippet: str
+    used: bool = False
+
+
+def _stmt_span(tree: ast.AST, line: int) -> Tuple[int, int]:
+    """The line range of the innermost SIMPLE statement containing
+    `line` (a multiline `x = jax.jit(\\n ...)` is one logical unit — a
+    suppression anywhere on it covers findings anywhere on it).
+    Compound statements don't count: a comment above a `def` must not
+    blanket the whole body.  Falls back to the line itself."""
+    best: Optional[Tuple[int, int]] = None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt) \
+                or isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef, ast.If, ast.For,
+                                     ast.While, ast.With, ast.Try,
+                                     ast.AsyncFor, ast.AsyncWith)):
+            continue
+        lo, hi = node.lineno, getattr(node, "end_lineno", node.lineno)
+        if lo <= line <= hi and (best is None
+                                 or hi - lo < best[1] - best[0]):
+            best = (lo, hi)
+    return best or (line, line)
+
+
+def parse_suppressions(source: str, path: str,
+                       tree: Optional[ast.AST] = None
+                       ) -> Tuple[Dict[int, List[_Suppression]],
+                                  List[Finding]]:
+    """``# dynlint: disable=DYN001[,DYN004] <reason>`` — on the flagged
+    statement, or standalone on the line(s) above it (stacked
+    standalone disables all target the next code line).  A suppression
+    covers the whole logical statement its target line belongs to, so
+    trailing comments on continuation lines of a multiline expression
+    work.  A missing reason is a DYN000 finding (not suppressible).
+    Parsed from real COMMENT tokens (``tokenize``), so
+    suppression-shaped text inside string literals — lint-test
+    fixtures, docs — is never mistaken for one."""
+    by_line: Dict[int, List[_Suppression]] = {}
+    errors: List[Finding] = []
+    lines = source.splitlines()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [t for t in tokens if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, SyntaxError):  # pragma: no cover
+        comments = []  # ast parsed it, so this is near-unreachable
+    for tok in comments:
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        lineno, col = tok.start
+        raw = lines[lineno - 1] if lineno <= len(lines) else tok.string
+        rules = tuple(r.strip().upper() for r in m.group(1).split(",")
+                      if r.strip())
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            errors.append(Finding(
+                rule=SUPPRESS_NO_REASON, path=canon_path(path), line=lineno,
+                message="dynlint suppression without a reason: write "
+                        "`# dynlint: disable=DYNxxx <why this is safe>`",
+                snippet=raw.strip()))
+            continue
+        standalone = raw[:col].strip() == ""
+        target = lineno
+        if standalone:
+            # skip past further comment/blank lines: stacked standalone
+            # disables all anchor on the next CODE line
+            target += 1
+            while target <= len(lines):
+                nxt = lines[target - 1].strip()
+                if nxt == "" or nxt.startswith("#"):
+                    target += 1
+                else:
+                    break
+        lo, hi = _stmt_span(tree, target) if tree is not None \
+            else (target, target)
+        sup = _Suppression(rules=rules, reason=reason, line=target,
+                           comment_line=lineno, snippet=raw.strip())
+        for covered in range(lo, hi + 1):
+            by_line.setdefault(covered, []).append(sup)
+    return by_line, errors
+
+
+# -- run ---------------------------------------------------------------------
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)   # actionable
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)  # unmatched keys
+    errors: List[str] = field(default_factory=list)          # parse failures
+    files: int = 0
+    # what this run covered, for scope-aware baseline handling: the
+    # canonical paths linted, and the canonical dir prefixes the given
+    # roots enclose (stale detection and --write-baseline merging must
+    # not touch entries outside them)
+    linted: set = field(default_factory=set)
+    scope_roots: Tuple[str, ...] = ()
+
+    def in_scope(self, key_path: str) -> bool:
+        return key_path in self.linted \
+            or key_path.startswith(self.scope_roots)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale_baseline \
+            and not self.errors
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "suppressed": len(self.suppressed),
+            "stale_baseline": list(self.stale_baseline),
+            "errors": list(self.errors),
+        }
+
+
+def check_module(mod: Module,
+                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """All raw findings for one module (suppressions applied, baseline
+    NOT applied — that is a run-level concern).  With the FULL rule set
+    (rules=None), a suppression no finding matched is itself a DYN000
+    finding — dead disables must not accumulate and silently mask a
+    later reintroduction (the suppression analogue of the baseline's
+    stale-entry rule).  Rule-restricted runs skip that check: most
+    suppressions legitimately target unselected rules there."""
+    from . import rules as _rules  # noqa: F401  (registers on import)
+
+    selected = [RULES[r] for r in rules] if rules else list(RULES.values())
+    raw: List[Finding] = []
+    for rule in selected:
+        if not rule.applies(mod.path):
+            continue
+        raw.extend(rule.check(mod))
+    sup, sup_errors = parse_suppressions(mod.source, mod.path, mod.tree)
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in sorted(raw, key=lambda f: (f.line, f.rule)):
+        hits = [s for s in sup.get(f.line, ()) if f.rule in s.rules]
+        if hits:
+            for s in hits:
+                s.used = True
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    kept.extend(sup_errors)
+    if rules is None:
+        seen_sups: List[_Suppression] = []
+        for sups in sup.values():
+            for s in sups:
+                # one suppression covers a statement's whole line range
+                # and is registered per covered line: judge it once
+                if any(s is x for x in seen_sups):
+                    continue
+                seen_sups.append(s)
+                if not s.used:
+                    kept.append(Finding(
+                        rule=SUPPRESS_NO_REASON, path=mod.path,
+                        line=s.comment_line,
+                        message="unused dynlint suppression: no "
+                                f"{'/'.join(s.rules)} finding on its "
+                                "target line — the code changed, delete "
+                                "the comment (or re-point it)",
+                        snippet=s.snippet))
+    mod.suppressed_findings = suppressed  # type: ignore[attr-defined]
+    return kept

@@ -1,0 +1,469 @@
+"""dynlint rules DYN001–DYN010: each one encodes a bug this repo really
+shipped (the PR it came from is named per rule), turning a
+found-late-by-review-or-live-fleet failure into a permanently-enforced
+invariant.  The README "Static analysis" table is generated from the
+``bug`` strings below.
+
+Scoping: rules carry a path predicate.  ``dynamo_tpu/`` is library code
+under full enforcement; ``tests/`` gets the rules whose bug class lives
+in tests too (task leaks, seam/span typos, marker literals, swallowed
+cancellation); CLI entrypoints (``__main__.py``, report/profiler) are
+exempt from the print rule because printing is their job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import Finding, Module, dotted, register, str_arg, terminal
+
+
+def _in_pkg(path: str) -> bool:
+    return path.startswith("dynamo_tpu/")
+
+
+def _in_pkg_or_tests(path: str) -> bool:
+    return path.startswith(("dynamo_tpu/", "tests/"))
+
+
+def _walk_async_body(fn: ast.AsyncFunctionDef) -> Iterable[ast.AST]:
+    """Nodes that execute ON THE EVENT LOOP inside this async def:
+    descends expressions and control flow but not nested function defs
+    (those are callbacks/executor targets, judged where they run)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# DYN001 — raw jax.jit / pjit outside the compile watchdog
+# ---------------------------------------------------------------------------
+
+_JIT_BASES = {"jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit"}
+
+
+@register(
+    "DYN001",
+    "raw jax.jit/pjit outside compile-watch wrapping",
+    "PR 7: guided decoding's duplicate lazy top-k init went through a raw "
+    "jax.jit that bypassed the compile watchdog — the measured 8-14s "
+    "mid-serving guided-fork stall would have stayed invisible",
+    applies=lambda p: _in_pkg(p) and p != "dynamo_tpu/obs/compile_watch.py"
+    and not p.startswith("dynamo_tpu/lint/"))
+def raw_jit(mod: Module) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        d = dotted(node)
+        if d not in _JIT_BASES:
+            continue
+        # bare-name matches must actually come from jax; `jit`/`pjit`
+        # defined locally (a helper named jit) is not our business
+        if isinstance(node, ast.Name) and not _imported_from_jax(mod,
+                                                                 node.id):
+            continue
+        # references that are themselves the attr of a longer chain
+        # (e.g. the `jax.jit` inside `jax.jit.lower`) are covered by the
+        # outer node; only judge the full chain
+        parent = mod.parent(node)
+        if isinstance(parent, ast.Attribute):
+            continue
+        if _under_wrap_call(mod, node):
+            continue
+        yield mod.finding(
+            "DYN001", node,
+            "raw jax.jit/pjit: route it through "
+            "obs/compile_watch.CompileWatch.wrap(...) so a mid-serving "
+            "compile is observed (the PR 7 guided-topk blind spot)")
+
+
+def _imported_from_jax(mod: Module, name: str) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[0] == "jax":
+            if any(a.asname == name or (a.asname is None and a.name == name)
+                   for a in node.names):
+                return True
+    return False
+
+
+def _under_wrap_call(mod: Module, node: ast.AST) -> bool:
+    """True when the jit reference is an argument (at any depth) of a
+    ``<watch>.wrap(...)`` call — the sanctioned way to create one."""
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.Call) and terminal(anc.func) == "wrap":
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# DYN002 — builtin hash() for identity
+# ---------------------------------------------------------------------------
+
+@register(
+    "DYN002",
+    "builtin hash() used for identity",
+    "PR 4: the mocker's position-addressed token stream seeded from "
+    "hash(request_id) — PYTHONHASHSEED randomizes it per process, so "
+    "cross-process token-replay migration regenerated a different suffix; "
+    "fixed to zlib.crc32",
+    applies=_in_pkg)
+def builtin_hash(mod: Module) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "hash":
+            yield mod.finding(
+                "DYN002", node,
+                "builtin hash() is PYTHONHASHSEED-randomized per process "
+                "— any value that crosses a process boundary (seeds, "
+                "replay identity, cache keys) must use zlib.crc32 or "
+                "tokens/hashing instead")
+
+
+# ---------------------------------------------------------------------------
+# DYN003 — metric family without the dynamo_ prefix
+# ---------------------------------------------------------------------------
+
+_METRIC_METHODS = {"counter", "gauge", "histogram", "inc", "observe",
+                   "set_gauge"}
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
+
+
+@register(
+    "DYN003",
+    "metric family defined without the dynamo_ prefix",
+    "PR 7: the scrape-contract test asserts every exported family is "
+    "dynamo_-prefixed at runtime; this is its static twin, catching the "
+    "definition site before a worker ever serves /metrics",
+    applies=_in_pkg_or_tests)
+def metric_prefix(mod: Module) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _METRIC_METHODS:
+            name = str_arg(node)
+        elif terminal(node.func) in _METRIC_CTORS:
+            name = str_arg(node)
+        if name is None:
+            continue
+        # only judge strings that are plausibly prometheus family names
+        # (.observe()/.inc() on non-metric objects take arbitrary args)
+        if not name.replace("_", "").islower() or " " in name \
+                or not name[:1].isalpha():
+            continue
+        if not name.startswith("dynamo_"):
+            yield mod.finding(
+                "DYN003", node,
+                f"metric family {name!r} must carry the dynamo_ prefix "
+                "(scrape contract: every exported family aggregates "
+                "under one namespace)")
+
+
+# ---------------------------------------------------------------------------
+# DYN004 — blocking call lexically inside async def
+# ---------------------------------------------------------------------------
+
+_BLOCKING_DOTTED = {
+    "time.sleep", "os.system",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+}
+
+
+@register(
+    "DYN004",
+    "blocking call inside async def",
+    "PR 7 class: the engine moved every device wait behind "
+    "asyncio.to_thread because one synchronous fetch on the event loop "
+    "stalls every live stream's frame egress at once",
+    applies=_in_pkg)
+def blocking_in_async(mod: Module) -> Iterable[Finding]:
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in _walk_async_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            t = terminal(node.func)
+            msg = None
+            if d in _BLOCKING_DOTTED:
+                msg = f"{d}() blocks the event loop"
+            elif isinstance(node.func, ast.Name) and node.func.id == "open":
+                msg = ("sync file I/O on the event loop: open/read/write "
+                       "via run_in_executor (or aiofiles-style helpers)")
+            elif t == "block_until_ready":
+                msg = ("block_until_ready() parks the loop on a device "
+                       "sync; fetch via asyncio.to_thread")
+            elif t == "result" and isinstance(node.func, ast.Attribute) \
+                    and not node.args and not node.keywords:
+                msg = (".result() on a future blocks (or raises "
+                       "InvalidState); await it, or suppress with the "
+                       "reason the future is known-done")
+            if msg:
+                yield mod.finding(
+                    "DYN004", node,
+                    f"{msg} — inside `async def {fn.name}` every "
+                    "concurrent request stalls behind it")
+
+
+# ---------------------------------------------------------------------------
+# DYN005 — fire-and-forget task
+# ---------------------------------------------------------------------------
+
+@register(
+    "DYN005",
+    "asyncio task created and discarded",
+    "PR 4: leaked tasks are how wedged-worker bugs hide — the conftest "
+    "gate catches them at runtime per test; this catches the discarded "
+    "reference at the creation site, library-wide",
+    applies=_in_pkg_or_tests)
+def discarded_task(mod: Module) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        t = terminal(call.func)
+        if t not in ("create_task", "ensure_future"):
+            continue
+        yield mod.finding(
+            "DYN005", call,
+            f"{t}(...) result discarded: the event loop holds only a "
+            "weak reference — the task can be garbage-collected "
+            "mid-flight and its exceptions are never observed; keep a "
+            "reference (owner set + done-callback discard) or await it")
+
+
+# ---------------------------------------------------------------------------
+# DYN006 — seam / span-kind literal not in the central registry
+# ---------------------------------------------------------------------------
+
+def _registries():
+    from .. import chaos, obs
+
+    return chaos.SEAMS, set(chaos.ACTIONS), obs.SPAN_KINDS
+
+
+@register(
+    "DYN006",
+    "chaos-seam / span-kind literal not in the central registry",
+    "PR 4/6 class: a typo'd seam name is a chaos rule that silently never "
+    "fires and a typo'd span kind is an orphan timeline row; "
+    "chaos.SEAMS / obs.SPAN_KINDS are the single source of truth",
+    applies=_in_pkg_or_tests)
+def registry_literals(mod: Module) -> Iterable[Finding]:
+    seams, actions, span_kinds = _registries()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        t = terminal(node.func)
+        if d in ("chaos.hit", "chaos.ahit"):
+            seam = str_arg(node)
+            if seam is not None and seam not in seams:
+                yield mod.finding(
+                    "DYN006", node,
+                    f"seam {seam!r} is not in chaos.SEAMS — this hit() "
+                    "can never be targeted by a rule; register the seam "
+                    "or fix the typo")
+        elif t == "rule":
+            seam, action = str_arg(node, 0), str_arg(node, 1)
+            if seam is not None and action in actions \
+                    and seam not in seams:
+                yield mod.finding(
+                    "DYN006", node,
+                    f"seam {seam!r} is not in chaos.SEAMS — a rule on an "
+                    "unregistered seam silently never fires")
+        elif d in ("obs.span", "obs.end"):
+            kind = str_arg(node)
+            if kind is not None and kind not in span_kinds:
+                yield mod.finding(
+                    "DYN006", node,
+                    f"span kind {kind!r} is not in obs.SPAN_KINDS — the "
+                    "report and dashboards join on the registered "
+                    "taxonomy; add the kind there or fix the typo")
+
+
+# ---------------------------------------------------------------------------
+# DYN007 — protocol marker literal written inline
+# ---------------------------------------------------------------------------
+
+def _drain_markers():
+    from ..protocols import llm
+
+    return {llm.DRAIN_REJECT: "protocols.DRAIN_REJECT",
+            llm.DRAIN_ABORT: "protocols.DRAIN_ABORT"}
+
+
+@register(
+    "DYN007",
+    "protocol marker literal inlined instead of imported",
+    "PR 4: the drain markers were duplicated as string literals in both "
+    "engines — a reword in one would silently break real-engine "
+    "token-replay migration while mocker tests stayed green",
+    applies=lambda p: _in_pkg_or_tests(p)
+    and p != "dynamo_tpu/protocols/llm.py")
+def inline_marker(mod: Module) -> Iterable[Finding]:
+    markers = _drain_markers()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        v = node.value
+        name = markers.get(v)
+        # dynlint: disable=DYN007 the rule's own prefix check, not an inline marker
+        if name is None and v.startswith("worker draining:"):
+            name = "protocols.DRAIN_REJECT/DRAIN_ABORT"
+        if name is not None:
+            yield mod.finding(
+                "DYN007", node,
+                f"inline copy of a protocol marker: import {name} — "
+                "migratable-error classification substring-matches the "
+                "canonical text, a reworded copy breaks it silently")
+
+
+# ---------------------------------------------------------------------------
+# DYN008 — swallowing cancellation in async code
+# ---------------------------------------------------------------------------
+
+@register(
+    "DYN008",
+    "bare except / except BaseException in async def without re-raise",
+    "PR 4 class: a handler that eats CancelledError turns cooperative "
+    "cancellation into a wedged task — exactly the shutdown/drain hangs "
+    "the chaos suite exists to catch",
+    applies=_in_pkg_or_tests)
+def swallowed_cancellation(mod: Module) -> Iterable[Finding]:
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in _walk_async_body(fn):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _catches_base_exception(node.type):
+                continue
+            if any(isinstance(n, ast.Raise)
+                   for b in node.body for n in ast.walk(b)):
+                continue
+            what = ("bare `except:`" if node.type is None
+                    else "`except BaseException`")
+            yield mod.finding(
+                "DYN008", node,
+                f"{what} inside `async def {fn.name}` swallows "
+                "CancelledError: the task can no longer be cancelled "
+                "(wedged drains/shutdowns); re-raise, or catch Exception")
+
+
+def _catches_base_exception(type_node) -> bool:
+    """True for bare ``except:``, ``except BaseException`` and a tuple
+    clause containing it (``except (OSError, BaseException)`` swallows
+    CancelledError just the same)."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(terminal(e) == "BaseException" for e in type_node.elts)
+    return terminal(type_node) == "BaseException"
+
+
+# ---------------------------------------------------------------------------
+# DYN009 — KV tuple destructured at fixed arity 2
+# ---------------------------------------------------------------------------
+
+_KV_NAMES = {"kv", "kv_cache", "kv_pages", "kv_tuple"}
+
+
+def _kv_name(node: ast.AST):
+    t = terminal(node)
+    if t is None:
+        return None
+    if t in _KV_NAMES or t.endswith("_kv"):
+        return t
+    return None
+
+
+@register(
+    "DYN009",
+    "KV cache tuple destructured at fixed arity 2",
+    "PR 3: the int8 cache rides as a (k, v, k_scale, v_scale) 4-tuple "
+    "through the same pytree as the bf16 (k, v) 2-tuple; an unguarded "
+    "`k, v = kv` silently drops the scale planes (or raises) the first "
+    "time an int8 cache reaches it",
+    applies=lambda p: p.startswith((
+        "dynamo_tpu/engine/", "dynamo_tpu/ops/", "dynamo_tpu/models/",
+        "dynamo_tpu/kvbm/", "dynamo_tpu/disagg/", "dynamo_tpu/quant/",
+        "dynamo_tpu/mocker/", "dynamo_tpu/spec/")))
+def kv_fixed_arity(mod: Module) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Tuple) and len(tgt.elts) == 2
+                and all(isinstance(e, ast.Name) for e in tgt.elts)):
+            continue
+        name = _kv_name(node.value)
+        if name is None:
+            continue
+        fn = mod.enclosing_function(node)
+        if fn is not None and _has_len_guard(fn, name):
+            continue
+        yield mod.finding(
+            "DYN009", node,
+            f"`{tgt.elts[0].id}, {tgt.elts[1].id} = {name}` assumes the "
+            "bf16 2-tuple: int8 caches are (k, v, k_scale, v_scale) — "
+            "guard on len() (quant/kv.py unpack_kv) or handle both "
+            "arities")
+
+
+def _has_len_guard(fn: ast.AST, name: str) -> bool:
+    """The enclosing function tests len(<name>) somewhere — the
+    quant/kv.py unpack idiom — so the 2-arity branch is deliberate."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "len" and len(node.args) == 1 \
+                and terminal(node.args[0]) == name:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# DYN010 — print() in library code
+# ---------------------------------------------------------------------------
+
+_PRINT_OK = (
+    "__main__.py",                 # CLI entrypoints print by design
+    "dynamo_tpu/obs/report.py",    # report CLIs
+    "dynamo_tpu/profiler/",
+    "dynamo_tpu/loadgen/",
+    "dynamo_tpu/lint/cli.py",      # the lint's own CLI output
+)
+
+
+def _print_applies(path: str) -> bool:
+    if not _in_pkg(path):
+        return False
+    return not any(path.endswith(s) or path.startswith(s)
+                   for s in _PRINT_OK)
+
+
+@register(
+    "DYN010",
+    "print() in library code",
+    "observability-plane class: a print bypasses runtime/logging — no "
+    "level, no trace_id stamp (PR 7's log<->span join), invisible to "
+    "log-based alerting; workers' stdout is not a log pipeline",
+    applies=_print_applies)
+def print_in_library(mod: Module) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "print":
+            yield mod.finding(
+                "DYN010", node,
+                "print() in library code: use runtime/logging (levels, "
+                "TraceIdFilter correlation) — stdout is not scraped")

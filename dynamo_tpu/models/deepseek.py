@@ -342,6 +342,7 @@ def prefill(
     true_len: jax.Array,
 ):
     """Same contract as llama.prefill; cache pair = (latent, rope key)."""
+    # dynlint: disable=DYN009 MLA latent cache is bf16-only by design (no int8 scale shapes); the engine forces the bf16 fallback for this family
     c_cache, kr_cache = kv_cache
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [T, d]
     T = x.shape[0]
@@ -377,6 +378,7 @@ def prefill_batched(
     true_lens: jax.Array,      # [Bp]
 ):
     """Multi-sequence chunked prefill (llama.prefill_batched contract)."""
+    # dynlint: disable=DYN009 MLA latent cache is bf16-only by design (no int8 scale shapes); the engine forces the bf16 fallback for this family
     c_cache, kr_cache = kv_cache
     Bp, T = token_ids.shape
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [Bp, T, d]
@@ -423,6 +425,7 @@ def decode(
     valid: Optional[jax.Array] = None,
     mesh=None,                 # uniform signature; MLA decode is pure jnp
 ):
+    # dynlint: disable=DYN009 MLA latent cache is bf16-only by design (no int8 scale shapes); the engine forces the bf16 fallback for this family
     c_cache, kr_cache = kv_cache
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [B, d]
     B = x.shape[0]

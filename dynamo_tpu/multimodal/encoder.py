@@ -118,6 +118,7 @@ class VitEncoder:
                 "w1": dense(k[2], (cfg.d_model, 4 * cfg.d_model)),
                 "w2": dense(k[3], (4 * cfg.d_model, cfg.d_model)),
             })
+        # dynlint: disable=DYN001 stub encoder worker outside the engine; no FPM/metrics plane to feed a CompileWatch yet
         self._jit = jax.jit(self._forward)
 
     @property

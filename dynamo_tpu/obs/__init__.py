@@ -99,6 +99,26 @@ DEFAULT_RING = 16384
 STEP_PHASES = ("sched", "prefill_dispatch", "decode_dispatch",
                "device_wait", "sample")
 
+# THE canonical span taxonomy (the docstring table above, plus the
+# compile watchdog's span): every obs.span()/obs.end() call site names
+# one of these, and the DYN006 lint (lint/rules.py) checks the literals
+# statically — a typo'd kind would otherwise produce an orphan span the
+# report buckets under its own name and no dashboard ever joins on.
+# Extend this set and the docstring table together when adding a kind.
+SPAN_KINDS = frozenset(STEP_PHASES) | frozenset({
+    "step",
+    "detok",
+    "frame_egress",
+    "request",
+    "worker_request",
+    "kv_pull",
+    "disagg_open",
+    "disagg_chunk",
+    "kvbm_offload",
+    "kvbm_onboard",
+    "compile",  # obs/compile_watch.py COMPILE_KIND
+})
+
 # ---------------------------------------------------------------------------
 # span record: a plain tuple, cheapest thing that can ride a deque
 #   (kind, t0, t1, track, attrs|None, trace_id|None)
@@ -414,6 +434,7 @@ def install_from_env() -> Optional[Tracer]:
 
 __all__ = [
     "DEFAULT_RING",
+    "SPAN_KINDS",
     "STEP_PHASES",
     "Tracer",
     "begin",

@@ -321,11 +321,18 @@ class FileDiscovery(DiscoveryBackend):
         await chaos.ahit("discovery.op", key=f"put:{key}")
         await self.start()
         p = self._path(key)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
-        tmp = p + f".tmp{secrets.token_hex(4)}"
-        with open(tmp, "w") as f:
-            json.dump(value, f)
-        os.replace(tmp, p)
+
+        def _write() -> None:
+            # atomic tmp+rename, off the event loop: registration rides
+            # the request path, and a put stalled on a slow/contended
+            # filesystem must not stall every live stream with it
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            tmp = p + f".tmp{secrets.token_hex(4)}"
+            with open(tmp, "w") as f:
+                json.dump(value, f)
+            os.replace(tmp, p)
+
+        await asyncio.to_thread(_write)
         if lease:
             self._owned.add(key)
             self._owned_values[key] = value

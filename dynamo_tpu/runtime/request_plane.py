@@ -19,6 +19,7 @@ import secrets
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from .. import chaos
+from . import aio
 from .cancellation import CancellationToken
 from .codec import read_frame, write_frame
 
@@ -216,6 +217,10 @@ class _Connection:
         self.write_lock = asyncio.Lock()
         self.streams: Dict[str, asyncio.Queue] = {}
         self.closed = False
+        # abandoned-stream cancel frames in flight (stream()'s finally):
+        # the loop only weak-refs tasks, so a fire-and-forget cancel
+        # could be gc'd before the frame hits the wire (DYN005)
+        self.bg_tasks: set = set()
         self._pump = asyncio.create_task(self._pump_loop())
 
     async def _pump_loop(self) -> None:
@@ -310,6 +315,7 @@ class RequestPlaneClient:
                         p.cancel()
                     if get not in done:
                         continue
+                    # dynlint: disable=DYN004 asyncio future in `done`: result() is a non-blocking read
                     frame = get.result()
                 else:
                     frame = await q.get()
@@ -327,7 +333,7 @@ class RequestPlaneClient:
             if not finished and not conn.closed:
                 # consumer broke out of the stream — stop the remote handler
                 try:
-                    asyncio.ensure_future(send_cancel(True))
+                    aio.spawn_retained(send_cancel(True), conn.bg_tasks)
                 except RuntimeError:
                     pass
 

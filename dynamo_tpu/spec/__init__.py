@@ -42,7 +42,7 @@ from .ngram import NgramProposer
 from .verify import SpecPlan, plan_spec_verify
 
 
-def make_proposer(config, mesh):
+def make_proposer(config, mesh, compile_watch=None):
     """Build the proposer an EngineConfig asks for (engine/core.py).
 
     `config.spec_decode`: "ngram" (zero-weight prompt lookup) or "draft"
@@ -87,6 +87,9 @@ def make_proposer(config, mesh):
             # its writes (catch-up prefill + propose bursts) are KV write
             # sites like any other, and its HBM footprint halves too
             kv_cache_dtype=config.kv_cache_dtype,
+            # the engine threads its compile watchdog through so draft
+            # compiles are observed on the same FPM/metric plane
+            compile_watch=compile_watch,
         )
     raise ValueError(
         f"spec_decode must be 'off' | 'ngram' | 'draft', "

@@ -46,7 +46,7 @@ class DraftModelProposer:
     def __init__(self, model_cfg, mesh, *, num_blocks: int,
                  block_size: int, prefill_buckets, model_path: str = "",
                  max_k: int = 4, seed: int = 0,
-                 kv_cache_dtype: str = "bf16"):
+                 kv_cache_dtype: str = "bf16", compile_watch=None):
         from ..parallel.mesh import shard_params
 
         self.cfg = model_cfg
@@ -76,8 +76,10 @@ class DraftModelProposer:
 
             dtype = jnp.int8 if quantized else model_cfg.dtype
             kv = [
+                # dynlint: disable=DYN001 one-shot sharded-zeros allocation at init, never dispatched while serving
                 jax.jit(partial(jnp.zeros, k_shape, dtype),
                         out_shardings=NamedSharding(mesh, k_spec))(),
+                # dynlint: disable=DYN001 one-shot sharded-zeros allocation at init, never dispatched while serving
                 jax.jit(partial(jnp.zeros, v_shape, dtype),
                         out_shardings=NamedSharding(mesh, v_spec))(),
             ]
@@ -86,14 +88,25 @@ class DraftModelProposer:
                     model_cfg, num_blocks, block_size)
                 scale_specs = self.family.kv_cache_scale_specs()
                 kv += [
+                    # dynlint: disable=DYN001 one-shot sharded-zeros allocation at init, never dispatched while serving
                     jax.jit(partial(jnp.zeros, shape, jnp.float32),
                             out_shardings=NamedSharding(mesh, spec))()
                     for shape, spec in zip(scale_shapes, scale_specs)
                 ]
             self.kv = tuple(kv)
-        self._jit_prefill = jax.jit(
+        # the draft's prefill/propose programs dispatch during serving
+        # exactly like the target's: under the engine's compile watchdog
+        # (obs/compile_watch.py) a draft recompile mid-serving is
+        # observed too.  A standalone proposer (tests, benches) wraps
+        # with a local watch so the call syntax never branches.
+        if compile_watch is None:
+            from ..obs.compile_watch import CompileWatch
+
+            compile_watch = CompileWatch()
+        self._watch = compile_watch
+        self._jit_prefill = compile_watch.wrap(jax.jit(
             partial(self._prefill_impl, self.family, self.cfg),
-            donate_argnums=(1,))
+            donate_argnums=(1,)), "draft_prefill", lambda a: a[2].shape[-1])
         self._jit_propose = {}  # k -> jitted k-step greedy draft program
 
     @staticmethod
@@ -139,10 +152,11 @@ class DraftModelProposer:
         k = min(k, self.max_k)
         jit = self._jit_propose.get(k)
         if jit is None:
-            jit = self._jit_propose[k] = jax.jit(
+            jit = self._jit_propose[k] = self._watch.wrap(jax.jit(
                 partial(self._propose_impl, self.family, self.cfg,
                         self.mesh, k),
-                donate_argnums=(1,))
+                donate_argnums=(1,)), "draft_propose",
+                lambda a, _k=k: _k)
         burst, self.kv = jit(
             self.params, self.kv, jnp.int32(tokens[ctx]), jnp.int32(ctx),
             table, jnp.int32(ctx))

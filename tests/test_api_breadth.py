@@ -2,6 +2,7 @@
 and /v1/embeddings (ref: the reference's http route families +
 preprocessor.rs stream parsers)."""
 
+import pytest
 import asyncio
 import json
 import uuid
@@ -251,6 +252,9 @@ async def test_embeddings_route_with_mocker():
         await stop_stack(*stack[:4])
 
 
+# real JAX engine in an async body: -O0 compiles dwarf the 200ms
+# loop gate (see conftest); mocker-based tests here stay gated
+@pytest.mark.allow_slow_callbacks
 async def test_jax_engine_embed_pooled_unit_vector():
     from dynamo_tpu.engine import EngineConfig, JaxEngine
 

@@ -27,6 +27,18 @@ def test_benchmarks_discovered():
     assert "bench_kv_quant.py" in names
 
 
+def test_lint_cli_help_exits_zero():
+    """The dynlint CLI rides the same drift gate as the benches: --help
+    forces the full module import and argparse wiring (the --json
+    contract itself is covered in tests/test_lint.py)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.lint", "--help"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    assert "--json" in r.stdout and "--baseline" in r.stdout
+
+
 @pytest.mark.parametrize(
     "path", BENCHES, ids=[os.path.basename(p) for p in BENCHES])
 def test_bench_help_exits_zero(path):

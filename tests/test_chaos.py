@@ -118,12 +118,12 @@ def test_rules_fire_deterministically_from_seed():
 
 def test_after_times_and_match_semantics():
     plane = chaos.ChaosPlane(seed=0).rule(
-        "s", "fail", after=2, times=2, match="good")
+        "engine.step", "fail", after=2, times=2, match="good")
     with plane:
         outcomes = []
         for key in ["bad", "good", "good", "good", "good", "good"]:
             try:
-                chaos.hit("s", key=key)
+                chaos.hit("engine.step", key=key)
                 outcomes.append("ok")
             except chaos.ChaosError:
                 outcomes.append("fail")
@@ -143,17 +143,27 @@ def test_injected_errors_classify_as_migratable():
     assert is_migratable(ei.value)
     # and the engine-crash flavor too
     assert is_migratable(RuntimeError("worker engine error: loop crashed"))
+    # dynlint: disable=DYN007 deliberately a NON-canonical marker-prefixed text: the test proves substring classification
     assert is_migratable(EngineError("worker draining: migrating"))
     assert is_migratable(RuntimeError("worker stalled: no stream frame"))
     assert not is_migratable(RuntimeError("schema validation failed"))
 
 
 def test_install_is_scoped():
-    plane = chaos.ChaosPlane(seed=1).rule("s", "fail")
+    plane = chaos.ChaosPlane(seed=1).rule("engine.step", "fail")
     with plane:
         assert chaos.active() is plane
     assert chaos.active() is None
-    chaos.hit("s")  # uninstalled again: no raise
+    chaos.hit("engine.step")  # uninstalled again: no raise
+
+
+def test_rule_rejects_unregistered_seam():
+    """A typo'd seam name used to be a rule that silently never fired;
+    the SEAMS registry makes it a construction-time error."""
+    with pytest.raises(ValueError, match="unknown chaos seam"):
+        # dynlint: disable=DYN006 the typo is the point: negative test for the registry validation
+        chaos.ChaosPlane(seed=0).rule("engine.stpe", "fail")
+    assert "engine.step" in chaos.SEAMS
 
 
 # --------------------------- scenario: frames ---------------------------
@@ -623,6 +633,9 @@ async def _disagg_pull_run(rt, decode_w, prefill_w, agg, rid):
     return tokens, expect
 
 
+# real JAX engine in an async body: -O0 compiles dwarf the 200ms
+# loop gate (see conftest); mocker-based tests here stay gated
+@pytest.mark.allow_slow_callbacks
 async def test_kv_pull_chunk_failure_retry_then_fallback():
     """Acceptance scenario 2: mid-sequence KV pull failures on the real
     JAX disagg path (one fleet, two sub-scenarios — the engines are the

@@ -199,6 +199,9 @@ def test_burn_rate_windows_age_out():
 # --------------------- e2e: histograms + injected breach --------------------
 
 
+# timing-sensitive: asserts a real 80ms TTFT target holds on the fast
+# path — the slow-callback gate's debug-mode overhead breaches it flakily
+@pytest.mark.allow_slow_callbacks
 async def test_frontend_exports_slo_surface_and_chaos_breach():
     """The acceptance path: a CPU-only mocker+frontend run exports the
     TTFT/e2e/queue histograms and a goodput gauge that RESPONDS to an
@@ -321,6 +324,9 @@ async def test_scrape_contract_frontend_and_mocker():
         await rt.shutdown()
 
 
+# real JAX engine in an async body: -O0 compiles dwarf the 200ms
+# loop gate (see conftest); mocker-based tests here stay gated
+@pytest.mark.allow_slow_callbacks
 async def test_scrape_contract_jax_worker():
     """The JAX engine worker's /metrics surface (engine gauges, compile
     histogram, occupancy, FPM aggregates) honors the same contract."""

@@ -3,6 +3,7 @@ the PROPOSE loop holding latency targets (ref planner-design.md
 "Throughput-Based Scaling": predict traffic -> invert perf model under
 TTFT/ITL SLAs -> replica targets)."""
 
+import pytest
 import asyncio
 import math
 import uuid
@@ -35,6 +36,10 @@ def synthetic_profile(base=0.002, per_seq=0.001, prefill_per_tok=0.00002):
 # ----------------------------- profiler ----------------------------------
 
 
+# profiler sweep: CPU-bound host math runs in the test coroutine —
+# borderline against the loop gate under suite load (harness cost,
+# not a serving path)
+@pytest.mark.allow_slow_callbacks
 async def test_profile_mock_engine_latency_surface():
     """The sweep recovers the mocker's polynomial timing model: ITL rises
     with concurrency, TTFT rises with ISL."""
@@ -324,6 +329,9 @@ async def test_fpm_observer_derives_itl_and_prefill_rate():
     await rt.shutdown()
 
 
+# real JAX engine in an async body: -O0 compiles dwarf the 200ms
+# loop gate (see conftest); mocker-based tests here stay gated
+@pytest.mark.allow_slow_callbacks
 async def test_fpm_prefill_mfu_queue_depth_and_single_record_rate():
     """The chunked-prefill FPM fields flow end-to-end: records produced
     by the ENGINE's own _fpm_prefill (gap/flops/mfu/queue_depth) publish
