@@ -55,6 +55,63 @@ def engine_args(role="both"):
                           peak_hbm_gbps=SIM_PEAK_HBM_GBPS)
 
 
+async def sample_fleet_peaks(workers, stop: asyncio.Event, peaks: dict):
+    """Track the fleet-plane headline AT PEAK while the replay runs:
+    worst load imbalance, worst straggler count, minimum KV headroom —
+    sampled from the same per-worker debug states obs.fleet scrapes,
+    reduced by the same summarize_states."""
+    from dynamo_tpu.obs.fleet import summarize_states
+
+    while not stop.is_set():
+        s = summarize_states([w.debug_state() for w in workers])
+        peaks["imbalance"] = max(peaks.get("imbalance", 1.0),
+                                 s["imbalance"])
+        peaks["stragglers"] = max(peaks.get("stragglers", 0),
+                                  s["straggler_count"])
+        peaks["kv_headroom_min"] = min(peaks.get("kv_headroom_min", 1.0),
+                                       s["kv_headroom_min"])
+        peaks["_last"] = s
+        try:
+            await asyncio.wait_for(stop.wait(), 0.05)
+        except asyncio.TimeoutError:
+            pass
+
+
+async def collect_fleet(rt, workers, peaks: dict):
+    """`fleet` block for the bench JSON: export the peak-annotated
+    summary through the fleet gauge surface (obs/fleet.py), then read
+    the numbers back off the run's own registry with the prometheus
+    parser — the same families a production scrape of a fleet exporter
+    would see."""
+    import time
+
+    from prometheus_client.parser import text_string_to_metric_families
+
+    from dynamo_tpu.obs.fleet import FleetSnapshot, export_fleet_gauges, \
+        summarize_states
+
+    summary = peaks.get("_last") or summarize_states(
+        [w.debug_state() for w in workers])
+    summary["imbalance"] = peaks.get("imbalance", summary["imbalance"])
+    summary["straggler_count"] = peaks.get("stragglers",
+                                           summary["straggler_count"])
+    summary["kv_headroom_min"] = peaks.get("kv_headroom_min",
+                                           summary["kv_headroom_min"])
+    export_fleet_gauges(
+        rt.metrics.scoped(component="fleet"),
+        FleetSnapshot(ts_unix=time.time(), workers=[], frontends=[],
+                      summary=summary))
+    out = {}
+    for fam in text_string_to_metric_families(rt.metrics.render().decode()):
+        if fam.name == "dynamo_fleet_load_imbalance":
+            out["imbalance"] = round(fam.samples[0].value, 4)
+        elif fam.name == "dynamo_fleet_straggler_workers":
+            out["stragglers"] = int(fam.samples[0].value)
+        elif fam.name == "dynamo_fleet_kv_headroom_min":
+            out["kv_headroom_min"] = round(fam.samples[0].value, 4)
+    return out
+
+
 async def collect_roofline(rt):
     """Scrape the run's worker gauges (one load-loop tick after the
     replay) into the bench JSON's roofline block: per-phase MFU/MBU and
@@ -91,14 +148,21 @@ async def bench_agg(rows, n_workers, args):
     client = await (rt.namespace("dynamo").component("backend")
                     .endpoint("generate").client()).start()
     await client.wait_for_instances()
-    report = await replay(client.generate, rows, block_size=BLOCK,
-                          speedup=args.speedup)
+    stop, peaks = asyncio.Event(), {}
+    sampler = asyncio.create_task(sample_fleet_peaks(workers, stop, peaks))
+    try:
+        report = await replay(client.generate, rows, block_size=BLOCK,
+                              speedup=args.speedup)
+    finally:
+        stop.set()
+        await sampler
     roofline = await collect_roofline(rt)
+    fleet = await collect_fleet(rt, workers, peaks)
     await client.close()
     for w in workers:
         await w.close()
     await rt.shutdown()
-    return report, roofline
+    return report, roofline, fleet
 
 
 async def bench_disagg(rows, n_prefill, n_decode, args):
@@ -128,16 +192,24 @@ async def bench_disagg(rows, n_prefill, n_decode, args):
         async for item in dclient.generate(routed.to_dict()):
             yield item
 
-    report = await replay(client_fn, rows, block_size=BLOCK,
-                          speedup=args.speedup)
+    stop, peaks = asyncio.Event(), {}
+    sampler = asyncio.create_task(
+        sample_fleet_peaks(prefills + decodes, stop, peaks))
+    try:
+        report = await replay(client_fn, rows, block_size=BLOCK,
+                              speedup=args.speedup)
+    finally:
+        stop.set()
+        await sampler
     roofline = await collect_roofline(rt)
+    fleet = await collect_fleet(rt, prefills + decodes, peaks)
     await orch.close()
     await pclient.close()
     await dclient.close()
     for w in prefills + decodes:
         await w.close()
     await rt.shutdown()
-    return report, roofline
+    return report, roofline, fleet
 
 
 async def main():
@@ -182,11 +254,13 @@ async def main():
     slo_itl_s = (args.slo_itl_ms / 1000.0
                  if args.slo_itl_ms is not None else args.slo_itl)
 
-    def line(config, summary, roofline):
+    def line(config, summary, roofline, fleet):
         # stable bench JSON schema: the `slo` block mirrors the
         # frontend SLO plane's vocabulary (targets + goodput fraction),
-        # `roofline` the worker gauges, so a scoreboard diff across
-        # rounds reads the same numbers a live scrape would
+        # `roofline` the worker gauges, `fleet` the obs.fleet headline
+        # at peak (imbalance, straggler count, min KV headroom), so a
+        # scoreboard diff across rounds reads the same numbers a live
+        # scrape would
         gp = summary.get("goodput", {})
         total = summary.get("requests", 0)
         return json.dumps({
@@ -198,16 +272,17 @@ async def main():
                 "good_rps": gp.get("good_rps"),
             },
             "roofline": roofline,
+            "fleet": fleet,
         })
 
-    agg, agg_roof = await bench_agg(rows, args.workers, args)
+    agg, agg_roof, agg_fleet = await bench_agg(rows, args.workers, args)
     print(line(f"agg-{args.workers}w",
-               agg.summary(slo_ttft_s, slo_itl_s), agg_roof))
-    dis, dis_roof = await bench_disagg(rows, max(1, args.workers // 2),
-                                       max(1, args.workers // 2), args)
+               agg.summary(slo_ttft_s, slo_itl_s), agg_roof, agg_fleet))
+    dis, dis_roof, dis_fleet = await bench_disagg(
+        rows, max(1, args.workers // 2), max(1, args.workers // 2), args)
     print(line(f"disagg-{max(1, args.workers // 2)}p"
                f"{max(1, args.workers // 2)}d",
-               dis.summary(slo_ttft_s, slo_itl_s), dis_roof))
+               dis.summary(slo_ttft_s, slo_itl_s), dis_roof, dis_fleet))
 
     if tracer is not None:
         from dynamo_tpu.obs.report import report_paths

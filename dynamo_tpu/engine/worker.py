@@ -84,6 +84,13 @@ class JaxEngineWorker:
         self.served = None
         self._aux_served = []
         self._load_task: Optional[asyncio.Task] = None
+        # local FPM aggregation window: the load loop feeds it, and the
+        # /debug/state dump reads compile-family stats and ITL p95 off
+        # it between ticks (fleet straggler detection input)
+        from ..planner.metrics import FpmWindow
+
+        self._fpm_window = FpmWindow()
+        self._debug_source_name: Optional[str] = None
 
     @property
     def card(self) -> ModelDeploymentCard:
@@ -411,9 +418,60 @@ class JaxEngineWorker:
             await asyncio.to_thread(self.engine.warmup_decode)
         await register_model(rt, self.card, instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
+        # fleet introspection: this worker's live state on /debug/state
+        self._debug_source_name = f"worker:{instance_id}"
+        rt.register_debug_source(self._debug_source_name, self.debug_state)
         logger.info("jax engine worker %d serving %s (tp=%d)",
                     instance_id, self.config.served_name, self.config.tp)
         return self
+
+    def debug_state(self) -> dict:
+        """Live scheduler/KV/drain snapshot for /debug/state and the
+        fleet aggregator (obs/fleet.py).  Read-only over structures the
+        scheduler thread mutates — copies first, tolerates a torn read
+        (a debug dump must never take the step lock)."""
+        eng = self.engine
+        if eng is None:
+            return {"kind": "engine", "role": "follower",
+                    "rank": self.mh.rank}
+        slots = []
+        for s in list(eng._slots):
+            if s is None:
+                continue
+            slots.append({
+                "request_id": s.request.request_id,
+                "prompt_len": s.prompt_len,
+                "generated": s.generated,
+                "prefilling": s.prefilling,
+                "pulling": s.pulling,
+                "inflight": s.inflight,
+                "cached_tokens": s.cached_tokens,
+            })
+        waiting = [s.request.request_id for s in list(eng.waiting)]
+        fw = self._fpm_window
+        return {
+            "kind": "engine",
+            "instance_id": (self.served.instance_id
+                            if self.served is not None else None),
+            "namespace": self.namespace,
+            "component": self.component,
+            "model": self.config.served_name,
+            "role": self.config.role,
+            "draining": eng.draining,
+            "active_seqs": eng.num_active_seqs,
+            "waiting": waiting,
+            "slots": slots,
+            "tokens_in_flight": sum(
+                s["prompt_len"] + s["generated"] for s in slots),
+            "kv": eng.kv_occupancy(),
+            "kv_usage": eng.kv_usage(),
+            "kv_cache_dtype": eng.kv_dtype,
+            "itl_ema_s": eng.itl_ema_s,
+            "itl_p95_s": fw.decode_itl_p95_s(),
+            "compile": fw.compile_stats(),
+            "engine_metrics": dict(eng.metrics),
+            "config": dict(self.card.runtime_config),
+        }
 
     async def _start_follower(self) -> "JaxEngineWorker":
         """Follower process of an N-host slice: hold the same engine state
@@ -553,9 +611,9 @@ class JaxEngineWorker:
         # FpmObserver runs fleet-wide, fed from this worker's own ring
         # BEFORE it ships — so a bare `/metrics` scrape sees the
         # headline engine numbers without a planner in the deployment
-        from ..planner.metrics import FpmWindow
-
-        fw = FpmWindow()
+        # (and /debug/state reads compile stats + ITL p95 off the same
+        # window)
+        fw = self._fpm_window
         while True:
             await asyncio.sleep(0.5)
             if self.engine is None or self.served is None:
@@ -643,6 +701,9 @@ class JaxEngineWorker:
         self.engine.drain_abort()
 
     async def close(self) -> None:
+        if self._debug_source_name is not None:
+            self.runtime.unregister_debug_source(self._debug_source_name)
+            self._debug_source_name = None
         if getattr(self, "_broker_id", None) is not None:
             from ..disagg import broker
 

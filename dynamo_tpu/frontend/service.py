@@ -339,6 +339,8 @@ class HttpService:
         self.inflight = 0
         self._runner: Optional[web.AppRunner] = None
         self._slo_task: Optional[asyncio.Task] = None
+        self._fleet_instance = None
+        self._fleet_instance_id: Optional[int] = None
         from .request_trace import TraceConfig, TraceSink
 
         self.trace_sink = TraceSink(TraceConfig.from_env())
@@ -908,6 +910,19 @@ class HttpService:
                 pass
         return resp
 
+    def debug_state(self) -> dict:
+        """Frontend half of /debug/state (fleet introspection plane):
+        served models, in-flight count, and the SLO plane's rolling
+        summary — what the fleet aggregator folds into goodput spread."""
+        return {
+            "kind": "frontend",
+            "instance_id": self._fleet_instance_id,
+            "models": sorted(self.manager.models),
+            "inflight": self.inflight,
+            "busy_threshold": self.busy_threshold,
+            "slo": self.slo_plane.summary(),
+        }
+
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> "HttpService":
         self._runner = web.AppRunner(self.app)
@@ -916,6 +931,29 @@ class HttpService:
         await site.start()
         if self.slo_plane.config.targets_set:
             self._slo_task = asyncio.create_task(self._slo_publish_loop())
+        # fleet introspection plane: register the frontend's state dump
+        # and — when a system-status server is up — a discovery instance
+        # under {ns}/frontend/http so obs/fleet.py discovers this
+        # process the same way it discovers workers (no router watches
+        # that component/endpoint, so serving is unaffected)
+        rt = self.runtime
+        from ..runtime.discovery import Instance, new_instance_id
+
+        self._fleet_instance_id = new_instance_id()
+        rt.register_debug_source(f"frontend:{self._fleet_instance_id}",
+                                 self.debug_state)
+        self._fleet_instance = None
+        if rt.system_address:
+            port = self._runner.addresses[0][1]
+            self._fleet_instance = Instance(
+                namespace=rt.config.namespace, component="frontend",
+                endpoint="http", instance_id=self._fleet_instance_id,
+                address=f"{rt.config.tcp_host}:{port}",
+                metadata={"kind": "frontend",
+                          "system_addr": rt.system_address},
+            )
+            await rt.discovery.put(self._fleet_instance.key(),
+                                   self._fleet_instance.to_dict())
         logger.info("HTTP service on %s:%d", self.host, self.port)
         return self
 
@@ -935,6 +973,17 @@ class HttpService:
             pass
 
     async def close(self) -> None:
+        if getattr(self, "_fleet_instance_id", None) is not None:
+            self.runtime.unregister_debug_source(
+                f"frontend:{self._fleet_instance_id}")
+        if getattr(self, "_fleet_instance", None) is not None:
+            try:
+                await self.runtime.discovery.delete(
+                    self._fleet_instance.key())
+            except Exception:
+                logger.warning("fleet instance deregistration failed",
+                               exc_info=True)
+            self._fleet_instance = None
         # cancel in-flight batch jobs BEFORE tearing the pipelines down
         # (a running batch would keep calling handlers on a dead service)
         await self.extra.close()

@@ -129,7 +129,7 @@ def builtin_hash(mod: Module) -> Iterable[Finding]:
 # ---------------------------------------------------------------------------
 
 _METRIC_METHODS = {"counter", "gauge", "histogram", "inc", "observe",
-                   "set_gauge"}
+                   "set", "set_gauge"}
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
 
@@ -138,7 +138,9 @@ _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
     "metric family defined without the dynamo_ prefix",
     "PR 7: the scrape-contract test asserts every exported family is "
     "dynamo_-prefixed at runtime; this is its static twin, catching the "
-    "definition site before a worker ever serves /metrics",
+    "definition site before a worker ever serves /metrics (PR 10 widened "
+    "it to MetricsHierarchy.set so the fleet aggregator's dynamo_fleet_* "
+    "gauge definitions are in scope)",
     applies=_in_pkg_or_tests)
 def metric_prefix(mod: Module) -> Iterable[Finding]:
     for node in ast.walk(mod.tree):
@@ -439,6 +441,7 @@ def _has_len_guard(fn: ast.AST, name: str) -> bool:
 _PRINT_OK = (
     "__main__.py",                 # CLI entrypoints print by design
     "dynamo_tpu/obs/report.py",    # report CLIs
+    "dynamo_tpu/obs/fleet.py",     # fleet snapshot CLI
     "dynamo_tpu/profiler/",
     "dynamo_tpu/loadgen/",
     "dynamo_tpu/lint/cli.py",      # the lint's own CLI output

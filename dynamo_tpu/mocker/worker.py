@@ -39,6 +39,13 @@ class MockerWorker:
         self.engine: Optional[MockEngine] = None
         self.served = None
         self._load_task: Optional[asyncio.Task] = None
+        # local FPM window: load loop feeds it; /debug/state reads
+        # compile stats + ITL p95 (same shape as the JAX worker, so the
+        # fleet plane is tier-1 testable CPU-only)
+        from ..planner.metrics import FpmWindow
+
+        self._fpm_window = FpmWindow()
+        self._debug_source_name: Optional[str] = None
 
     @property
     def card(self) -> ModelDeploymentCard:
@@ -170,9 +177,71 @@ class MockerWorker:
         ]
         await register_model(rt, self.card, instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
+        # fleet introspection: this worker's live state on /debug/state
+        self._debug_source_name = f"worker:{instance_id}"
+        rt.register_debug_source(self._debug_source_name, self.debug_state)
         logger.info("mocker worker %d serving model %s",
                     instance_id, self.args.model_name)
         return self
+
+    def debug_state(self) -> dict:
+        """Live scheduler/KV/drain snapshot for /debug/state — the same
+        contract JaxEngineWorker.debug_state serves, from the simulated
+        engines (summed across dp ranks; each rank owns its own KV
+        pool, so used/capacity SUM like the load loop's gauges)."""
+        engines = getattr(self, "engines", None) or (
+            [self.engine] if self.engine else [])
+        slots = []
+        waiting = []
+        for eng in engines:
+            for seq in list(eng.running):
+                slots.append({
+                    "request_id": seq.request_id,
+                    "prompt_len": seq.num_prompt_tokens,
+                    "generated": seq.generated,
+                    "prefilling": seq.prefill_pos < seq.num_prompt_tokens,
+                    "pulling": False,
+                    "inflight": 0,
+                    "cached_tokens": seq.cached_blocks
+                    * self.args.block_size,
+                })
+            waiting.extend(s.request_id for s in list(eng.waiting))
+        used = sum(e.cache.used_blocks for e in engines)
+        cap = sum(e.cache.num_blocks for e in engines)
+        weights = [e.num_active_seqs for e in engines] or [1]
+        if not any(weights):
+            weights = [1] * len(weights)
+        itl = (sum(w * e.itl_ema_s for w, e in zip(weights, engines))
+               / sum(weights)) if engines else 0.0
+        fw = self._fpm_window
+        return {
+            "kind": "mocker",
+            "instance_id": (self.served.instance_id
+                            if self.served is not None else None),
+            "namespace": self.namespace,
+            "component": self.component,
+            "model": self.args.model_name,
+            "role": self.args.role,
+            "draining": any(e.draining for e in engines),
+            "dead": any(e.dead for e in engines),
+            "active_seqs": sum(e.num_active_seqs for e in engines),
+            "waiting": waiting,
+            "slots": slots,
+            "tokens_in_flight": sum(
+                s["prompt_len"] + s["generated"] for s in slots),
+            "kv": {"g1": {"used": used, "free": cap - used,
+                          "capacity": cap}},
+            "kv_usage": (sum(e.kv_usage() for e in engines)
+                         / len(engines)) if engines else 0.0,
+            "kv_cache_dtype": self.args.kv_cache_dtype,
+            "itl_ema_s": itl,
+            "itl_p95_s": fw.decode_itl_p95_s(),
+            "compile": fw.compile_stats(),
+            "engine_metrics": ({k: sum(e.metrics[k] for e in engines)
+                                for k in engines[0].metrics}
+                               if engines else {}),
+            "config": dict(self.card.runtime_config),
+        }
 
     async def _load_loop(self) -> None:
         """Periodic load metrics for least-loaded / KV routing cost inputs."""
@@ -183,10 +252,9 @@ class MockerWorker:
         if tr is not None:
             tr.bind_metrics(m)
         # local FPM aggregation mirrors the JAX worker: /metrics scrapes
-        # see spec acceptance etc. without a planner attached
-        from ..planner.metrics import FpmWindow
-
-        fw = FpmWindow()
+        # see spec acceptance etc. without a planner attached (and
+        # /debug/state reads compile stats + ITL p95 off the window)
+        fw = self._fpm_window
         while True:
             await asyncio.sleep(0.25)
             if self.engine is None or self.served is None:
@@ -287,6 +355,9 @@ class MockerWorker:
     async def close(self) -> None:
         from ..protocols.model_card import deregister_model
 
+        if self._debug_source_name is not None:
+            self.runtime.unregister_debug_source(self._debug_source_name)
+            self._debug_source_name = None
         if self._load_task is not None:
             self._load_task.cancel()
         for eng in getattr(self, "engines", []) or (

@@ -52,6 +52,17 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--itl-target-ms", type=float, default=None)
     p.add_argument("--perf-model", default=None,
                    help="perf profile JSON (required for --mode sla)")
+    # fleet introspection (obs/fleet.py): merged /metrics + /debug/state
+    # scrapes folded into every tick's diag and exported as
+    # dynamo_fleet_* gauges on this process's /metrics
+    p.add_argument("--fleet-scrape", action="store_true",
+                   help="run a FleetObserver: scrape every discovered "
+                        "instance's debug surface (DYN_ADMIN_TOKEN), "
+                        "feed fleet_imbalance/straggler/kv_headroom "
+                        "into planner diag, export dynamo_fleet_* "
+                        "gauges")
+    p.add_argument("--fleet-interval", type=float, default=5.0,
+                   help="seconds between fleet scrapes")
     return p
 
 
@@ -71,9 +82,17 @@ async def main() -> None:
             raise SystemExit("--connector subprocess needs "
                              "--worker-module")
         connector = SubprocessConnector(args.worker_module, args.worker_arg)
+    fleet = None
+    if args.fleet_scrape:
+        from ..obs.fleet import FleetObserver
+
+        fleet = await FleetObserver(
+            runtime=rt, namespace=args.namespace,
+            interval_s=args.fleet_interval).start()
     planner = Planner(
         rt, args.namespace, args.component, connector,
-        PlannerConfig(
+        fleet=fleet,
+        config=PlannerConfig(
             interval_s=args.interval,
             min_replicas=args.min_replicas,
             max_replicas=args.max_replicas,
@@ -96,6 +115,8 @@ async def main() -> None:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     await planner.close()
+    if fleet is not None:
+        await fleet.close()
     await connector.close()
     await rt.shutdown()
 

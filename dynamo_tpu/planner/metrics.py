@@ -203,6 +203,33 @@ class FpmWindow:
                     steps_total += k
         return gap_total / steps_total if steps_total else 0.0
 
+    def decode_itl_p95_s(self) -> float:
+        """p95 per-token decode latency over the window's dispatch gaps
+        (each gap contributes one sample at gap/k).  The fleet
+        aggregator compares each worker's p95 against the fleet median
+        to flag stragglers — tail latency is where a sick worker shows
+        first, long before its mean moves.  0.0 when no decode records
+        are in the window.
+
+        Unlike decode_itl_s there is no gap ceiling here: both engines
+        already clamp idle-period gaps to 0.0 AT THE RECORD SOURCE
+        (their own >1s heuristic), which bounds what a tail detector
+        can see — a worker wedged harder than that surfaces through the
+        fleet plane's scrape-timeout `unreachable` mark and the
+        serving-compile hotspots instead, not through this number."""
+        from ..runtime.metrics import percentile
+
+        samples = []
+        for dq in self._window().values():
+            for _, rec in dq:
+                if rec.get("kind") != "decode":
+                    continue
+                gap = float(rec.get("gap_s", 0.0))
+                k = int(rec.get("k", 1))
+                if gap > 0.0 and k > 0:
+                    samples.append(gap / k)
+        return percentile(samples, 95.0)
+
     def prefill_tokens_per_s(self) -> float:
         """Fleet prefill token rate over the window (0.0 when idle).
 

@@ -65,7 +65,11 @@ class Planner:
     def __init__(self, runtime, namespace: str, component: str,
                  connector: Connector,
                  config: Optional[PlannerConfig] = None,
-                 perf_model=None):
+                 perf_model=None, fleet=None):
+        """fleet: an obs.fleet.FleetObserver (or anything with a
+        ``summary() -> dict|None``) whose snapshot the tick folds into
+        diag — the imbalance/straggler/KV-headroom inputs the item-4
+        controller and item-2 cost function read."""
         self.config = config or PlannerConfig()
         self.observer = LoadObserver(runtime, namespace, component)
         self.fpm: Optional[FpmObserver] = (
@@ -94,6 +98,11 @@ class Planner:
                 raise ValueError("sla mode requires at least one of "
                                  "itl_target_s / ttft_target_s")
         self.connector = connector
+        self.fleet = fleet
+        # last tick's full diag (fleet signals included), action or not:
+        # operators and tests read the tick's view here — `decisions`
+        # only records ticks that actually scaled
+        self.last_diag: dict = {}
         self._task: Optional[asyncio.Task] = None
         self._last_action_t = 0.0
         self._low_ticks = 0
@@ -159,6 +168,22 @@ class Planner:
             proposed = self._propose_sla(load, predicted, diag)
         else:
             proposed = math.ceil(predicted / c.target_active_per_replica)
+        # fleet introspection plane (obs/fleet.py): the merged-scrape
+        # signals the SLA controller and the KV-aware cost function
+        # read — imbalance says load is skewed even when the mean looks
+        # fine, headroom says where admission will park next, a
+        # straggler says p95 will breach before the mean ITL moves
+        fleet = getattr(self, "fleet", None)  # tests build bare planners
+        fs = fleet.summary() if fleet is not None else None
+        if fs is not None:
+            diag["fleet_imbalance"] = fs["imbalance"]
+            diag["fleet_straggler"] = fs["straggler_count"]
+            diag["fleet_kv_headroom"] = fs["kv_headroom_min"]
+            if fs.get("unreachable"):
+                diag["fleet_unreachable"] = fs["unreachable"]
+            if fs.get("draining"):
+                diag["fleet_draining"] = fs["draining"]
+        self.last_diag = diag
         if load.workers and load.mean_kv_usage >= c.kv_pressure_threshold:
             proposed += 1
         # min_replicas=0 is scale-to-zero: the floor comes only from config

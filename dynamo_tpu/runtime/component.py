@@ -96,13 +96,19 @@ class Endpoint:
         rt = self.runtime
         address = await rt.request_server.start()
         iid = instance_id if instance_id is not None else new_instance_id()
+        meta = dict(metadata or {})
+        # fleet introspection (obs/fleet.py): every instance advertises
+        # where its /metrics + /debug/state surface lives, so the
+        # aggregator needs no out-of-band port map
+        if rt.system_address and "system_addr" not in meta:
+            meta["system_addr"] = rt.system_address
         instance = Instance(
             namespace=self.component.namespace.name,
             component=self.component.name,
             endpoint=self.name,
             instance_id=iid,
             address=address,
-            metadata=metadata or {},
+            metadata=meta,
         )
         rt.request_server.register_handler(self.path, handler, iid)
         if health_check_payload is not None:

@@ -51,6 +51,10 @@ class RuntimeConfig:
 
     namespace: str = "dynamo"
     system_port: int = 0  # /health /live /metrics server; 0 = disabled
+    # admin surface (system_status.py /debug/*): shared secret required
+    # for state dumps and profiler captures; empty = admin routes return
+    # 403 (fail closed).  /health /live /metrics stay unauthenticated.
+    admin_token: str = ""
 
     extra: dict = field(default_factory=dict)
 
@@ -68,6 +72,7 @@ class RuntimeConfig:
             zmq_host=os.environ.get("DYN_ZMQ_HOST", ""),
             namespace=os.environ.get("DYN_NAMESPACE", "dynamo"),
             system_port=int(os.environ.get("DYN_SYSTEM_PORT", "0")),
+            admin_token=os.environ.get("DYN_ADMIN_TOKEN", ""),
         )
         for k, v in overrides.items():
             setattr(cfg, k, v)

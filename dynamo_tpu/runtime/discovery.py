@@ -276,10 +276,17 @@ class FileDiscovery(DiscoveryBackend):
     (interval default 100ms) — fine for control-plane rates.
     """
 
-    def __init__(self, root: str, ttl_s: float = 5.0, poll_s: float = 0.1):
+    def __init__(self, root: str, ttl_s: float = 5.0, poll_s: float = 0.1,
+                 read_only: bool = False):
         self.root = root
         self.ttl_s = ttl_s
         self.poll_s = poll_s
+        # read_only: an observer (fleet CLI, dashboards) that must never
+        # reap expired files — reaping is a participant's job, and an
+        # observer launched with a mismatched DYN_LEASE_TTL would
+        # otherwise unlink LIVE leases (heartbeats only utime existing
+        # paths, so a reaped key never comes back)
+        self.read_only = read_only
         self._owned: set[str] = set()
         self._owned_values: Dict[str, Dict[str, Any]] = {}
         self._hb_task: Optional[asyncio.Task] = None
@@ -363,11 +370,12 @@ class FileDiscovery(DiscoveryBackend):
                 try:
                     st = os.stat(full)
                     if now - st.st_mtime > self.ttl_s:
-                        # expired lease — reap so watchers converge
-                        try:
-                            os.unlink(full)
-                        except OSError:
-                            pass
+                        if not self.read_only:
+                            # expired lease — reap so watchers converge
+                            try:
+                                os.unlink(full)
+                            except OSError:
+                                pass
                         continue
                     with open(full) as f:
                         out[key] = json.load(f)
@@ -422,7 +430,11 @@ class FileDiscovery(DiscoveryBackend):
 
 def make_discovery(backend: str, *, path: str = "", ttl_s: float = 5.0,
                    cluster_id: str = "default",
-                   etcd_endpoint: str = "") -> DiscoveryBackend:
+                   etcd_endpoint: str = "",
+                   read_only: bool = False) -> DiscoveryBackend:
+    """read_only: observer processes (fleet CLI, dashboards) that must
+    not mutate cluster state — currently only the file backend's
+    expired-lease reaping is affected."""
     if backend == "mem":
         return MemDiscovery(cluster_id=cluster_id)
     if backend == "file":
@@ -430,7 +442,7 @@ def make_discovery(backend: str, *, path: str = "", ttl_s: float = 5.0,
         # etcd backend for anything resembling production
         if not path:
             raise ValueError("file discovery requires DYN_DISCOVERY_PATH")
-        return FileDiscovery(path, ttl_s=ttl_s)
+        return FileDiscovery(path, ttl_s=ttl_s, read_only=read_only)
     if backend == "etcd":
         from .etcd import EtcdDiscovery
 

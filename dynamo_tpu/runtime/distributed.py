@@ -59,6 +59,12 @@ class DistributedRuntime:
         self.system_health = SystemHealth(self)
         self.request_server.on_activity = self.system_health.notify_activity
         self._system_server = None
+        # fleet introspection plane (obs/fleet.py): workers/frontends
+        # register state-dump callables here; /debug/state merges them,
+        # and system_address is what instances advertise in discovery so
+        # the fleet aggregator can find this process's scrape surface
+        self.debug_sources: dict = {}
+        self.system_address: str = ""
         self._closed = False
 
     @classmethod
@@ -69,13 +75,32 @@ class DistributedRuntime:
     def namespace(self, name: Optional[str] = None) -> Namespace:
         return Namespace(self, name or self.config.namespace)
 
+    def register_debug_source(self, name: str, fn) -> None:
+        """Register a callable (sync or async, returning a JSON-able
+        dict) merged into /debug/state under `name`.  Worker sources
+        include their `instance_id` so the fleet aggregator can join a
+        dump entry to the discovery instance it describes."""
+        self.debug_sources[name] = fn
+
+    def unregister_debug_source(self, name: str) -> None:
+        self.debug_sources.pop(name, None)
+
     async def start(self) -> "DistributedRuntime":
         await self.discovery.start()
         if self.config.system_port:
             from .system_status import SystemStatusServer
 
-            self._system_server = SystemStatusServer(self, self.config.system_port)
+            # negative = ephemeral (DYN_SYSTEM_PORT=-1): multi-process
+            # single-host fleets can't share a fixed port, and the fleet
+            # aggregator finds the bound port via discovery metadata
+            self._system_server = SystemStatusServer(
+                self, max(0, self.config.system_port))
             await self._system_server.start()
+            # advertise the scrape surface on the request-plane host (the
+            # bind is 0.0.0.0; the reachable address is the same one the
+            # request plane advertises)
+            self.system_address = (f"{self.config.tcp_host}:"
+                                   f"{self._system_server.bound_port}")
         return self
 
     async def shutdown(self) -> None:

@@ -94,5 +94,20 @@ class MetricsHierarchy:
             **self.labels, **extra
         ).observe(value)
 
+    def remove(self, name: str, **extra) -> None:
+        """Drop one labeled sample from an existing family (e.g. a
+        departed worker's gauge — a stale label would otherwise freeze
+        its last value into every future scrape); with no extra labels
+        it drops this hierarchy's own sample of a plain family.  No-op
+        when the family or sample doesn't exist.  Label-value ordering
+        is owned here, next to the label-name ordering `_get` defines."""
+        m = self._metrics.get(name)
+        if m is None:
+            return
+        try:
+            m.remove(*self.labels.values(), *extra.values())
+        except KeyError:
+            pass
+
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -88,3 +88,9 @@ def test_bench_serving_json_carries_slo_and_roofline_blocks():
         assert roof["compiles"].get("prefill", 0) >= 1
         assert roof["compiles"].get("decode", 0) >= 1
         assert "decode" in roof["mfu"] and "decode" in roof["mbu"]
+        # fleet block (obs/fleet.py): peak imbalance / straggler count /
+        # min KV headroom scraped back off the run's own registry
+        fleet = rep["fleet"]
+        assert fleet["imbalance"] >= 1.0
+        assert fleet["stragglers"] >= 0
+        assert 0.0 <= fleet["kv_headroom_min"] <= 1.0
