@@ -48,11 +48,53 @@ SIM_PEAK_TFLOPS = 50.0
 SIM_PEAK_HBM_GBPS = 100.0
 
 
-def engine_args(role="both"):
+def engine_args(role="both", overlap=True, fused=8):
     return MockEngineArgs(model_name="bench", block_size=BLOCK,
                           num_blocks=8192, speedup_ratio=1.0, role=role,
                           peak_tflops=SIM_PEAK_TFLOPS,
-                          peak_hbm_gbps=SIM_PEAK_HBM_GBPS)
+                          peak_hbm_gbps=SIM_PEAK_HBM_GBPS,
+                          overlap_scheduling=overlap,
+                          decode_fused_steps=fused)
+
+
+class RunTrace:
+    """Per-topology span recording: each bench run gets its own Tracer
+    (service tagged with the config label, so merged dumps keep their
+    tracks distinct) and reduces its own timeline to the obs.report gap
+    block — sched_overhead/device_wait/idle/enqueue_ahead fractions and
+    cont_burst_frac land in the run's JSON line next to the latency
+    numbers they explain."""
+
+    def __init__(self, label: str, out_path: str = ""):
+        import os
+
+        from dynamo_tpu import obs
+
+        path = ""
+        if out_path:
+            # split on the BASENAME only: a dotted directory component
+            # (/runs/2026.08/trace) must not become the split point
+            root, ext = os.path.splitext(out_path)
+            path = f"{root}.{label}{ext or '.json'}"
+        self.tracer = obs.Tracer(service=f"bench-{label}",
+                                 ring=8 * obs.DEFAULT_RING,
+                                 out_path=path or None)
+        self.path = path
+
+    def __enter__(self):
+        self.tracer.install()
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer.uninstall()
+        return False
+
+    def gap(self):
+        from dynamo_tpu.obs.report import events_of_doc, report
+
+        if self.path:
+            self.path = self.tracer.dump() or ""
+        return report(events_of_doc(self.tracer.chrome_trace()))["gap"]
 
 
 async def sample_fleet_peaks(workers, stop: asyncio.Event, peaks: dict):
@@ -139,10 +181,11 @@ async def collect_roofline(rt):
     return out
 
 
-async def bench_agg(rows, n_workers, args):
+async def bench_agg(rows, n_workers, args, overlap=True, label="agg"):
     rt = await fresh_runtime().start()
     workers = [
-        await MockerWorker(rt, engine_args(), component="backend").start()
+        await MockerWorker(rt, engine_args(overlap=overlap),
+                           component="backend").start()
         for _ in range(n_workers)
     ]
     client = await (rt.namespace("dynamo").component("backend")
@@ -150,30 +193,33 @@ async def bench_agg(rows, n_workers, args):
     await client.wait_for_instances()
     stop, peaks = asyncio.Event(), {}
     sampler = asyncio.create_task(sample_fleet_peaks(workers, stop, peaks))
-    try:
-        report = await replay(client.generate, rows, block_size=BLOCK,
-                              speedup=args.speedup)
-    finally:
-        stop.set()
-        await sampler
-    roofline = await collect_roofline(rt)
+    with RunTrace(label, args.trace_out) as rtrace:
+        try:
+            report = await replay(client.generate, rows, block_size=BLOCK,
+                                  speedup=args.speedup)
+        finally:
+            stop.set()
+            await sampler
+        roofline = await collect_roofline(rt)
+    gap = rtrace.gap()
     fleet = await collect_fleet(rt, workers, peaks)
     await client.close()
     for w in workers:
         await w.close()
     await rt.shutdown()
-    return report, roofline, fleet
+    return report, roofline, fleet, gap, rtrace.path
 
 
-async def bench_disagg(rows, n_prefill, n_decode, args):
+async def bench_disagg(rows, n_prefill, n_decode, args, overlap=True,
+                       label="disagg"):
     rt = await fresh_runtime().start()
     prefills = [
-        await MockerWorker(rt, engine_args("prefill"),
+        await MockerWorker(rt, engine_args("prefill", overlap=overlap),
                            component="prefill").start()
         for _ in range(n_prefill)
     ]
     decodes = [
-        await MockerWorker(rt, engine_args("decode"),
+        await MockerWorker(rt, engine_args("decode", overlap=overlap),
                            component="backend").start()
         for _ in range(n_decode)
     ]
@@ -195,13 +241,15 @@ async def bench_disagg(rows, n_prefill, n_decode, args):
     stop, peaks = asyncio.Event(), {}
     sampler = asyncio.create_task(
         sample_fleet_peaks(prefills + decodes, stop, peaks))
-    try:
-        report = await replay(client_fn, rows, block_size=BLOCK,
-                              speedup=args.speedup)
-    finally:
-        stop.set()
-        await sampler
-    roofline = await collect_roofline(rt)
+    with RunTrace(label, args.trace_out) as rtrace:
+        try:
+            report = await replay(client_fn, rows, block_size=BLOCK,
+                                  speedup=args.speedup)
+        finally:
+            stop.set()
+            await sampler
+        roofline = await collect_roofline(rt)
+    gap = rtrace.gap()
     fleet = await collect_fleet(rt, prefills + decodes, peaks)
     await orch.close()
     await pclient.close()
@@ -209,7 +257,7 @@ async def bench_disagg(rows, n_prefill, n_decode, args):
     for w in prefills + decodes:
         await w.close()
     await rt.shutdown()
-    return report, roofline, fleet
+    return report, roofline, fleet, gap, rtrace.path
 
 
 async def main():
@@ -232,18 +280,18 @@ async def main():
                    help="mean-ITL SLO target in ms (overrides "
                         "--slo-itl)")
     p.add_argument("--trace-out", default="",
-                   help="record the run's timeline spans (obs/) and dump "
-                        "a Perfetto-loadable Chrome trace here; also "
-                        "prints the obs.report gap-attribution line")
+                   help="dump each topology's Perfetto-loadable Chrome "
+                        "trace to PATH with the config label inserted "
+                        "before the extension, and print a merged "
+                        "obs.report gap-attribution line (the per-run "
+                        "gap fracs are in every JSON line regardless)")
+    p.add_argument("--overlap", choices=["on", "off", "ab"], default="on",
+                   help="scheduler mode for the mocker engines: "
+                        "overlapped (default), lockstep sync, or 'ab' — "
+                        "run every topology in BOTH modes so the "
+                        "overlapped scheduler's win is measurable in "
+                        "one invocation")
     args = p.parse_args()
-
-    tracer = None
-    if args.trace_out:
-        from dynamo_tpu import obs
-
-        tracer = obs.Tracer(service="bench_serving",
-                            ring=4 * obs.DEFAULT_RING,
-                            out_path=args.trace_out).install()
 
     rows = synthesize(args.requests, rate_rps=args.rate,
                       input_len=args.input_len, output_len=args.output_len,
@@ -254,13 +302,21 @@ async def main():
     slo_itl_s = (args.slo_itl_ms / 1000.0
                  if args.slo_itl_ms is not None else args.slo_itl)
 
-    def line(config, summary, roofline, fleet):
+    # the headline gap-report fracs every JSON line carries (the
+    # item-3 scoreboard: sched_overhead -> ~0 and cont_burst -> 1 is
+    # what the overlapped scheduler is FOR; the rest partitions where
+    # the remaining wall time goes)
+    GAP_KEYS = ("sched_overhead_frac", "enqueue_ahead_frac",
+                "device_wait_frac", "idle_frac", "cont_burst_frac")
+
+    def line(config, summary, roofline, fleet, gap):
         # stable bench JSON schema: the `slo` block mirrors the
         # frontend SLO plane's vocabulary (targets + goodput fraction),
         # `roofline` the worker gauges, `fleet` the obs.fleet headline
-        # at peak (imbalance, straggler count, min KV headroom), so a
-        # scoreboard diff across rounds reads the same numbers a live
-        # scrape would
+        # at peak (imbalance, straggler count, min KV headroom), and
+        # `gap` the obs.report wall partition of this run's own engine
+        # tracks — a scoreboard diff across rounds reads the same
+        # numbers a live scrape/trace would
         gp = summary.get("goodput", {})
         total = summary.get("requests", 0)
         return json.dumps({
@@ -273,29 +329,39 @@ async def main():
             },
             "roofline": roofline,
             "fleet": fleet,
+            "gap": {k: gap[k] for k in GAP_KEYS if k in gap},
         })
 
-    agg, agg_roof, agg_fleet = await bench_agg(rows, args.workers, args)
-    print(line(f"agg-{args.workers}w",
-               agg.summary(slo_ttft_s, slo_itl_s), agg_roof, agg_fleet))
-    dis, dis_roof, dis_fleet = await bench_disagg(
-        rows, max(1, args.workers // 2), max(1, args.workers // 2), args)
-    print(line(f"disagg-{max(1, args.workers // 2)}p"
-               f"{max(1, args.workers // 2)}d",
-               dis.summary(slo_ttft_s, slo_itl_s), dis_roof, dis_fleet))
+    modes = {"on": [(True, "overlap")], "off": [(False, "sync")],
+             "ab": [(False, "sync"), (True, "overlap")]}[args.overlap]
+    np_, nd = max(1, args.workers // 2), max(1, args.workers // 2)
+    trace_paths = []
+    for ov, tag in modes:
+        suffix = f"-{tag}" if args.overlap == "ab" else ""
+        label = f"agg-{args.workers}w{suffix}"
+        agg, roof, fleet, gap, path = await bench_agg(
+            rows, args.workers, args, overlap=ov, label=label)
+        trace_paths.append(path)
+        print(line(label, agg.summary(slo_ttft_s, slo_itl_s), roof,
+                   fleet, gap))
+        label = f"disagg-{np_}p{nd}d{suffix}"
+        dis, roof, fleet, gap, path = await bench_disagg(
+            rows, np_, nd, args, overlap=ov, label=label)
+        trace_paths.append(path)
+        print(line(label, dis.summary(slo_ttft_s, slo_itl_s), roof,
+                   fleet, gap))
 
-    if tracer is not None:
+    if args.trace_out:
         from dynamo_tpu.obs.report import report_paths
 
-        path = tracer.dump()
-        tracer.uninstall()
-        if path is None:
+        paths = [p for p in trace_paths if p]
+        if not paths:
             print(json.dumps({"config": "trace",
                               "error": f"trace dump to "
                                        f"{args.trace_out!r} failed"}))
         else:
-            print(json.dumps({"config": "trace", "trace_out": path,
-                              **report_paths([path])["gap"]}))
+            print(json.dumps({"config": "trace", "trace_out": paths,
+                              **report_paths(paths)["gap"]}))
 
 
 if __name__ == "__main__":

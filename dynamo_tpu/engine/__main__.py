@@ -101,6 +101,19 @@ def build_args() -> argparse.ArgumentParser:
                         "to finish before the rest error with the "
                         "migratable 'worker draining' marker and replay "
                         "on surviving workers")
+    p.add_argument("--no-overlap-scheduling", action="store_true",
+                   help="lockstep reference scheduler (schedule -> "
+                        "dispatch -> block -> emit) instead of the "
+                        "overlapped default; greedy output is "
+                        "byte-identical, served throughput is not")
+    p.add_argument("--no-adaptive-fusion", action="store_true",
+                   help="always dispatch full decode_fused_steps bursts "
+                        "when no prefill is pending, instead of ramping "
+                        "the burst size up a decode-only stretch")
+    p.add_argument("--slo-yield-burn", type=float, default=1.0,
+                   help="SLA-aware admission: prefill chunks yield "
+                        "budget to decode while the frontend-published "
+                        "SLO burn rate exceeds this (0 disables)")
     return p
 
 
@@ -142,6 +155,9 @@ async def main() -> None:
         spec_k=args.spec_k,
         spec_draft_model=args.spec_draft_model,
         spec_draft_model_path=args.spec_draft_model_path,
+        overlap_scheduling=not args.no_overlap_scheduling,
+        decode_fuse_adaptive=not args.no_adaptive_fusion,
+        slo_yield_burn=args.slo_yield_burn,
     )
     rt = await DistributedRuntime.detached().start()
     worker = await JaxEngineWorker(

@@ -64,7 +64,44 @@ class EngineConfig:
     # on the tunneled v5e, served throughput plateaus at depth 4 (~80% of
     # the raw on-device loop).  Latency-sensitive deployments can trade
     # throughput for (d-1)*decode_fused_steps fewer tokens of stream lag.
+    # Only effective with overlap_scheduling on; sync mode is lockstep
+    # (depth 1 and drain-after-dispatch) regardless of this value.
     decode_pipeline_depth: int = 4
+    # overlapped scheduler (the ROADMAP item-3 refactor): while step N's
+    # programs execute on device, the host schedules and enqueues step
+    # N+1 — decode bursts pipeline to decode_pipeline_depth, a completing
+    # prefill chunk's first-token readback is DEFERRED one step (the
+    # device_wait then pays only for the previous step's work, and
+    # streaming emission is one step late for exactly that first token),
+    # and host scheduling done while the device is busy is attributed to
+    # the `enqueue_ahead` span instead of `sched` (obs/report.py keeps
+    # the wall partition exact; sched_overhead_frac counts only host
+    # time the device actually waited on).  False = lockstep reference
+    # mode: schedule -> dispatch -> block on device -> emit, greedy
+    # byte-identical to overlapped mode by construction (the test matrix
+    # in tests/test_overlap.py asserts it, including cancellation, chaos
+    # and drain).
+    overlap_scheduling: bool = True
+    # adaptive decode fusion: in a decode-only stretch the burst size
+    # ramps INTERLEAVE_BURST -> 2x -> ... -> decode_fused_steps (one
+    # compiled variant per ladder rung, all warmed by warmup_decode) and
+    # de-fuses back to the interleave burst the step a new arrival,
+    # cancellation, or pending prefill chunk appears — so steady-state
+    # throughput gets the full fusion while TTFT under arrivals is
+    # bounded by a short burst.  False = the pre-adaptive policy (full
+    # decode_fused_steps whenever no prefill/admission work is pending).
+    decode_fuse_adaptive: bool = True
+    # SLA-aware admission (closes the PR 1 mixed-scheduling loop against
+    # the PR 7 SLO plane): when the frontend-published error-budget burn
+    # rate (obs/slo.py; worst window, fed to the engine by the worker's
+    # slo_metrics subscription) exceeds this threshold while decodes are
+    # active, the per-step prefill chunk budget is scaled down by
+    # threshold/burn (floored at the smallest prefill bucket) — prefill
+    # chunks yield to decode until ITL recovers.  0 disables.
+    slo_yield_burn: float = 1.0
+    # a burn signal older than this is ignored (frontend gone / SLO
+    # plane off must not keep throttling prefill forever)
+    slo_burn_stale_s: float = 10.0
     prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
     # per-scheduler-step token budget: one prefill chunk is capped to
     # max_batch_tokens minus one token per decoding slot, so decode ITL is

@@ -117,6 +117,10 @@ class _Slot:
     inflight: int = 0
     # bumped on preemption so stale in-flight bursts are discarded
     epoch: int = 0
+    # overlapped scheduling: the prompt is fully prefilled but the first
+    # sampled token is still riding _pending_first (deferred readback) —
+    # decode/spec skip the slot until the next step's flush emits it
+    awaiting_first: bool = False
     # guided decoding (guided/json_prefix.py): constrained slots step
     # one token at a time through the top-M candidate path instead of
     # joining fused batch bursts
@@ -293,6 +297,23 @@ class JaxEngine:
                 self.params = shard_params(params, self.mesh)
             self.kv = self._init_kv_cache()
 
+        # pinned output shardings for every KV-returning program: XLA is
+        # otherwise free to pick a DIFFERENT (equivalent) sharding for a
+        # program's kv output than the cache was initialized with, and the
+        # C++ dispatch cache keys on input sharding — so the next program
+        # that consumed the drifted kv forked its executable (the
+        # committed-vs-uncommitted packed-prefill fork the PR 7 watchdog
+        # measured at 8-14s mid-serving on TPU).  Pinning the kv outputs
+        # to the canonical cache shardings (and the small host-bound
+        # outputs to replicated) makes every program's kv round-trip
+        # sharding-stable: one executable per shape, period.
+        self._rep_sharding = NamedSharding(self.mesh, P())
+        kv_specs = list(self.family.kv_cache_specs())
+        if is_quantized(self.kv):
+            kv_specs += list(self.family.kv_cache_scale_specs())
+        self._kv_shardings = tuple(
+            NamedSharding(self.mesh, spec) for spec in kv_specs)
+
         # compile watchdog + roofline (obs/compile_watch.py) is
         # constructed FIRST so every jit below is a WatchedProgram from
         # the moment it exists — a compile (warmup or the mid-serving
@@ -321,6 +342,13 @@ class JaxEngine:
         _toks2 = lambda a: a[2].shape[-1]           # noqa: E731
         _toks2_total = lambda a: int(               # noqa: E731
             np.prod(a[2].shape))
+        # out_shardings pytrees: kv pinned canonical, everything else
+        # replicated (token/descriptor outputs are [B]-sized and host
+        # bound — see the _kv_shardings rationale above)
+        rep = self._rep_sharding
+        kvsh = self._kv_shardings
+        _decode_out = (rep, kvsh, rep, rep, rep)
+        _prefill_out = (rep, kvsh)
         # decode variants: {greedy: jitted} — an all-greedy batch takes the
         # argmax specialization (sampling machinery measurably costs on
         # large vocabs even top-k-capped)
@@ -331,16 +359,19 @@ class JaxEngine:
                 partial(self._decode_impl, self.family, self.model_cfg,
                         self.mesh, g),
                 donate_argnums=(1, 5, 7, 9),
+                out_shardings=_decode_out,
             ), "decode")
             for g in (False, True)
         }
         self._jit_prefill = w.wrap(jax.jit(
             partial(self._prefill_impl, self.family, self.model_cfg),
             donate_argnums=(1,),
+            out_shardings=_prefill_out,
         ), "prefill", _toks2)
         self._jit_prefill_batched = w.wrap(jax.jit(
             partial(self._prefill_batched_impl, self.family, self.model_cfg),
             donate_argnums=(1,),
+            out_shardings=_prefill_out,
         ), "prefill_batched", _toks2_total)
         # packed chunked prefill (engine/prefill.py planner +
         # ops/packed_prefill.py): the padding-free multi-sequence path.
@@ -364,6 +395,7 @@ class JaxEngine:
                 partial(self._prefill_packed_impl, self.family,
                         self.model_cfg),
                 donate_argnums=(1,),
+                out_shardings=_prefill_out,
             ), "prefill_packed", _toks2)
         # speculative decoding (spec/): like prefill_packed, the verify
         # jit exists whenever the FAMILY supports it — a multi-host
@@ -375,6 +407,7 @@ class JaxEngine:
                 partial(self._spec_verify_impl, self.family,
                         self.model_cfg),
                 donate_argnums=(1,),
+                out_shardings=(rep, rep, rep, kvsh),
             ), "spec_verify", _toks2)
         self.proposer = None
         self._spec_ok = False
@@ -429,22 +462,30 @@ class JaxEngine:
                 partial(self._prefill_ring_impl, self.family,
                         self.model_cfg, self.mesh),
                 donate_argnums=(1,),
+                out_shardings=_prefill_out,
             ), "prefill_ring", _toks2)
         self._jit_inject = w.wrap(
-            jax.jit(self._inject_impl, donate_argnums=(0,)), "inject",
+            jax.jit(self._inject_impl, donate_argnums=(0,),
+                    out_shardings=kvsh), "inject",
             lambda a: a[3].shape[0])
         self._jit_gather = w.wrap(
             jax.jit(self._gather_impl), "gather", lambda a: a[1].shape[0])
+        # fused decode: one compiled variant per (greedy, k) ladder rung
+        # (adaptive fusion ramps k through _fuse_ladder; a fixed
+        # num_steps program dispatched at a smaller accounting k would
+        # waste (num_steps - k)/num_steps of every interleave burst's
+        # decode compute).  All rungs are warmed by warmup_decode.
         self._jit_decode_multi = None
         if config.decode_fused_steps > 1:
             self._jit_decode_multi = {
-                g: w.wrap(jax.jit(
+                (g, k): w.wrap(jax.jit(
                     partial(self._decode_multi_impl, self.family,
-                            self.model_cfg, self.mesh, g,
-                            config.decode_fused_steps),
+                            self.model_cfg, self.mesh, g, k),
                     donate_argnums=(1, 5, 7, 9),
+                    out_shardings=_decode_out,
                 ), "decode_multi")
                 for g in (False, True)
+                for k in self._fuse_ladder()[1:]
             }
 
         # continuation decode (steady state): the burst descriptor lives on
@@ -509,6 +550,21 @@ class JaxEngine:
         # dispatch-gap MFU is only meaningful when a sync landed inside
         # the gap — pure async enqueues measure host time, not compute
         self._fpm_sync_t = 0.0
+        # overlapped scheduling (config.overlap_scheduling): deferred
+        # prefill first-token readbacks — each entry holds one dispatch's
+        # sampled-token device array plus the completing slots awaiting
+        # it; flushed (ONE device_wait) at the top of the next step,
+        # while this step's programs execute behind it
+        self._overlap = bool(config.overlap_scheduling)
+        self._pending_first: List[dict] = []
+        # adaptive decode fusion: consecutive decode-only steps (the
+        # fusion ladder's ramp clock); reset on arrivals/cancellations
+        self._decode_only_run = 0
+        # SLA-aware admission: worst SLO burn rate the worker last fed
+        # us (obs/slo.py via the worker's slo_metrics subscription) and
+        # when — stale signals decay to 0 (_effective_slo_burn)
+        self._slo_burn = 0.0
+        self._slo_burn_t = 0.0
 
     # -- cache ------------------------------------------------------------
     def _init_kv_cache(self):
@@ -845,11 +901,14 @@ class JaxEngine:
         elif kind in ("decode", "decode_multi"):
             # _dispatch_decode keeps the follower's device token chain
             # symmetric with the leader's (use_chain lanes resolve to the
-            # follower's own previous burst, which is value-identical)
-            self._dispatch_decode(
-                self.config.decode_fused_steps if kind == "decode_multi"
-                else 1, a,
-            )
+            # follower's own previous burst, which is value-identical).
+            # Adaptive fusion: the leader's burst size rides the
+            # descriptor (falling back to the full fusion for streams
+            # from pre-adaptive leaders) — the follower must dispatch the
+            # SAME (greedy, k) program or the collective schedule forks.
+            k = (int(a.get("k", self.config.decode_fused_steps))
+                 if kind == "decode_multi" else 1)
+            self._dispatch_decode(k, a)
         elif kind == "decode_cont":
             # continuation bursts ship no arrays: the follower's own
             # device pack (persisted by its preceding full decode replay)
@@ -901,13 +960,9 @@ class JaxEngine:
         }
         if self.lora_bank is not None:
             zero["lidx"] = np.zeros(B, np.int32)
-        ks = [1]
-        if self.config.decode_fused_steps > 1:
-            ks.append(self.config.decode_fused_steps)
-        interleave = min(self.INTERLEAVE_BURST,
-                         self.config.decode_fused_steps)
-        if interleave not in ks:
-            ks.append(interleave)
+        # every fusion-ladder rung (adaptive bursts ramp through all of
+        # them) — a rung missing here is a mid-serving compile later
+        ks = self._fuse_ladder()
         chain0, desc0, last0 = (self._chain_tokens, self._dev_desc,
                                 self._last_desc)
         for greedy in (True, False):
@@ -936,6 +991,7 @@ class JaxEngine:
             self._task = None
         self._fail_all_streams()
         self._inflight.clear()  # drop unread bursts (streams already dead)
+        self._pending_first.clear()  # and deferred first-token readbacks
         if self.kvbm is not None:
             # quiesce: a cancelled loop task does not stop a _sched_step
             # already running in its thread, and that step may be mid-write
@@ -980,6 +1036,25 @@ class JaxEngine:
         obs.flight_dump("drain_abort")
         self._fail_all_streams(error=DRAIN_ABORT)
         self._wake.set()
+
+    def set_slo_burn(self, burn: float) -> None:
+        """SLA-aware admission input: the worst SLO error-budget burn
+        rate the frontends currently report (obs/slo.py burn_rates; fed
+        by the worker's slo_metrics subscription).  Any-thread safe (two
+        atomic float stores); consumed by _prefill_dispatch, where a
+        burn above config.slo_yield_burn makes prefill chunks yield
+        budget to decode until ITL recovers."""
+        self._slo_burn = float(burn)
+        self._slo_burn_t = time.monotonic()
+
+    def _effective_slo_burn(self) -> float:
+        """The last reported burn, or 0.0 once it has gone stale (a dead
+        frontend / disabled SLO plane must not throttle prefill
+        forever)."""
+        if time.monotonic() - self._slo_burn_t > \
+                self.config.slo_burn_stale_s:
+            return 0.0
+        return self._slo_burn
 
     @property
     def num_active_seqs(self) -> int:
@@ -1153,6 +1228,10 @@ class JaxEngine:
                 slot.finished = True
                 self._slots[i] = None
                 self._emit_events(self.allocator.free(self._seq_id(slot)))
+                # membership changed mid-stretch: de-fuse so the freed
+                # lane's capacity returns to useful work within a short
+                # burst (adaptive fusion ramps back up afterwards)
+                self._decode_only_run = 0
 
     def _seq_id(self, slot: _Slot) -> str:
         return slot.request.request_id
@@ -1278,6 +1357,7 @@ class JaxEngine:
                 # stays bit-identical with the leader's
                 self.step_sink("lora_write", {
                     "slot": np.int32(slot),
+                    # dynlint: disable=DYN011 adapter tensors are host-loaded numpy, not device arrays
                     **{k: np.asarray(v) for k, v in
                        adapter.tensors.items()},
                 })
@@ -1366,8 +1446,12 @@ class JaxEngine:
                         self.model_cfg)), "embed",
                 tokens_of=lambda a: a[0].shape[0])
         with self.mesh:
-            return np.asarray(
-                jit(jnp.asarray(toks), jnp.int32(true_len)), np.float32)
+            vec = jit(jnp.asarray(toks), jnp.int32(true_len))
+            t_obs = obs.begin()
+            out = np.asarray(vec, np.float32)
+            obs.end("device_wait", t_obs, track=self._obs_track,
+                    what="embed_fetch")
+            return out
 
     async def clear_kv_blocks(self) -> int:
         """Drop the reusable prefix cache (active sequences keep theirs)."""
@@ -1462,7 +1546,11 @@ class JaxEngine:
             # int8 scale planes): slice the pow2 padding off uniformly
             arrs = tuple(a[:, :count] for a in arrs)
             if to_host:
-                return tuple(np.asarray(a) for a in arrs)
+                t_d = obs.begin()
+                out = tuple(np.asarray(a) for a in arrs)
+                obs.end("device_wait", t_d, track=self._obs_track,
+                        what="parked_extract")
+                return out
             return arrs
 
         return await self._call_on_scheduler(gather)
@@ -1552,16 +1640,29 @@ class JaxEngine:
             # `sched` over the host-only scheduling work; the dispatch
             # phases emit their own spans inside.  Each is one
             # module-global None check when tracing is off.
+            # Overlapped mode: when unread bursts are in flight the
+            # device is still executing them, so this host scheduling
+            # work is OVERLAPPED, not overhead — it reports as
+            # `enqueue_ahead` (report.py excludes it from
+            # sched_overhead_frac; the wall partition stays exact).
             t_step = obs.begin()
             t = obs.begin()
+            overlapped = self._overlap and bool(self._inflight)
             self._process_cancellations()
             self._maybe_offload()
             self._admit_waiting()
-            obs.end("sched", t, track=self._obs_track)
+            obs.end("enqueue_ahead" if overlapped else "sched", t,
+                    track=self._obs_track)
+            # deferred first tokens from the PREVIOUS step's completing
+            # prefills: flushed before this step's dispatches, so the
+            # blocking fetch pays only for work the device has had a
+            # full step to finish (overlap mode; sync fetches inline)
+            self._flush_pending_first()
             self._prefill_step()
             self._guided_step()
             self._spec_step()
-            if any(s is not None and not s.prefilling for s in self._slots):
+            if any(s is not None and not s.prefilling
+                   and not s.awaiting_first for s in self._slots):
                 self._decode_step()
             elif self._inflight:
                 # no dispatchable decode work: flush the pipeline tail so
@@ -1829,6 +1930,20 @@ class JaxEngine:
             1 for s in self._slots if s is not None and not s.prefilling
         )
         budget = max(c.chunk_budget - decoding, c.prefill_buckets[0])
+        # SLA-aware admission (the PR 1 mixed-scheduling loop closed
+        # against the PR 7 SLO plane): when the frontier burn rate says
+        # the ITL/TTFT error budget is burning faster than allowed AND
+        # decodes are live, prefill yields chunk budget to decode —
+        # scaled by threshold/burn, floored at the smallest bucket so
+        # prefill always advances (no livelock, TTFT degrades gradually
+        # instead of decode ITL collapsing).
+        if c.slo_yield_burn > 0 and decoding:
+            burn = self._effective_slo_burn()
+            if burn > c.slo_yield_burn:
+                budget = max(int(budget * c.slo_yield_burn / burn),
+                             c.prefill_buckets[0])
+                self.metrics["slo_yield_steps"] = \
+                    self.metrics.get("slo_yield_steps", 0) + 1
         if len(pslots) == 1 and self._ring_eligible(pslots[0]):
             # long-context path (see _prefill_one's rationale)
             self._prefill_ring_one(pslots[0])
@@ -1903,23 +2018,20 @@ class JaxEngine:
             completing=sum(1 for s, ch in zip(pslots, chunks)
                            if s.prefill_pos + ch >= s.prompt_len),
             xla=self._jit_prefill_batched.cost(Bp * bucket))
-        # fetch the sampled tokens ONLY when some row completes its
-        # prompt this chunk: np.asarray is a blocking device round trip
-        # (~35-100ms through the tunnel), and intermediate chunks discard
-        # the sample anyway — per-chunk fetches were the dominant term in
-        # round 4's 2.9s TTFT (prefill MFU 9%)
-        firsts = None
-        if any(s.prefill_pos + ch >= s.prompt_len
-               for s, ch in zip(pslots, chunks)):
-            t_obs = obs.begin()
-            firsts = np.asarray(tok)
-            obs.end("device_wait", t_obs, track=self._obs_track,
-                    what="prefill_first")
-            self._fpm_sync_t = time.monotonic()
+        # the sampled tokens matter ONLY when some row completes its
+        # prompt this chunk (np.asarray is a blocking device round trip,
+        # ~35-100ms through the tunnel; intermediate chunks discard the
+        # sample — per-chunk fetches were the dominant term in round 4's
+        # 2.9s TTFT); overlap mode defers even that fetch one step
+        need = self._completing_rows(pslots, chunks)
+        firsts = (self._prefill_samples(
+            tok, [(s, i) for i, s in need.items()]) if need else None)
         for i, (slot, chunk) in enumerate(zip(pslots, chunks)):
-            self._finish_prefill_chunk(
-                slot, chunk,
-                int(firsts[i]) if firsts is not None else -1)
+            if i in need:
+                first = int(firsts[i]) if firsts is not None else None
+            else:
+                first = -1
+            self._finish_prefill_chunk(slot, chunk, first)
 
     def _fpm_prefill(self, rows: int, tokens: int, bucket: int,
                      packed: bool = False, completing: int = 0,
@@ -2040,21 +2152,18 @@ class JaxEngine:
             completing=sum(1 for s, ch in zip(plan.slots, plan.chunks)
                            if s.prefill_pos + ch >= s.prompt_len),
             xla=self._jit_prefill_packed.cost(plan.bucket))
-        # blocking token fetch only when some segment completes its
-        # prompt this chunk (see _prefill_step: intermediate chunks
-        # discard the sample)
-        firsts = None
-        if any(s.prefill_pos + ch >= s.prompt_len
-               for s, ch in zip(plan.slots, plan.chunks)):
-            t_obs = obs.begin()
-            firsts = np.asarray(tok)
-            obs.end("device_wait", t_obs, track=self._obs_track,
-                    what="prefill_first")
-            self._fpm_sync_t = time.monotonic()
+        # token fetch only when some segment completes its prompt this
+        # chunk (see _prefill_step: intermediate chunks discard the
+        # sample); overlap mode defers the readback one step
+        need = self._completing_rows(plan.slots, plan.chunks)
+        firsts = (self._prefill_samples(
+            tok, [(s, i) for i, s in need.items()]) if need else None)
         for i, (slot, chunk) in enumerate(zip(plan.slots, plan.chunks)):
-            self._finish_prefill_chunk(
-                slot, chunk,
-                int(firsts[i]) if firsts is not None else -1)
+            if i in need:
+                first = int(firsts[i]) if firsts is not None else None
+            else:
+                first = -1
+            self._finish_prefill_chunk(slot, chunk, first)
 
     def _ring_eligible(self, slot: "_Slot") -> bool:
         """A cold (prefill_pos == 0), non-LoRA prompt longer than the
@@ -2113,14 +2222,12 @@ class JaxEngine:
             rows=1, tokens=int(chunk), bucket=bucket,
             completing=int(slot.prefill_pos + chunk >= slot.prompt_len),
             xla=self._jit_prefill.cost(bucket))
-        # blocking token fetch only on the completing chunk (see
-        # _prefill_step: intermediate chunks discard the sample)
-        if pos + chunk >= slot.prompt_len:
-            t_obs = obs.begin()
-            first = int(np.asarray(tok))
-            obs.end("device_wait", t_obs, track=self._obs_track,
-                    what="prefill_first")
-            self._fpm_sync_t = time.monotonic()
+        # token fetch only on the completing chunk (see _prefill_step:
+        # intermediate chunks discard the sample); deferred in overlap
+        if pos + chunk >= slot.prompt_len \
+                and (slot.guide is None or slot.disagg_prefill):
+            arr = self._prefill_samples(tok, [(slot, 0)])
+            first = int(arr) if arr is not None else None
         else:
             first = -1
         self._finish_prefill_chunk(slot, chunk, first)
@@ -2155,12 +2262,85 @@ class JaxEngine:
         )
         self.metrics["ring_prefills"] = \
             self.metrics.get("ring_prefills", 0) + 1
-        self._finish_prefill_chunk(slot, T, int(tok))
+        if slot.guide is None or slot.disagg_prefill:
+            arr = self._prefill_samples(tok, [(slot, 0)])
+            first = int(arr) if arr is not None else None
+        else:
+            first = -1
+        self._finish_prefill_chunk(slot, T, first)
+
+    def _completing_rows(self, slots, chunks) -> Dict[int, "_Slot"]:
+        """{program row -> slot} of slots whose prompt completes this
+        chunk AND whose first sampled token is actually consumed
+        (guided non-disagg completions discard the unconstrained sample
+        and re-derive it in the guided step, so they never cost a
+        fetch)."""
+        return {
+            i: s for i, (s, ch) in enumerate(zip(slots, chunks))
+            if s.prefill_pos + ch >= s.prompt_len
+            and (s.guide is None or s.disagg_prefill)
+        }
+
+    def _prefill_samples(self, tok, entries):
+        """Completing slots' sampled first tokens, one program's worth.
+
+        Sync mode: blocking fetch now (the lockstep reference path).
+        Overlap mode: start the device->host copy and DEFER the read one
+        step (_pending_first; _flush_pending_first at the top of the
+        next step emits them) — the dispatching step never blocks on its
+        own program, so the device_wait only ever pays for work the
+        device had a full step to finish.  Returns the host array, or
+        None when deferred.  `entries` is [(slot, program row)]."""
+        if self._overlap:
+            try:
+                tok.copy_to_host_async()
+            except AttributeError:  # non-jax stand-ins in tests
+                pass
+            ents = []
+            for slot, row in entries:
+                slot.awaiting_first = True
+                ents.append((slot, (self._seq_id(slot), slot.epoch), row))
+            self._pending_first.append({"tok": tok, "entries": ents})
+            return None
+        t_obs = obs.begin()
+        arr = np.asarray(tok)
+        obs.end("device_wait", t_obs, track=self._obs_track,
+                what="prefill_first")
+        self._fpm_sync_t = time.monotonic()
+        return arr
+
+    def _flush_pending_first(self) -> None:
+        """Overlap mode: read back the PREVIOUS step's deferred prefill
+        first tokens (one blocking fetch for everything deferred, while
+        this step's dispatches run behind it) and emit or park them.
+        Entries whose slot finished, cancelled, or preempted since
+        dispatch are discarded — the same (seq_id, epoch) identity check
+        the in-flight decode bursts use."""
+        if not self._pending_first:
+            return
+        pending, self._pending_first = self._pending_first, []
+        t_obs = obs.begin()
+        arrs = [np.asarray(e["tok"]) for e in pending]
+        obs.end("device_wait", t_obs, track=self._obs_track,
+                what="prefill_first")
+        self._fpm_sync_t = time.monotonic()
+        for e, arr in zip(pending, arrs):
+            flat = np.atleast_1d(arr)
+            for slot, ident, row in e["entries"]:
+                slot.awaiting_first = False
+                if slot.finished or slot.index < 0 \
+                        or self._slots[slot.index] is not slot \
+                        or (self._seq_id(slot), slot.epoch) != ident:
+                    continue
+                self._complete_prefill(slot, int(flat[row]))
 
     def _finish_prefill_chunk(self, slot: "_Slot", chunk: int,
-                              first: int) -> None:
-        """Advance a slot past a completed chunk; emit the first token (or
-        park the KV for disagg pull) when the prompt is done."""
+                              first: Optional[int]) -> None:
+        """Advance a slot past a completed chunk.  `first` is the prompt's
+        sampled first token when it completes this chunk; -1 marks a
+        non-completing chunk (or a guided completion, which discards the
+        sample); None marks a completed prompt whose token readback is
+        deferred (_pending_first — the flush completes it next step)."""
         self.metrics["prefill_tokens"] += chunk
         slot.prefill_pos += chunk
         slot.ctx_len = slot.prefill_pos
@@ -2169,17 +2349,25 @@ class JaxEngine:
         self._commit_full_blocks(slot)
         if slot.prefilling:
             return  # more chunks to go; decode runs in between
-        slot.first_token_t = time.monotonic()
-        if slot.disagg_prefill:
-            self._park_prefilled(slot, first)
-            return
-        if slot.guide is not None:
+        if slot.guide is not None and not slot.disagg_prefill:
             # constrained output: discard the unconstrained sample and
             # re-derive the first token's logits in the guided step by
             # re-running the last prompt position (its KV rewrite is
             # value-identical)
+            slot.first_token_t = time.monotonic()
             slot.ctx_len = slot.prompt_len - 1
             slot.last_token = slot.seq.tokens[slot.prompt_len - 1]
+            return
+        if first is None:
+            return  # awaiting_first; the next step's flush completes it
+        self._complete_prefill(slot, first)
+
+    def _complete_prefill(self, slot: "_Slot", first: int) -> None:
+        """Prompt fully materialized and first token in hand: emit it (or
+        park the KV for disagg pull)."""
+        slot.first_token_t = time.monotonic()
+        if slot.disagg_prefill:
+            self._park_prefilled(slot, first)
             return
         self._push_token(slot, first)
 
@@ -2358,10 +2546,13 @@ class JaxEngine:
             # the pulled KV rides the step stream to the slice's followers
             # (device-resident tiers are gated off for multi-host slices,
             # so the padded chunks are host bytes here)
+            # dynlint: disable=DYN011 multi-host pulls are host-staged frames (device tiers gated off); these are numpy already
             desc = {"kb": np.asarray(padded[0]), "vb": np.asarray(padded[1]),
                     "ids": ids}
             if len(padded) == 4:
+                # dynlint: disable=DYN011 same host-staged frame (scale planes)
                 desc["ksb"] = np.asarray(padded[2])
+                # dynlint: disable=DYN011 same host-staged frame (scale planes)
                 desc["vsb"] = np.asarray(padded[3])
             self.step_sink("inject", desc)
         self.kv = self._jit_inject(
@@ -2491,6 +2682,7 @@ class JaxEngine:
         c = self.config
         cands = [s for s in self._slots
                  if s is not None and not s.prefilling and not s.pulling
+                 and not s.awaiting_first  # first token still deferred
                  and not s.finished and s.guide is None
                  and s.lora_idx == 0]
         if not cands:
@@ -2711,18 +2903,46 @@ class JaxEngine:
     # a prefill chunk back ~3 extra steps (~8ms of compute).
     INTERLEAVE_BURST = 4
 
+    def _fuse_ladder(self) -> List[int]:
+        """The decode-burst sizes adaptive fusion can dispatch, ascending:
+        1, then INTERLEAVE_BURST doubling up to decode_fused_steps.  One
+        compiled (greedy, k) variant exists per rung (built at __init__,
+        warmed by warmup_decode) — the ladder is the closed set of shapes
+        serving can reach, so a ramp can never compile mid-serving."""
+        fused = self.config.decode_fused_steps
+        ladder = [1]
+        k = min(self.INTERLEAVE_BURST, fused)
+        while k > ladder[-1]:
+            ladder.append(k)
+            k = min(k * 2, fused)
+        return ladder
+
     def _fused_k(self) -> int:
-        """Decode-burst size for this step.  Full bursts only when the
-        scheduler has no other work: pending admissions or prefill chunks
-        run between SHORT decode bursts (chunked-prefill interleaving),
-        and a full burst would hold them back k steps."""
+        """Decode-burst size for this step (the adaptive fusion policy).
+
+        Pending admissions or prefill chunks run between SHORT decode
+        bursts (chunked-prefill interleaving — a full burst would hold
+        them back k steps): any pending work de-fuses to the interleave
+        burst and resets the ramp.  In a decode-only stretch the burst
+        ramps up the fusion ladder one rung per step, so the steps right
+        after an arrival stay short (TTFT) while steady state reaches
+        full decode_fused_steps within log2 steps (throughput).
+        decode_fuse_adaptive=False restores the pre-adaptive jump
+        straight to decode_fused_steps."""
         c = self.config
         if self._jit_decode_multi is None:
             return 1
         if (self.waiting
-                or any(s is not None and s.prefilling for s in self._slots)):
+                or any(s is not None and (s.prefilling or s.awaiting_first)
+                       for s in self._slots)):
+            self._decode_only_run = 0
             return min(self.INTERLEAVE_BURST, c.decode_fused_steps)
-        return c.decode_fused_steps
+        if not c.decode_fuse_adaptive:
+            return c.decode_fused_steps
+        k = min(self.INTERLEAVE_BURST << self._decode_only_run,
+                c.decode_fused_steps)
+        self._decode_only_run = min(self._decode_only_run + 1, 16)
+        return k
 
     def _decode_step(self) -> None:
         c = self.config
@@ -2730,15 +2950,21 @@ class JaxEngine:
         t_obs = obs.begin()
         # pipeline: keep at most depth-1 unread bursts after this dispatch;
         # processing the oldest here overlaps its (already-complete or
-        # nearly-complete) fetch with the device compute of newer bursts
-        depth = max(1, c.decode_pipeline_depth)
+        # nearly-complete) fetch with the device compute of newer bursts.
+        # Sync mode (overlap_scheduling=False) is lockstep: depth 1 and a
+        # drain right after dispatch, so tokens emit the step they were
+        # computed — the byte-identity reference the overlap tests pin.
+        depth = max(1, c.decode_pipeline_depth) if self._overlap else 1
         while len(self._inflight) >= depth:
             self._process_oldest_burst()
         k = self._fused_k()
         # slots that speculated this step already emitted synchronously
-        # (engine/_spec_step); dispatching them again would double-step
+        # (engine/_spec_step); dispatching them again would double-step.
+        # awaiting_first slots have no last_token yet (deferred prefill
+        # readback) — they join decode the step after their flush.
         active = [s for s in self._slots
                   if s is not None and not s.prefilling
+                  and not s.awaiting_first
                   and s.guide is None and s.index not in self._specced]
         if not active:
             return
@@ -2793,10 +3019,25 @@ class JaxEngine:
 
         active = [s for s in self._slots
                   if s is not None and not s.prefilling
+                  and not s.awaiting_first
                   and s.guide is None and s.index not in self._specced]
         if not active:
             return
 
+        # from here to the dispatch call is host work building + enqueuing
+        # the NEXT burst; with unread bursts in flight the device is still
+        # executing, so this is the overlapped enqueue-ahead phase, not
+        # scheduler overhead (obs taxonomy: `enqueue_ahead`, nested inside
+        # decode_dispatch so the report's innermost-span attribution keeps
+        # the wall partition exact).
+        t_ea = obs.begin() if (self._overlap and self._inflight) else 0.0
+        # NOTE on buffer reuse: these descriptor arrays CANNOT be pooled /
+        # double-buffered in place — jax.device_put may alias numpy memory
+        # zero-copy (it does on CPU), continuation bursts keep the aliased
+        # device descriptor live indefinitely, and the step sink hands the
+        # same arrays to the loop thread.  Fresh arrays per full dispatch
+        # are the double buffer: the previous generation stays pinned by
+        # the in-flight burst while this one is built.
         tokens = np.zeros(B, np.int32)
         use_chain = np.zeros(B, bool)
         positions = np.zeros(B, np.int32)
@@ -2862,7 +3103,11 @@ class JaxEngine:
                 self.metrics.get("cont_bursts", 0) + 1
         else:
             if self.step_sink is not None:
-                self.step_sink("decode_multi" if k > 1 else "decode", a)
+                # adaptive fusion: the burst size rides the descriptor so
+                # followers dispatch the identical (greedy, k) program
+                self.step_sink(
+                    "decode_multi" if k > 1 else "decode",
+                    {**a, "k": np.int32(k)} if k > 1 else a)
             burst = self._dispatch_decode(k, a)
             self._last_desc = {**a, "k": k}
             self._last_desc.pop("tokens", None)
@@ -2875,6 +3120,7 @@ class JaxEngine:
             burst.copy_to_host_async()
         except AttributeError:  # non-jax stand-ins in tests
             pass
+        obs.end("enqueue_ahead", t_ea, track=self._obs_track, k=k)
         lanes = {}
         for s in active:
             s.inflight += k
@@ -2885,6 +3131,9 @@ class JaxEngine:
         self._obs_decode_extra = None
         obs.end("decode_dispatch", t_obs, track=self._obs_track,
                 cont=cont_burst, k=k, lanes=len(active), **extra)
+        if not self._overlap:
+            # lockstep reference mode: block on the burst and emit now
+            self._drain_inflight()
 
     GUIDED_TOPM = 32
     GUIDED_TOPM_WIDE = 256
@@ -2947,8 +3196,14 @@ class JaxEngine:
         is complete.  When no candidate fits — or the token budget is
         about to run out mid-document — the canonical completion closes
         the document, so the response is ALWAYS schema-valid."""
+        # awaiting_first: a guided+disagg slot defers its first-token
+        # readback like any parked-to-be prefill (its completion PARKS
+        # the KV at the next flush) — stepping it here meanwhile would
+        # write a constrained token's KV past the prompt and corrupt
+        # the parked prompt_len the decode side pulls
         gslots = [s for s in self._slots
                   if s is not None and not s.prefilling
+                  and not s.awaiting_first
                   and s.guide is not None and not s.finished]
         if not gslots:
             return
@@ -3035,7 +3290,11 @@ class JaxEngine:
                     jnp.asarray(a["positions"]), jnp.asarray(a["tables"]),
                     jnp.asarray(a["ctx_lens"]), jnp.asarray(a["valid"]),
                 )
-                chosen = choose(np.asarray(wids[i]), np.asarray(wvals[i]))
+                t_d = obs.begin()
+                wid_i, wval_i = np.asarray(wids[i]), np.asarray(wvals[i])
+                obs.end("device_wait", t_d, track=self._obs_track,
+                        what="guided_fetch")
+                chosen = choose(wid_i, wval_i)
             if chosen is None:
                 # even the widened set has no valid continuation: close
                 # the document canonically (and say so in the response)
@@ -3127,6 +3386,7 @@ class JaxEngine:
         device-side token chain, and persists the descriptor as the
         device pack continuations advance from (advance=0 here: the host
         arrays are already current)."""
+        # dynlint: disable=DYN011 a["temps"] is the host-side numpy descriptor, not a device array
         greedy = bool(np.all(np.asarray(a["temps"]) <= 0.0))
         chain = self._chain_tokens
         if chain is None:
@@ -3182,7 +3442,7 @@ class JaxEngine:
             dd["valid"], adv,
             self.lora_bank, dd["lidx"],
         )
-        fn = self._jit_decode_multi[greedy] if k > 1 \
+        fn = self._jit_decode_multi[(greedy, k)] if k > 1 \
             else self._jit_decode[greedy]
         burst, self.kv, pos, ctx, steps = fn(*args)
         dd["positions"], dd["ctx_lens"], dd["steps"] = pos, ctx, steps

@@ -125,6 +125,12 @@ class JaxEngineWorker:
                 # routers/planners can see each worker's chunk budget
                 "prefill_chunk_tokens": self.config.chunk_budget,
                 "prefill_packed": self.config.prefill_packed,
+                # overlapped scheduler (engine/core.py): whether this
+                # worker pipelines host scheduling behind device
+                # execution — sync-mode workers show distinctly worse
+                # served/raw ratios, and a fleet debugger should see the
+                # mode without reading worker flags
+                "overlap_scheduling": self.config.overlap_scheduling,
                 # speculative decoding (spec/): planners/routers see the
                 # proposer and max draft length; live acceptance rides
                 # the FPM stream (spec_verify records).  Gated on the
@@ -418,6 +424,14 @@ class JaxEngineWorker:
             await asyncio.to_thread(self.engine.warmup_decode)
         await register_model(rt, self.card, instance_id)
         self._load_task = asyncio.create_task(self._load_loop())
+        # SLA-aware admission input (engine/core.py set_slo_burn): feed
+        # the frontends' published SLO burn rate (obs/slo.py
+        # SloPlane.publish -> slo_metrics.{ns}) into the engine, where a
+        # sustained burn makes prefill chunks yield budget to decode.
+        # Stale signals decay engine-side (slo_burn_stale_s), so a
+        # frontend restart or a disabled SLO plane is harmless.
+        self._slo_cancel = asyncio.Event()
+        self._slo_task = asyncio.create_task(self._slo_loop())
         # fleet introspection: this worker's live state on /debug/state
         self._debug_source_name = f"worker:{instance_id}"
         rt.register_debug_source(self._debug_source_name, self.debug_state)
@@ -596,6 +610,35 @@ class JaxEngineWorker:
             allow_transfer=single_host,
         )
 
+    async def _slo_loop(self) -> None:
+        """Fold every frontend SLO summary into the engine's burn signal
+        (worst window wins — the same reduction the planner's
+        SloObserver applies)."""
+        from ..obs.slo import SLO_SUBJECT_PREFIX
+
+        subject = f"{SLO_SUBJECT_PREFIX}.{self.namespace}"
+        try:
+            async for subj, payload in self.runtime.event_plane.subscribe(
+                subject, cancel=self._slo_cancel
+            ):
+                if subj != subject or self.engine is None:
+                    continue
+                try:
+                    burns = payload.get("burn")
+                    self.engine.set_slo_burn(
+                        max((float(v) for v in burns.values()),
+                            default=0.0)
+                        if isinstance(burns, dict) else 0.0)
+                except Exception:
+                    # one malformed event (non-dict payload included)
+                    # must not kill the feed task — a dead subscription
+                    # silently disables SLA-aware admission for the
+                    # worker's whole lifetime
+                    logger.warning("malformed slo payload: %r",
+                                   payload, exc_info=True)
+        except asyncio.CancelledError:
+            pass
+
     async def _load_loop(self) -> None:
         subject = f"{LOAD_SUBJECT_PREFIX}.{self.namespace}.{self.component}"
         fpm_subject = f"fpm.{self.namespace}.{self.component}"
@@ -724,6 +767,9 @@ class JaxEngineWorker:
             await self._broadcaster.close()
         if self._load_task is not None:
             self._load_task.cancel()
+        if getattr(self, "_slo_task", None) is not None:
+            self._slo_cancel.set()
+            self._slo_task.cancel()
         if self.engine is not None:
             await self.engine.close()
         if self.served is not None:

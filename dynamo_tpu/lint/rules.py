@@ -435,6 +435,110 @@ def _has_len_guard(fn: ast.AST, name: str) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# DYN011 — blocking device sync in the scheduler hot path outside a
+# device_wait span
+# ---------------------------------------------------------------------------
+
+# the scheduler hot path: every function in engine/core.py except the
+# ones that run before serving or replay a leader's lockstep stream
+_DYN011_EXEMPT_FNS = {"warmup_decode", "_init_kv_cache", "apply_step"}
+
+
+def _dyn011_candidates(mod: Module):
+    """Calls that force a host<->device synchronization: np.asarray(...)
+    (the engine's canonical fetch), .block_until_ready(), .item()."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        t = terminal(node.func)
+        if d in ("np.asarray", "numpy.asarray"):
+            yield node, "np.asarray(...)"
+        elif t == "block_until_ready":
+            yield node, ".block_until_ready()"
+        elif t == "item" and isinstance(node.func, ast.Attribute) \
+                and not node.args and not node.keywords:
+            yield node, ".item()"
+
+
+def _stmt_of(mod: Module, node: ast.AST) -> ast.stmt:
+    """The innermost statement containing `node`."""
+    stmt = node
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.stmt):
+            stmt = anc
+            break
+    return stmt
+
+
+def _body_of(mod: Module, stmt: ast.stmt):
+    """The statement list `stmt` sits in (its parent's matching block)."""
+    parent = mod.parent(stmt)
+    if parent is None:
+        return None
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(parent, field, None)
+        if isinstance(block, list) and stmt in block:
+            return block
+    return None
+
+
+def _in_device_wait_span(mod: Module, node: ast.AST) -> bool:
+    """True when the call follows the sanctioned idiom in its OWN
+    statement block:
+
+        t = obs.begin()
+        <the blocking fetch>
+        obs.end("device_wait", t, ...)
+
+    i.e. an obs.begin() assignment somewhere before it and an
+    obs.end("device_wait", ...) somewhere after it, both at the same
+    block depth — so the fetch's wall time is attributed to the
+    device_wait phase the gap report scores."""
+    stmt = _stmt_of(mod, node)
+    block = _body_of(mod, stmt)
+    if block is None:
+        return False
+    idx = block.index(stmt)
+    begin_before = any(
+        isinstance(s, ast.Assign) and isinstance(s.value, ast.Call)
+        and dotted(s.value.func) == "obs.begin"
+        for s in block[:idx])
+    end_after = any(
+        isinstance(s, ast.Expr) and isinstance(s.value, ast.Call)
+        and dotted(s.value.func) == "obs.end"
+        and str_arg(s.value) == "device_wait"
+        for s in block[idx + 1:])
+    return begin_before and end_after
+
+
+@register(
+    "DYN011",
+    "blocking device sync in the scheduler hot path outside a "
+    "device_wait span",
+    "PR 11 class: the overlapped scheduler only works if the hot path's "
+    "sole blocking points are the deliberate, span-attributed readbacks "
+    "— one stray np.asarray/.item()/block_until_ready silently "
+    "re-serializes host and device AND the stall is invisible to the "
+    "gap report that exists to catch it",
+    applies=lambda p: p == "dynamo_tpu/engine/core.py")
+def blocking_sync_in_hot_path(mod: Module) -> Iterable[Finding]:
+    for node, what in _dyn011_candidates(mod):
+        fn = mod.enclosing_function(node)
+        if fn is not None and fn.name in _DYN011_EXEMPT_FNS:
+            continue
+        if _in_device_wait_span(mod, node):
+            continue
+        yield mod.finding(
+            "DYN011", node,
+            f"{what} in the scheduler hot path forces a device sync "
+            "outside a device_wait span: wrap it in the t=obs.begin() / "
+            "obs.end(\"device_wait\", t, ...) idiom so the stall is "
+            "attributed (and deliberate), or move the readback behind "
+            "the overlap machinery (_pending_first / _inflight)")
+
+
+# ---------------------------------------------------------------------------
 # DYN010 — print() in library code
 # ---------------------------------------------------------------------------
 

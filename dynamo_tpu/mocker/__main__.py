@@ -78,6 +78,14 @@ def build_args() -> argparse.ArgumentParser:
                         "to finish before the rest error with the "
                         "migratable 'worker draining' marker and "
                         "replay elsewhere")
+    p.add_argument("--no-overlap-scheduling", action="store_true",
+                   help="lockstep scheduler sim (one token per seq per "
+                        "step, host time serial with the simulated "
+                        "device) instead of the overlapped default")
+    p.add_argument("--decode-fused-steps", type=int, default=8,
+                   help="adaptive-fusion ceiling for the overlap sim: "
+                        "decode-only stretches fuse up to this many "
+                        "tokens per dispatch (1 disables fusion)")
     return p
 
 
@@ -107,6 +115,8 @@ async def main() -> None:
         wedge_after=args.wedge_after,
         flaky=args.flaky,
         fault_seed=args.fault_seed,
+        overlap_scheduling=not args.no_overlap_scheduling,
+        decode_fused_steps=args.decode_fused_steps,
     )
     rt = await DistributedRuntime.detached().start()
     workers = []

@@ -54,6 +54,19 @@ class MockEngineArgs:
     prefill_s_per_token: float = 0.00002
     decode_s_per_seq: float = 0.0002
     speedup_ratio: float = 1.0  # >1 runs faster than "real time"
+    # overlapped scheduler sim (mirrors engine/config.py
+    # overlap_scheduling): host scheduling hides behind the simulated
+    # device step (the sleep shrinks by the host time spent since the
+    # step began, and that work reports as `enqueue_ahead` instead of
+    # `sched`), and decode-only stretches fuse adaptively up to
+    # decode_fused_steps tokens per dispatch — one base_step_s per
+    # BURST instead of per token, the same dispatch-amortization the
+    # real engine's fused path buys.  De-fuses to the interleave burst
+    # (min(4, decode_fused_steps) — the real _fused_k policy) the step
+    # an arrival or prefill chunk appears.  Token streams are
+    # byte-identical either way (position-addressed stream).
+    overlap_scheduling: bool = True
+    decode_fused_steps: int = 8
     # disagg role: "both" | "prefill" | "decode"
     role: str = "both"
     # emit exactly this text (as byte-token ids the frontend's mock
@@ -180,6 +193,12 @@ class MockEngine:
         self._compiled_families: set = set()
         self._fpm_last_prefill_t = 0.0
         self._fpm_last_decode_t = 0.0
+        # overlapped-scheduler sim state: consecutive decode-only steps
+        # (the adaptive-fusion ramp clock) and the previous decode
+        # dispatch's (membership, k) — a matching pair is a continuation
+        # burst (`cont` span attr, the real engine's zero-upload path)
+        self._decode_run = 0
+        self._last_decode_key = None
 
     # simulated cost model: nominal FLOPs / HBM bytes per token — the
     # values only need to be self-consistent (gauge math and record
@@ -207,7 +226,7 @@ class MockEngine:
         })
 
     def _fpm_dispatch(self, kind: str, tokens: int, lanes: int,
-                      queue_depth: int = 0) -> None:
+                      queue_depth: int = 0, k: int = 1) -> None:
         """One prefill/decode FPM record per simulated dispatch — the
         same fields the JAX engine emits, so FpmWindow derivations,
         worker gauges, and planner diag run identically against the
@@ -233,7 +252,7 @@ class MockEngine:
                 rec["est_mfu"] = rec["mfu"]  # sim: one cost model
             self._fpm_last_prefill_t = now
         else:
-            rec.update(k=1, lanes=lanes)
+            rec.update(k=k, lanes=lanes)
             self._fpm_last_decode_t = now
         self.fpm.append(rec)
 
@@ -442,11 +461,18 @@ class MockEngine:
         await chaos.ahit("engine.step", key=self.args.model_name)
         # timeline spans: same kinds (and zero-cost-off None check) as
         # JaxEngine._sched_step, so obs.report decomposes a mocker run
-        # with the same phase taxonomy
+        # with the same phase taxonomy.  Overlap sim: mid decode-only
+        # stretch the "device" (the previous burst's sleep) was still
+        # running while this host work happens, so it reports as
+        # enqueue_ahead — and the sleep below shrinks by the host time,
+        # modeling host scheduling hidden behind device execution.
+        host_t0 = time.monotonic()
+        overlapped = self.args.overlap_scheduling and self._decode_run > 0
         t_step = obs.begin()
         t_obs = obs.begin()
         self._try_admit()
-        obs.end("sched", t_obs, track=self._obs_track)
+        obs.end("enqueue_ahead" if overlapped else "sched", t_obs,
+                track=self._obs_track)
         if not self.running:
             await asyncio.sleep(0)  # let admissions catch up
             return
@@ -483,24 +509,54 @@ class MockEngine:
                     1 for s in self.running
                     if s.prefill_pos < s.num_prompt_tokens))
 
-        # simulated step latency
+        # adaptive decode fusion (overlap sim, the real _fused_k policy):
+        # pending arrivals / prefill chunks de-fuse to the interleave
+        # burst within one step (the TTFT bound); a decode-only stretch
+        # ramps interleave -> 2x -> ... -> decode_fused_steps
+        k = 1
+        if (self.args.overlap_scheduling and decode_seqs
+                and self.args.decode_fused_steps > 1
+                # disagg prefill hops emit transfer params once and
+                # finish — fusing would hold that TTFT-critical emission
+                # behind a k-long burst for nothing
+                and not any(s.disagg_prefill for s in decode_seqs)):
+            ib = min(4, self.args.decode_fused_steps)
+            if prefill_tokens or self.waiting:
+                self._decode_run = 0
+                k = ib
+            else:
+                k = min(ib << min(self._decode_run, 10),
+                        self.args.decode_fused_steps)
+                self._decode_run += 1
+        else:
+            self._decode_run = 0
+
+        # simulated step latency: one base dispatch cost per BURST (the
+        # fused path's amortization), per-token costs unchanged
         step_s = (
             self.args.base_step_s
             + prefill_tokens * self.args.prefill_s_per_token
-            + len(decode_seqs) * self.args.decode_s_per_seq
+            + k * len(decode_seqs) * self.args.decode_s_per_seq
         ) / max(self.args.speedup_ratio, 1e-6)
+        if self.args.overlap_scheduling:
+            # host scheduling hides behind the device: the sleep only
+            # covers what the host work since step start didn't already
+            step_s_sleep = max(0.0, step_s - (time.monotonic() - host_t0))
+        else:
+            step_s_sleep = step_s
         # the sleep IS the simulated device step: device_wait by kind
         t_obs = obs.begin()
-        await asyncio.sleep(step_s)
+        await asyncio.sleep(step_s_sleep)
         obs.end("device_wait", t_obs, track=self._obs_track,
                 what="sim_step")
 
         self.metrics["steps"] += 1
         self.metrics["prefill_tokens"] += prefill_tokens
         if decode_seqs:
-            # each decoding seq saw one token this step: step time IS the ITL
-            self.itl_ema_s = step_s if self.itl_ema_s == 0.0 \
-                else 0.9 * self.itl_ema_s + 0.1 * step_s
+            # each decoding seq saw k tokens this step: per-token ITL
+            itl = step_s / k
+            self.itl_ema_s = itl if self.itl_ema_s == 0.0 \
+                else 0.9 * self.itl_ema_s + 0.1 * itl
 
         t_obs = obs.begin()
         for seq in decode_seqs:
@@ -525,82 +581,97 @@ class MockEngine:
                 self.running.remove(seq)
                 self._publish(self.cache.free(seq.request_id))
                 continue
-            # simulated speculative decoding: 1 base token + a draft
+            # k fused decode rounds for this seq (adaptive fusion sim);
+            # each round: 1 base token + a simulated speculative draft
             # acceptance run (Bernoulli chain truncated at the first
-            # rejection, capped at k — the same longest-accepted-prefix
-            # shape the real verify step produces)
-            emit = 1
-            spec = self.args.speculative
-            if spec is not None:
-                k = max(1, int(spec.get("k", 4)))
-                acc = float(spec.get("acceptance", 0.5))
-                a = 0
-                while a < k and seq.rng.random() < acc:
-                    a += 1
-                self.metrics["spec_proposed"] += k
-                self.metrics["spec_accepted"] += a
-                self.fpm.append({
-                    "t": time.monotonic(), "kind": "spec_verify",
-                    "lanes": 1, "proposed": k, "accepted": a,
-                })
-                emit = 1 + a
-            for _ in range(emit):
-                if (self.args.fail_after_tokens
-                        and self.metrics["decode_tokens"]
-                        >= self.args.fail_after_tokens):
-                    self._die()
-                    return
-                if (self.args.flaky
-                        and self._fault_rng.random() < self.args.flaky):
-                    # drop just this sequence's stream mid-decode with a
-                    # migratable marker; the engine itself stays healthy
-                    seq.finished = True
-                    self.running.remove(seq)
-                    self._publish(self.cache.free(seq.request_id))
-                    seq.out_queue.put_nowait(LLMEngineOutput(
-                        finish_reason="error", error=FLAKY_ERROR))
+            # rejection — the same longest-accepted-prefix shape the
+            # real verify step produces)
+            for _round in range(k):
+                if seq.finished or seq not in self.running:
                     break
-                tok = self._next_token(seq)
-                completed = seq.blocks.append(tok)
-                partial = seq.blocks.partial_len()
-                res = self.cache.grow(
-                    seq.request_id, completed, need_new_block=(partial == 1)
-                )
-                if res is None:
-                    # OOM: preempt back to waiting, replay prefill later
-                    self.metrics["preemptions"] += 1
-                    self.running.remove(seq)
-                    free_res = self.cache.free(seq.request_id)
-                    self._publish(free_res)
-                    seq.prefill_pos = 0
-                    self.waiting.insert(0, seq)
-                    break
-                self._publish(res)
-                seq.generated += 1
-                self.metrics["decode_tokens"] += 1
-
-                finish = self._finish_reason(seq, tok)
-                out = LLMEngineOutput(
-                    token_ids=[tok],
-                    finish_reason=finish,
-                    metrics={
-                        "kv_usage": self.kv_usage(),
-                        "active_seqs": len(self.running),
-                    } if finish else None,
-                )
-                seq.out_queue.put_nowait(out)
-                if finish is not None:
-                    seq.finished = True
-                    self.running.remove(seq)
-                    res = self.cache.free(seq.request_id)
+                emit = 1
+                spec = self.args.speculative
+                if spec is not None:
+                    sk = max(1, int(spec.get("k", 4)))
+                    acc = float(spec.get("acceptance", 0.5))
+                    a = 0
+                    while a < sk and seq.rng.random() < acc:
+                        a += 1
+                    self.metrics["spec_proposed"] += sk
+                    self.metrics["spec_accepted"] += a
+                    self.fpm.append({
+                        "t": time.monotonic(), "kind": "spec_verify",
+                        "lanes": 1, "proposed": sk, "accepted": a,
+                    })
+                    emit = 1 + a
+                for _ in range(emit):
+                    if (self.args.fail_after_tokens
+                            and self.metrics["decode_tokens"]
+                            >= self.args.fail_after_tokens):
+                        self._die()
+                        return
+                    if (self.args.flaky
+                            and self._fault_rng.random() < self.args.flaky):
+                        # drop just this sequence's stream mid-decode
+                        # with a migratable marker; the engine itself
+                        # stays healthy
+                        seq.finished = True
+                        self.running.remove(seq)
+                        self._publish(self.cache.free(seq.request_id))
+                        seq.out_queue.put_nowait(LLMEngineOutput(
+                            finish_reason="error", error=FLAKY_ERROR))
+                        break
+                    tok = self._next_token(seq)
+                    completed = seq.blocks.append(tok)
+                    partial = seq.blocks.partial_len()
+                    res = self.cache.grow(
+                        seq.request_id, completed,
+                        need_new_block=(partial == 1)
+                    )
+                    if res is None:
+                        # OOM: preempt back to waiting, replay later
+                        self.metrics["preemptions"] += 1
+                        self.running.remove(seq)
+                        free_res = self.cache.free(seq.request_id)
+                        self._publish(free_res)
+                        seq.prefill_pos = 0
+                        self.waiting.insert(0, seq)
+                        break
                     self._publish(res)
-                    break
+                    seq.generated += 1
+                    self.metrics["decode_tokens"] += 1
+
+                    finish = self._finish_reason(seq, tok)
+                    out = LLMEngineOutput(
+                        token_ids=[tok],
+                        finish_reason=finish,
+                        metrics={
+                            "kv_usage": self.kv_usage(),
+                            "active_seqs": len(self.running),
+                        } if finish else None,
+                    )
+                    seq.out_queue.put_nowait(out)
+                    if finish is not None:
+                        seq.finished = True
+                        self.running.remove(seq)
+                        res = self.cache.free(seq.request_id)
+                        self._publish(res)
+                        break
         if decode_seqs:
+            # continuation-burst accounting (the real engine's `cont`
+            # attr / _is_continuation): same lane membership, same k —
+            # the dispatch the device-resident descriptor path uploads
+            # nothing for.  A prefill chunk co-scheduled for a DIFFERENT
+            # slot does not break a continuation (the decode descriptor
+            # is unchanged), exactly like the real check.
+            key = (frozenset(s.request_id for s in decode_seqs), k)
+            cont = self._last_decode_key == key
+            self._last_decode_key = key
             obs.end("decode_dispatch", t_obs, track=self._obs_track,
-                    cont=False, k=1, lanes=len(decode_seqs))
-            self._sim_compile("decode", len(decode_seqs))
-            self._fpm_dispatch("decode", len(decode_seqs),
-                               lanes=len(decode_seqs))
+                    cont=cont, k=k, lanes=len(decode_seqs))
+            self._sim_compile("decode", k * len(decode_seqs))
+            self._fpm_dispatch("decode", k * len(decode_seqs),
+                               lanes=len(decode_seqs), k=k)
         if (self.args.sim_recompile_every
                 and self.metrics["steps"] % self.args.sim_recompile_every
                 == 0):

@@ -43,7 +43,16 @@ Span taxonomy (kind — where — what the time is):
   step             engine _sched_step / mocker _step: one scheduler
                    iteration end to end
   sched            host scheduling: cancellations, KVBM offload sweep,
-                   admission (allocation + prefix match)
+                   admission (allocation + prefix match) — emitted only
+                   when the device had nothing in flight (the host time
+                   the device actually waited on)
+  enqueue_ahead    the same host scheduling/dispatch-build work when it
+                   runs WHILE the device is still executing in-flight
+                   work (overlap_scheduling): the overlapped scheduler's
+                   step-N+1 build during step N.  Counted as its own
+                   phase so the wall partition stays exact, and excluded
+                   from the report's sched_overhead_frac — the device
+                   never waited on it
   prefill_dispatch building + dispatching one prefill program (packed /
                    batched / B=1 / ring), including its FPM accounting
   decode_dispatch  building + dispatching one decode burst; attrs carry
@@ -96,8 +105,8 @@ DEFAULT_RING = 16384
 # span kinds the engine-step partition is scored on (report.py groups
 # everything else under its own name); kept here so engine, mocker and
 # report agree on the taxonomy
-STEP_PHASES = ("sched", "prefill_dispatch", "decode_dispatch",
-               "device_wait", "sample")
+STEP_PHASES = ("sched", "enqueue_ahead", "prefill_dispatch",
+               "decode_dispatch", "device_wait", "sample")
 
 # THE canonical span taxonomy (the docstring table above, plus the
 # compile watchdog's span): every obs.span()/obs.end() call site names

@@ -270,12 +270,15 @@ async def test_engine_step_crash_migrates_token_identical():
     rt = await fresh_runtime().start()
     try:
         workers, watcher, pipeline = await start_fleet(rt)
-        baseline = await collect(pipeline, greedy_req("ff-5", 10))
+        # 40 tokens: the overlapped scheduler's fused bursts emit up to
+        # 8 tokens per step, so a shorter request would finish before
+        # the rule's step-5 crash ever fires
+        baseline = await collect(pipeline, greedy_req("ff-5", 40))
         plane = chaos.ChaosPlane(seed=13).rule(
             "engine.step", "fail", after=4, times=1,
             error="worker engine error: chaos crash on step N")
         with plane:
-            faulted = await collect(pipeline, greedy_req("ch-5", 10))
+            faulted = await collect(pipeline, greedy_req("ch-5", 40))
         assert plane.fired() == 1
         assert faulted == baseline
         # the crashed engine fails fast (migratable) instead of hanging

@@ -23,7 +23,7 @@ from dynamo_tpu.lint.core import canon_path
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-ALL_RULES = tuple(f"DYN{i:03d}" for i in range(1, 11))
+ALL_RULES = tuple(f"DYN{i:03d}" for i in range(1, 12))
 
 
 def run(src, path="dynamo_tpu/engine/snippet.py", rules=None):
@@ -34,7 +34,7 @@ def rule_ids(findings):
     return sorted({f.rule for f in findings})
 
 
-def test_registry_has_all_ten_rules():
+def test_registry_has_all_rules():
     assert set(ALL_RULES) <= set(lint.RULES)
     for r in lint.RULES.values():
         assert r.title and r.bug  # README table sources
@@ -351,6 +351,78 @@ def test_dyn010_print_in_library():
     assert run('print("usage: ...")\n',
                path="dynamo_tpu/engine/__main__.py") == []
     assert run('print("report")\n', path="dynamo_tpu/obs/report.py") == []
+
+
+# ------------------- DYN011: blocking sync in hot path ------------------
+
+def test_dyn011_unattributed_asarray_in_hot_path():
+    bad = run("""
+        import numpy as np
+
+        class JaxEngine:
+            def _process_oldest_burst(self):
+                e = self._inflight.popleft()
+                arr = np.asarray(e["burst"])
+                return arr
+        """, path="dynamo_tpu/engine/core.py")
+    assert rule_ids(bad) == ["DYN011"]
+    assert len(bad) == 1
+
+
+def test_dyn011_device_wait_span_idiom_passes():
+    good = run("""
+        import numpy as np
+        from dynamo_tpu import obs
+
+        class JaxEngine:
+            def _process_oldest_burst(self):
+                e = self._inflight.popleft()
+                t_obs = obs.begin()
+                arr = np.asarray(e["burst"])
+                obs.end("device_wait", t_obs, track=self._obs_track,
+                        what="burst_fetch")
+                return arr
+        """, path="dynamo_tpu/engine/core.py")
+    assert good == []
+
+
+def test_dyn011_item_and_block_until_ready_flagged():
+    bad = run("""
+        class JaxEngine:
+            def _sched_step(self, tok, kv):
+                a = tok.item()
+                tok.block_until_ready()
+                return a
+        """, path="dynamo_tpu/engine/core.py")
+    assert rule_ids(bad) == ["DYN011"]
+    assert len(bad) == 2
+
+
+def test_dyn011_scope_and_exemptions():
+    # pre-serving warmup and the follower's lockstep replay are exempt
+    assert run("""
+        import numpy as np
+        import jax
+
+        class JaxEngine:
+            def warmup_decode(self):
+                jax.block_until_ready(self.kv)
+
+            def apply_step(self, kind, a):
+                return np.asarray(a["toks"])
+        """, path="dynamo_tpu/engine/core.py") == []
+    # only the engine core is the hot path; other modules are governed
+    # by their own rules (DYN004 covers the event loop)
+    assert run("import numpy as np\nx = np.asarray(y)\n",
+               path="dynamo_tpu/kvbm/pools.py") == []
+
+
+def test_dyn011_suppression_with_reason():
+    src = ("import numpy as np\n"
+           "def _dispatch_decode(a):\n"
+           "    # dynlint: disable=DYN011 host-side numpy descriptor\n"
+           "    return np.asarray(a['temps'])\n")
+    assert lint.run_source(src, "dynamo_tpu/engine/core.py") == []
 
 
 # --------------------------- suppressions -------------------------------

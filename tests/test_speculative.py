@@ -351,6 +351,16 @@ async def test_kv_rollback_accounting_matches_plain_decode():
     assert spec.metrics.get("spec_proposed", 0) \
         > spec.metrics.get("spec_accepted", 0), \
         "workload produced no rejections; rollback not exercised"
+    # the finish frame is enqueued to the consumer BEFORE the scheduler
+    # thread frees the slot's blocks, so read-after-finish races the
+    # teardown by design — wait (bounded) for the accounting to settle
+    # instead of asserting mid-free (the historical 1-in-a-few flake)
+    for _ in range(200):
+        if (spec.allocator.num_free == plain.allocator.num_free
+                and spec.allocator.num_evictable
+                == plain.allocator.num_evictable):
+            break
+        await asyncio.sleep(0.02)
     assert spec.allocator.num_free == plain.allocator.num_free
     assert spec.allocator.num_evictable == plain.allocator.num_evictable
     await plain.close()
@@ -389,10 +399,16 @@ async def test_spec_verify_rides_step_stream_and_replays():
     prefill/decode; a follower replaying the captured stream must end
     with a bit-identical KV cache."""
     steps = []
+    # lockstep leader: this test's subject is step-stream REPLAY, and it
+    # needs a deterministic schedule that produces spec_verify steps —
+    # the overlapped scheduler's pipelined bursts coarsen the collapsed-
+    # slot probe cadence (probes land wherever a drain puts `generated`),
+    # so whether an n-gram probe matches this tiny model's pseudo-random
+    # tail becomes schedule luck.  Replay mechanics are mode-independent.
     kw = dict(model_config=FP32, block_size=4, num_blocks=128,
               max_blocks_per_seq=32, max_num_seqs=2,
               prefill_buckets=(8, 16, 32), seed=5,
-              spec_decode="ngram", spec_k=4)
+              spec_decode="ngram", spec_k=4, overlap_scheduling=False)
     leader = JaxEngine(EngineConfig(**kw),
                        step_sink=lambda kind, a: steps.append((kind, a)))
     toks = await collect(leader, req(REPEAT_PROMPT, 64, "mh"))
