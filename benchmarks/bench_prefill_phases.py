@@ -9,7 +9,15 @@ chunked-prefill pipeline separately on the real chip:
 
   packed      ONE packed program: S prompts' chunks concatenated into a
               padding-free stream with segment ids (the serving path,
-              ops/packed_prefill.py)
+              ops/packed_prefill.py).  `--impl` selects the attention
+              implementation inside it — the masked XLA reference
+              (S-fold attention FLOPs) or the Pallas tile-skip kernel
+              (ops/pallas_packed_prefill.py) — and `--impl ab` runs
+              BOTH and prints one JSON line with each variant's
+              hand-counted est_mfu AND the measured-program MFU from
+              the roofline plane (obs/compile_watch.xla_costs), so the
+              S-fold overhead elimination is visible as a FLOP-count
+              drop rather than just a wall-clock win.
   batched     the legacy padded multi-row program (every row padded to
               the packed total — what packing replaces)
   single      S serial B=1 bucket programs (the pre-round-6 path)
@@ -27,6 +35,8 @@ CPU smoke:        python benchmarks/bench_prefill_phases.py --model tiny \
 """
 
 import argparse
+import dataclasses
+import json
 import os
 import sys
 import time
@@ -39,6 +49,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from dynamo_tpu.models import llama            # noqa: E402
+from dynamo_tpu.obs.compile_watch import xla_costs  # noqa: E402
 from dynamo_tpu.ops import packed_prefill as pp  # noqa: E402
 
 PEAK_TFLOPS = 197.0  # v5e dense bf16
@@ -75,6 +86,12 @@ def main():
     p.add_argument("--ctx-blocks", type=int, default=16,
                    help="block-table width per sequence")
     p.add_argument("--block", type=int, default=128)
+    p.add_argument("--impl", default="xla",
+                   choices=["xla", "pallas", "pallas_interpret", "ab"],
+                   help="packed-attention impl for the `packed` phase; "
+                        "`ab` runs the XLA reference AND the Pallas "
+                        "tile-skip kernel (interpret mode off-TPU) and "
+                        "prints both variants' MFU in one JSON line")
     args = p.parse_args()
     if args.seqs > args.tokens:
         p.error(f"--seqs ({args.seqs}) must be <= --tokens "
@@ -140,21 +157,70 @@ def main():
 
     # --- packed: the serving path --------------------------------------
     if want("packed"):
-        @jax.jit
-        def packed(params, kv, toks, positions, seg_ids, tables, last_idx,
-                   valid):
-            lg, kv = llama.prefill_packed(
-                params, cfg, kv, toks, positions, seg_ids, tables,
-                last_idx, valid)
-            return lg, kv
+        if args.impl == "ab":
+            on_tpu = any(d.platform == "tpu" for d in jax.devices())
+            impls = ["xla", "pallas" if on_tpu else "pallas_interpret"]
+        else:
+            impls = [args.impl]
+        # analytic attention FLOPs per layer: score + pv matmuls over
+        # each token's segment context window (mb blocks wide).  The
+        # XLA reference runs one masked pass PER SEGMENT over the WHOLE
+        # stream — S-fold; the Pallas kernel's tile-skip visits only a
+        # token's own segment — 1x (upper bound: tile-granular causal
+        # frontier skips more).
+        attn_base = 4.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim \
+            * T * MB * BLOCK
+        variants = {}
+        for impl in impls:
+            cfg_i = dataclasses.replace(cfg, packed_attn_impl=impl)
 
-        def run_packed():
-            lg, state["kv"] = packed(
+            @jax.jit
+            def packed(params, kv, toks, positions, seg_ids, tables,
+                       last_idx, valid, cfg_i=cfg_i):
+                lg, kv = llama.prefill_packed(
+                    params, cfg_i, kv, toks, positions, seg_ids, tables,
+                    last_idx, valid)
+                return lg, kv
+
+            def run_packed(packed=packed):
+                lg, state["kv"] = packed(
+                    params, state["kv"], dev["toks"], dev["positions"],
+                    dev["seg_ids"], dev["tables"], dev["last_idx"],
+                    dev["valid"])
+                return lg
+
+            t = timeit(run_packed)
+            est_flops = flops_per_tok * T
+            est_mfu = est_flops / t / (PEAK_TFLOPS * 1e12)
+            # measured-program FLOPs from the roofline plane: XLA's own
+            # HLO cost analysis of the compiled program (for the Pallas
+            # variant the kernel's CostEstimate feeds this) — the
+            # number the S-fold elimination shows up in
+            costs = xla_costs(packed, (
                 params, state["kv"], dev["toks"], dev["positions"],
                 dev["seg_ids"], dev["tables"], dev["last_idx"],
-                dev["valid"])
-            return lg
-        report("packed", timeit(run_packed), T, flops_per_tok * T)
+                dev["valid"]))
+            row = {
+                "ms": round(t * 1e3, 3),
+                "tok_per_s": round(T / t, 1),
+                "est_flops": est_flops,
+                "est_mfu": round(est_mfu, 4),
+                "attn_flops_analytic": attn_base
+                * (S if impl == "xla" else 1),
+            }
+            if costs is not None:
+                row["xla_flops"] = costs["flops"]
+                row["xla_bytes"] = costs["bytes"]
+                row["xla_mfu"] = round(
+                    costs["flops"] / t / (PEAK_TFLOPS * 1e12), 4)
+            variants[impl] = row
+            report(f"packed/{impl}", t, T, flops_per_tok * T)
+        print(json.dumps({
+            "bench": "prefill_phases", "model": args.model, "seqs": S,
+            "tokens": T, "ctx_blocks": MB, "block": BLOCK,
+            "peak_tflops": PEAK_TFLOPS, "target_mfu": 0.4,
+            "impls": variants,
+        }))
 
     # --- batched: every row padded to the packed total -----------------
     if want("batched"):

@@ -52,6 +52,23 @@ def build_args() -> argparse.ArgumentParser:
                    help="chunked-prefill token budget per scheduler step "
                         "(bounds decode ITL during prefill bursts); "
                         "0 = max_batch_tokens")
+    from ..ops.packed_prefill import PACKED_IMPLS
+    from ..ops.paged_attention import DECODE_IMPLS
+
+    p.add_argument("--attn-impl", default="",
+                   choices=["", *DECODE_IMPLS],
+                   help="decode attention impl (ops/paged_attention.py):"
+                        " pallas = hand-tiled DMA kernel (int8 caches "
+                        "dequantize in-kernel), jnp/jnp_bf16 = XLA "
+                        "gather paths; default keeps the model family's "
+                        "choice")
+    p.add_argument("--packed-attn-impl", default="",
+                   choices=["", *PACKED_IMPLS],
+                   help="packed-prefill attention impl "
+                        "(ops/pallas_packed_prefill.py): pallas = "
+                        "segment-aware tile-skip kernel (no S-fold "
+                        "attention overhead), xla = masked reference; "
+                        "default keeps the model family's choice")
     p.add_argument("--no-packed-prefill", action="store_true",
                    help="disable packed chunked prefill (use the padded "
                         "per-row programs)")
@@ -138,6 +155,8 @@ async def main() -> None:
         kv_hbm_gb=args.kv_hbm_gb,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
         prefill_packed=not args.no_packed_prefill,
+        attn_impl=args.attn_impl,
+        packed_attn_impl=args.packed_attn_impl,
         peak_tflops=args.peak_tflops,
         peak_hbm_gbps=args.peak_hbm_gbps,
         host_cache_blocks=args.host_cache_blocks,

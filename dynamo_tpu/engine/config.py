@@ -125,6 +125,20 @@ class EngineConfig:
     # ITL during a prefill burst is capped by one chunk's compute).
     # 0 = use max_batch_tokens.
     prefill_chunk_tokens: int = 0
+    # decode attention impl override ("" = keep the model family's
+    # default): "auto" | "pallas" | "pallas_interpret" | "jnp" |
+    # "jnp_bf16" — the ops/paged_attention.py dispatch.  Every choice
+    # accepts int8 caches (the Pallas kernel dequantizes in-kernel);
+    # "pallas_interpret" exists for CPU testing.  Replaces the resolved
+    # model config's attn_impl field, so a preset model can take the
+    # kernel per worker without a custom model_config.
+    attn_impl: str = ""
+    # packed-prefill attention impl override ("" = family default):
+    # "auto"/"xla" (the masked XLA reference, S-fold attention FLOPs)
+    # | "pallas"/"pallas_interpret" (the tile-skip kernel,
+    # ops/pallas_packed_prefill.py).  Also selects the kernel for
+    # spec_verify, which rides the same packed path.
+    packed_attn_impl: str = ""
     # accelerator peak (dense bf16) TFLOP/s, for prefill-phase MFU in the
     # FPM stream (v5e: 197).  0 = unknown; MFU omitted from records.
     peak_tflops: float = 0.0

@@ -20,6 +20,7 @@ XLA's compile-once/execute-many model:
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import inspect
 import logging
 import zlib
@@ -168,6 +169,45 @@ class JaxEngine:
         self.config = config
         self.model_cfg = config.resolve_model()
         self.family = get_family(self.model_cfg)
+        # attention-impl overrides (ops/paged_attention.py +
+        # ops/pallas_packed_prefill.py): the engine-level knobs replace
+        # the resolved model config's fields so deployments pick the
+        # kernel per worker (--attn-impl/--packed-attn-impl) without a
+        # custom model_config.  "" keeps the family's default.  A knob
+        # the family would silently ignore is a loud config error — the
+        # MDC advertises the EFFECTIVE impl and must never claim a
+        # kernel the worker doesn't run: MLA consults neither
+        # attn_impl beyond "jnp" (family SUPPORTED_ATTN_IMPLS) nor
+        # packed_attn_impl (no packed path / field).
+        from ..ops.packed_prefill import PACKED_IMPLS
+        from ..ops.paged_attention import DECODE_IMPLS
+
+        impl_over = {}
+        if config.attn_impl:
+            supported = getattr(self.family, "SUPPORTED_ATTN_IMPLS",
+                                DECODE_IMPLS)
+            if config.attn_impl not in supported:
+                raise ValueError(
+                    f"attn_impl for model family "
+                    f"{type(self.model_cfg).__name__} must be one of "
+                    f"{' | '.join(supported)}, got {config.attn_impl!r}")
+            impl_over["attn_impl"] = config.attn_impl
+        if config.packed_attn_impl:
+            if config.packed_attn_impl not in PACKED_IMPLS:
+                raise ValueError(
+                    f"packed_attn_impl must be "
+                    f"{' | '.join(PACKED_IMPLS)}, "
+                    f"got {config.packed_attn_impl!r}")
+            if "packed_attn_impl" not in {
+                    f.name for f in dataclasses.fields(self.model_cfg)}:
+                raise ValueError(
+                    f"model family {type(self.model_cfg).__name__} has "
+                    f"no packed_attn_impl knob (MLA has no packed "
+                    f"prefill path)")
+            impl_over["packed_attn_impl"] = config.packed_attn_impl
+        if impl_over:
+            self.model_cfg = dataclasses.replace(self.model_cfg,
+                                                 **impl_over)
         self.mesh = mesh if mesh is not None else make_mesh(
             MeshConfig(dp=config.dp, tp=config.tp, sp=config.sp)
         )
@@ -393,7 +433,7 @@ class JaxEngine:
         if hasattr(self.family, "prefill_packed"):
             self._jit_prefill_packed = w.wrap(jax.jit(
                 partial(self._prefill_packed_impl, self.family,
-                        self.model_cfg),
+                        self.model_cfg, self.mesh),
                 donate_argnums=(1,),
                 out_shardings=_prefill_out,
             ), "prefill_packed", _toks2)
@@ -405,7 +445,7 @@ class JaxEngine:
         if hasattr(self.family, "spec_verify_packed"):
             self._jit_spec_verify = w.wrap(jax.jit(
                 partial(self._spec_verify_impl, self.family,
-                        self.model_cfg),
+                        self.model_cfg, self.mesh),
                 donate_argnums=(1,),
                 out_shardings=(rep, rep, rep, kvsh),
             ), "spec_verify", _toks2)
@@ -770,7 +810,7 @@ class JaxEngine:
         return tok, kv
 
     @staticmethod
-    def _prefill_packed_impl(family, model_cfg, params, kv, toks,
+    def _prefill_packed_impl(family, model_cfg, mesh, params, kv, toks,
                              positions, seg_ids, tables, last_idx, valid,
                              seeds, temps, top_ks, top_ps,
                              lora_bank=None, lidx=None):
@@ -778,12 +818,13 @@ class JaxEngine:
         co-scheduled prompts/chunks run as ONE padding-free token stream
         with segment ids.  First tokens are sampled per segment row; rows
         whose prompt is not finished this chunk have their sample
-        discarded by the host."""
+        discarded by the host.  `mesh` rides to the attention op for the
+        Pallas packed kernel's tp shard_map (like _decode_impl)."""
         lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
                    if lora_bank is not None else {})
         logits, kv = family.prefill_packed(
             params, model_cfg, kv, toks, positions, seg_ids, tables,
-            last_idx, valid, **lora_kw,
+            last_idx, valid, mesh=mesh, **lora_kw,
         )
         tok = sample_tokens(
             logits, seeds, jnp.zeros(seeds.shape, jnp.int32), temps,
@@ -792,8 +833,8 @@ class JaxEngine:
         return tok, kv
 
     @staticmethod
-    def _spec_verify_impl(family, model_cfg, params, kv, toks, positions,
-                          seg_ids, tables, valid, temps_t):
+    def _spec_verify_impl(family, model_cfg, mesh, params, kv, toks,
+                          positions, seg_ids, tables, valid, temps_t):
         """Packed multi-token verification (spec/): every speculating
         sequence's row [last_token, d1..dk] scored in ONE padding-free
         segment-id program (family spec_verify_packed over
@@ -807,7 +848,7 @@ class JaxEngine:
 
         logits, kv = family.spec_verify_packed(
             params, model_cfg, kv, toks, positions, seg_ids, tables,
-            valid,
+            valid, mesh=mesh,
         )
         scaled = logits / jnp.maximum(temps_t, 1e-6)[:, None]
         vals, ids = jax.lax.top_k(scaled, CAP)

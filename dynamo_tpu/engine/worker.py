@@ -125,6 +125,18 @@ class JaxEngineWorker:
                 # routers/planners can see each worker's chunk budget
                 "prefill_chunk_tokens": self.config.chunk_budget,
                 "prefill_packed": self.config.prefill_packed,
+                # EFFECTIVE attention impls (engine-level overrides
+                # applied to the model config): a fleet debugger sees
+                # which workers run the Pallas kernels vs the XLA
+                # reference paths without reading worker flags
+                "attn_impl": (self.engine.model_cfg.attn_impl
+                              if self.engine is not None
+                              else (self.config.attn_impl or "auto")),
+                "packed_attn_impl": (
+                    getattr(self.engine.model_cfg, "packed_attn_impl",
+                            "auto")
+                    if self.engine is not None
+                    else (self.config.packed_attn_impl or "auto")),
                 # overlapped scheduler (engine/core.py): whether this
                 # worker pipelines host scheduling behind device
                 # execution — sync-mode workers show distinctly worse

@@ -100,6 +100,12 @@ class DeepseekConfig:
         return self.n_experts > 0 and li >= self.first_k_dense
 
 
+# the absorbed-latent MLA decode path never consults cfg.attn_impl (no
+# paged_attention_decode dispatch in this family), so an engine-level
+# --attn-impl override of anything but "jnp" would be silently ignored;
+# the engine rejects those loudly against this set
+SUPPORTED_ATTN_IMPLS = ("jnp",)
+
 PRESETS: Dict[str, DeepseekConfig] = {
     # test-scale
     "tiny-mla": DeepseekConfig(),

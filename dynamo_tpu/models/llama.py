@@ -47,10 +47,14 @@ class LlamaConfig:
     tie_embeddings: bool = False
     max_context: int = 8192
     dtype: Any = jnp.bfloat16
-    # decode attention path: "auto" | "pallas" | "pallas_interpret" | "jnp"
+    # decode attention path: "auto" | "pallas" | "pallas_interpret" |
+    # "jnp" | "jnp_bf16" (ops/paged_attention.py rationale; every
+    # choice accepts int8 caches — the Pallas kernel dequantizes
+    # in-kernel)
     attn_impl: str = "auto"
-    # packed-prefill attention path: "auto"/"xla" (the reference path;
-    # "pallas" reserved for a future hand-tiled kernel)
+    # packed-prefill attention path: "auto"/"xla" (the masked XLA
+    # reference) | "pallas"/"pallas_interpret" (the tile-skip kernel,
+    # ops/pallas_packed_prefill.py)
     packed_attn_impl: str = "auto"
     # stop-token set (instruct checkpoints often declare several, e.g.
     # llama-3's <|end_of_text|> and <|eot_id|>)
@@ -594,6 +598,7 @@ def prefill_packed(
     valid: jax.Array,          # [T] bool: False on the padded tail
     lora_bank=None,            # stacked adapter bank (lora/bank.py)
     adapter_idx=None,          # [T] int32: bank slot PER TOKEN
+    mesh=None,                 # required for the Pallas path under tp>1
 ):
     """Packed multi-sequence prefill: several prompts' chunks (or
     prefix-hit tails) run as ONE padding-free token stream with segment
@@ -610,7 +615,7 @@ def prefill_packed(
     updated kv_cache)."""
     x, kv_cache = _packed_forward(
         params, cfg, kv_cache, token_ids, positions, seg_ids,
-        block_tables, valid, lora_bank, adapter_idx,
+        block_tables, valid, lora_bank, adapter_idx, mesh=mesh,
     )
     xl = x[last_idx]  # [S, d]
     logits = _logits(params, cfg, xl)
@@ -628,6 +633,7 @@ def _packed_forward(
     valid: jax.Array,          # [T] bool: False on the padded tail
     lora_bank=None,
     adapter_idx=None,
+    mesh=None,                 # required for the Pallas path under tp>1
 ):
     """Shared packed-stream transformer body (prefill_packed and
     spec_verify_packed): K/V scatter into each token's own blocks, then
@@ -645,6 +651,7 @@ def _packed_forward(
         attn = packed_prefill_attention(
             q, k_cache, v_cache, li, block_tables, seg_ids, positions,
             valid, impl=cfg.packed_attn_impl, k_scale=ks, v_scale=vs,
+            mesh=mesh,
         )
         x = x + _attn_out(layer, attn.reshape(T, cfg.q_dim), lora=lctx)
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
@@ -661,6 +668,7 @@ def spec_verify_packed(
     seg_ids: jax.Array,        # [T] int32 segment row per token
     block_tables: jax.Array,   # [S, mb] int32 per-segment block tables
     valid: jax.Array,          # [T] bool: False on the padded tail
+    mesh=None,                 # required for the Pallas path under tp>1
 ):
     """Speculative-decoding verification (spec/): each speculating
     sequence's row [last_token, d1..dk] runs through the SAME packed
@@ -672,7 +680,7 @@ def spec_verify_packed(
     Returns (logits [T, vocab], updated kv_cache)."""
     x, kv_cache = _packed_forward(
         params, cfg, kv_cache, token_ids, positions, seg_ids,
-        block_tables, valid,
+        block_tables, valid, mesh=mesh,
     )
     return _logits(params, cfg, x), kv_cache
 

@@ -32,9 +32,14 @@ segment row (S is small — max_prefill_seqs); each pass computes scores
 for the whole packed stream and masks foreign tokens out, an S-fold
 attention-FLOP overhead traded for zero padding on the projection/MLP
 FLOPs that dominate prefill at serving context lengths.  `impl` selects
-the implementation: "xla"/"auto" is this reference path; a hand-tiled
-Pallas kernel (per-token-block segment-aware iteration, no S-fold
-overhead) can slot in behind impl="pallas" when written.
+the implementation: "xla"/"auto" is this reference path;
+"pallas"/"pallas_interpret" is the hand-tiled kernel
+(ops/pallas_packed_prefill.py) whose per-token-block segment-aware
+iteration SKIPS (token-block, context-chunk) tiles that belong to
+other segments instead of computing-then-masking — no S-fold overhead,
+and the context streams HBM->VMEM by physical block id instead of
+through an XLA gather.  Both accept int8 caches (the kernel
+dequantizes in VMEM, the reference on the gather).
 
 Shape/layout conventions match ops/paged_attention.py: cache
 [L, nkv, nb, hd, bs] head-major transposed blocks, physical block 0 is
@@ -63,6 +68,11 @@ from .paged_attention import (
     _gqa_scores,
     _store_kv,
 )
+
+# the packed-prefill dispatch's impl vocabulary — the single source of
+# truth the engine's --packed-attn-impl validation and CLI choices
+# reference (a new impl added here is accepted end-to-end)
+PACKED_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
 
 
 def write_packed_kv(
@@ -133,6 +143,38 @@ def _segment_flash(q, k_cache, v_cache, layer, table, token_mask,
     return acc / jnp.maximum(l, 1e-20)[..., None]
 
 
+def _packed_pallas_tp(q, k_cache, v_cache, layer, block_tables, seg_ids,
+                      positions, valid, *, mesh, interpret, chunk_cols,
+                      k_scale=None, v_scale=None):
+    """Packed-prefill kernel under tensor parallelism
+    (paged_attention.kernel_tp_call — the shard_map scaffolding shared
+    with the decode kernel: local kv-head slices, replicated stream
+    metadata, scale planes sharded with the cache)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .paged_attention import kernel_tp_call
+    from .pallas_packed_prefill import packed_prefill_attention_pallas
+
+    quantized = k_scale is not None
+
+    def local(q, kc, vc, tables, seg, pos, val, *scales):
+        ks, vs = scales if quantized else (None, None)
+        return packed_prefill_attention_pallas(
+            q, kc, vc, layer, tables, seg, pos, val,
+            chunk_cols=chunk_cols, interpret=interpret,
+            k_scale=ks, v_scale=vs,
+        )
+
+    return kernel_tp_call(
+        mesh, local,
+        [q, k_cache, v_cache, block_tables, seg_ids, positions, valid],
+        [P(None, "tp", None), P(None, "tp", None, None, None),
+         P(None, "tp", None, None, None), P(None, None), P(None),
+         P(None), P(None)],
+        k_scale=k_scale, v_scale=v_scale,
+    )
+
+
 def packed_prefill_attention(
     q: jax.Array,             # [T, nh, hd] packed-stream queries (rope'd)
     k_cache: jax.Array,
@@ -146,6 +188,7 @@ def packed_prefill_attention(
     chunk_cols: int = 8,      # block columns per flash step
     k_scale: jax.Array = None,  # int8 cache: dequant scales (quant/kv.py)
     v_scale: jax.Array = None,
+    mesh=None,                # required for the Pallas path under tp>1
 ) -> jax.Array:
     """Causal-within-segment attention for a packed prefill chunk.
 
@@ -154,13 +197,36 @@ def packed_prefill_attention(
     whose K/V write_packed_kv already scattered in (so on an int8 cache
     the chunk's own K/V round-trip the quantizer before attention reads
     them — bit-consistent with how every later chunk will see them).
-    impl: "auto"/"xla" (this XLA reference); "pallas" is reserved for a
-    future hand-tiled kernel.
+
+    impl: "auto"/"xla" (this XLA reference — one masked flash pass per
+    segment row, S-fold attention FLOPs); "pallas"/"pallas_interpret"
+    (ops/pallas_packed_prefill.py — per-token-block tile-skip
+    iteration, ~1x attention FLOPs, context DMA'd HBM->VMEM by
+    physical block id).  Int8 caches work on every impl.  `mesh` is
+    required for the Pallas path when the cache is tensor-parallel
+    (kv_heads over a "tp" axis): the kernel then runs under shard_map
+    per shard, like the decode kernel.
     """
+    if impl in ("pallas", "pallas_interpret"):
+        interpret = impl == "pallas_interpret"
+        tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+        if tp > 1:
+            return _packed_pallas_tp(
+                q, k_cache, v_cache, layer, block_tables, seg_ids,
+                positions, valid, mesh=mesh, interpret=interpret,
+                chunk_cols=chunk_cols, k_scale=k_scale, v_scale=v_scale,
+            )
+        from .pallas_packed_prefill import packed_prefill_attention_pallas
+
+        return packed_prefill_attention_pallas(
+            q, k_cache, v_cache, layer, block_tables, seg_ids,
+            positions, valid, chunk_cols=chunk_cols, interpret=interpret,
+            k_scale=k_scale, v_scale=v_scale,
+        )
     if impl not in ("auto", "xla"):
         raise ValueError(
-            f"unknown packed-prefill impl {impl!r}; expected auto | xla "
-            "(pallas path not yet implemented)"
+            f"unknown packed-prefill impl {impl!r}; expected "
+            + " | ".join(PACKED_IMPLS)
         )
     S = block_tables.shape[0]
     out = jnp.zeros(q.shape, jnp.float32)

@@ -36,17 +36,18 @@ every write function takes optional `k_scale`/`v_scale` sibling arrays
 [L, nkv, num_blocks, block_size] fp32 — when passed, the incoming K/V
 quantize per (token, head) on the way into the cache and the scale
 scatters with the same index math, and the function returns a 4-tuple.
-Which read impls support int8:
+EVERY read impl supports int8:
 
-  * "jnp" / "jnp_bf16" / "auto" — native: the int8 block gather is what
+  * "jnp" / "jnp_bf16" / "auto" — the int8 block gather is what
     streams from HBM; dequantization happens on the gathered context
     (`_gather_ctx`), upcast to fp32 ("jnp") or bf16 ("jnp_bf16", keeping
     the MXU operands 16-bit with fp32 accumulation).
-  * "pallas" / "pallas_interpret" — NOT yet: the hand-tiled kernel has
-    no int8 lane layout, so a quantized cache routes these to the jnp
-    gather path (which round 5 measured faster on this platform
-    anyway).  An int8-native kernel (int8 MXU, fp32 accumulation) is
-    the follow-up once the Pallas DMA path beats XLA's gather.
+  * "pallas" / "pallas_interpret" — in-kernel dequant: the kernel DMAs
+    int8 blocks plus their [nkv, bs] fp32 scale rows into VMEM and
+    fuses the scale multiply into the chunk consume (query-dtype MXU
+    operands, fp32 softmax/accumulate) — int8's halved HBM traffic
+    happens inside the fast path (pallas_paged_attention.py docstring
+    has the VMEM layout).
 """
 
 from __future__ import annotations
@@ -60,6 +61,11 @@ import jax.numpy as jnp
 from ..quant.kv import quantize_tokens
 
 NEG_INF = -1e30
+
+# the decode dispatch's impl vocabulary — the single source of truth the
+# engine's --attn-impl validation and CLI choices reference (a new impl
+# added here is automatically accepted end-to-end)
+DECODE_IMPLS = ("auto", "pallas", "pallas_interpret", "jnp", "jnp_bf16")
 
 
 # ---------------------------------------------------------------------------
@@ -304,39 +310,66 @@ def paged_attention_decode_jnp(
     return out.astype(q.dtype)
 
 
-def _decode_pallas_tp(q, k_cache, v_cache, layer, block_tables, kv_lens,
-                      *, mesh, interpret):
-    """Pallas decode under tensor parallelism: shard_map over the tp axis.
+def kernel_tp_call(mesh, local, args, specs, k_scale=None, v_scale=None):
+    """shard_map scaffolding shared by the Pallas decode and
+    packed-prefill kernels under tensor parallelism.
 
-    The kernel is a custom call GSPMD cannot partition (left alone, XLA
-    all-gathers the whole kv_heads-sharded cache per layer per step — the
-    exact fallback this replaces).  Under shard_map each tp shard runs the
-    kernel on its LOCAL kv-head slice; GQA head grouping is kv-major and
-    contiguous, so a kv head's entire query group lives on the same shard
-    and the op needs zero cross-shard communication — the row-parallel wo
-    matmul downstream performs the usual psum.
-
-    Batch/tables/lens are replicated (axes beyond tp unmentioned =
-    replicated), matching the engine's host-array inputs."""
+    The kernels are custom calls GSPMD cannot partition (left alone,
+    XLA all-gathers the whole kv_heads-sharded cache per layer per
+    step — the exact fallback this replaces).  Under shard_map each tp
+    shard runs `local` on its LOCAL kv-head slice; GQA head grouping
+    is kv-major and contiguous, so a kv head's entire query group
+    lives on the same shard and the op needs zero cross-shard
+    communication — the row-parallel wo matmul downstream performs the
+    usual psum.  An int8 cache's scale planes shard with the cache
+    (kv_heads over tp, parallel/mesh.py kv_scale_spec) so each shard
+    dequantizes its own slab in-kernel; when scales are passed they
+    are appended to `args` and `local` receives them as its trailing
+    *scales.  Everything left unmentioned in a spec is replicated
+    (tables/lengths/stream metadata — the engine's host-array
+    inputs)."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.compat import shard_map
-    from .pallas_paged_attention import paged_attention_decode_pallas
 
-    def local(q, kc, vc, tables, lens):
-        return paged_attention_decode_pallas(
-            q, kc, vc, layer, tables, lens, interpret=interpret
-        )
-
+    args = list(args)
+    specs = list(specs)
+    if k_scale is not None:
+        args += [k_scale, v_scale]
+        specs += [P(None, "tp", None, None), P(None, "tp", None, None)]
     return shard_map(
         local, mesh=mesh,
-        in_specs=(P(None, "tp", None), P(None, "tp", None, None, None),
-                  P(None, "tp", None, None, None), P(None, None), P(None)),
+        in_specs=tuple(specs),
         out_specs=P(None, "tp", None),
         # pallas_call's out_shape carries no varying-mesh-axes annotation,
         # so the vma checker cannot see through it
         check_vma=False,
-    )(q, k_cache, v_cache, block_tables, kv_lens)
+    )(*args)
+
+
+def _decode_pallas_tp(q, k_cache, v_cache, layer, block_tables, kv_lens,
+                      *, mesh, interpret, k_scale=None, v_scale=None):
+    """Pallas decode under tensor parallelism (kernel_tp_call)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .pallas_paged_attention import paged_attention_decode_pallas
+
+    quantized = k_scale is not None
+
+    def local(q, kc, vc, tables, lens, *scales):
+        ks, vs = scales if quantized else (None, None)
+        return paged_attention_decode_pallas(
+            q, kc, vc, layer, tables, lens, interpret=interpret,
+            k_scale=ks, v_scale=vs,
+        )
+
+    return kernel_tp_call(
+        mesh, local,
+        [q, k_cache, v_cache, block_tables, kv_lens],
+        [P(None, "tp", None), P(None, "tp", None, None, None),
+         P(None, "tp", None, None, None), P(None, None), P(None)],
+        k_scale=k_scale, v_scale=v_scale,
+    )
 
 
 def paged_attention_decode(
@@ -366,25 +399,27 @@ def paged_attention_decode(
     GSPMD's unpartitionable-custom-call all-gather, so callers serving
     multi-chip must pass their mesh (the engine does).
 
-    k_scale/v_scale: an int8 cache's dequant scales (quant/kv.py).  The
-    Pallas kernel has no int8 lane layout yet, so a quantized cache
-    routes "pallas"/"pallas_interpret" to the jnp gather path (see the
-    module docstring's impl support matrix).
+    k_scale/v_scale: an int8 cache's dequant scales (quant/kv.py).
+    Every impl consumes them natively — the jnp paths dequantize on
+    the gather, the Pallas kernel DMAs int8 blocks + scale rows and
+    fuses the multiply in VMEM (module docstring's support matrix).
     """
     tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
-    if k_scale is not None and impl in ("pallas", "pallas_interpret"):
-        impl = "jnp_bf16"
     if impl == "auto":
-        # "auto" = the XLA gather path.  Measured on v5e (round 5,
-        # benchmarks/bench_decode_phases.py, llama-3b B=8 ctx=2048): the
-        # full decode step runs 14.2 ms with this path vs 17.1 ms with
-        # the Pallas kernel — the kernel's explicit DMAs cap at ~206 GB/s
-        # on this platform (per-engine ceiling, measured in
-        # benchmarks/bench_dma_layouts.py) while XLA's fused gather
-        # sustains ~340 GB/s.  The kernel stays available via
+        # "auto" = the XLA gather path, bf16 AND int8.  Measured on v5e
+        # (round 5, benchmarks/bench_decode_phases.py, llama-3b B=8
+        # ctx=2048): the full decode step runs 14.2 ms with this path vs
+        # 17.1 ms with the Pallas kernel — the kernel's explicit DMAs
+        # cap at ~206 GB/s on this platform (per-engine ceiling,
+        # measured in benchmarks/bench_dma_layouts.py) while XLA's fused
+        # gather sustains ~340 GB/s.  The kernel stays available via
         # impl="pallas" for platforms where Pallas DMA streams at full
-        # bandwidth.  Under tp the jnp ops partition natively (kv_heads
-        # axis), so no shard_map is needed either way.
+        # bandwidth; the int8 in-kernel dequant path is new this round
+        # and unmeasured on TPU (benchmarks/bench_kv_quant.py carries
+        # the int8-Pallas row), so "auto" keeps the measured choice
+        # until a TPU bench round says otherwise.  Under tp the jnp ops
+        # partition natively (kv_heads axis), so no shard_map is needed
+        # either way.
         impl = "jnp"
     if impl in ("pallas", "pallas_interpret"):
         interpret = impl == "pallas_interpret"
@@ -392,17 +427,18 @@ def paged_attention_decode(
             return _decode_pallas_tp(
                 q, k_cache, v_cache, layer, block_tables, kv_lens,
                 mesh=mesh, interpret=interpret,
+                k_scale=k_scale, v_scale=v_scale,
             )
         from .pallas_paged_attention import paged_attention_decode_pallas
 
         return paged_attention_decode_pallas(
             q, k_cache, v_cache, layer, block_tables, kv_lens,
-            interpret=interpret,
+            interpret=interpret, k_scale=k_scale, v_scale=v_scale,
         )
     if impl not in ("jnp", "jnp_bf16"):
         raise ValueError(
-            f"unknown attention impl {impl!r}; expected auto | pallas | "
-            "pallas_interpret | jnp | jnp_bf16"
+            f"unknown attention impl {impl!r}; expected "
+            + " | ".join(DECODE_IMPLS)
         )
     return paged_attention_decode_jnp(
         q, k_cache, v_cache, layer, block_tables, kv_lens,

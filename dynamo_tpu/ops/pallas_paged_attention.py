@@ -37,12 +37,23 @@ each point measured in benchmarks/bench_decode_phases.py):
     throughput plus 64 fill-bound passes made compute as slow as the
     entire bandwidth budget.
 
+Int8 KV caches (quant/kv.py) are consumed natively: alongside each
+[nkv, hd, bs] int8 block the kernel DMAs the block's [nkv, bs] fp32
+scale row (the per-position scale planes that ride the cache as
+sibling arrays) into [2, nkv, S] VMEM buffers on two extra semaphore
+lanes, and the chunk consume fuses the dequantizing multiply —
+int8 elements stream from HBM (half the bandwidth of bf16, +4 bytes
+per position of scale), the MXU sees query-dtype operands (bf16 on
+the serving path), softmax/accumulation stay fp32.  This is what lets
+quantization's bandwidth win compound with the fast attention path
+instead of routing around it (the pre-PR-12 jnp-gather fallback).
+
 Padded table entries point at physical block 0 (the garbage block) and
 are masked by position, so shapes stay static.  Numerics match
 paged_attention.paged_attention_decode_jnp to bf16 matmul tolerance
-(fp32 softmax and accumulation); tests/test_paged_attention.py
-cross-checks the two, and interpret mode keeps the kernel runnable on
-CPU.
+(fp32 softmax and accumulation); tests/test_paged_attention.py and
+tests/test_packed_pallas.py cross-check the two (int8 included), and
+interpret mode keeps the kernel runnable on CPU.
 """
 
 from __future__ import annotations
@@ -71,6 +82,54 @@ def tpu_compiler_params(**kwargs):
     return cls(**kwargs)
 
 
+def make_chunk_dma(tables_ref, k_hbm, v_hbm, k_buf, v_buf, sem, *,
+                   bpc, bs, ks_hbm=None, vs_hbm=None, ks_buf=None,
+                   vs_buf=None):
+    """The chunk DMA contract shared by the decode and packed-prefill
+    kernels: (start, wait) closures moving `bpc` physical blocks into a
+    double-buffered VMEM chunk — one strided descriptor per block per
+    tensor ([nkv, hd, bs], all heads, landing at the block's offset in
+    the chunk buffer), and for an int8 cache the block's [nkv, bs] fp32
+    scale rows on two extra semaphore lanes (`sem` is [slots, 2] bf16 /
+    [slots, 4] int8).  Both closures take (row, c, slot) where `row`
+    indexes tables_ref's first axis (the sequence for decode, the
+    segment for packed prefill).  One definition site keeps the two
+    kernels' DMA contracts — descriptor shapes, semaphore pairing,
+    scale lanes — from drifting."""
+    quantized = ks_hbm is not None
+
+    def _copies(row, c, slot):
+        for i in range(bpc):
+            pid = tables_ref[row, c * bpc + i]
+            yield pltpu.make_async_copy(
+                k_hbm.at[:, pid],
+                k_buf.at[slot, :, :, pl.ds(i * bs, bs)],
+                sem.at[slot, 0])
+            yield pltpu.make_async_copy(
+                v_hbm.at[:, pid],
+                v_buf.at[slot, :, :, pl.ds(i * bs, bs)],
+                sem.at[slot, 1])
+            if quantized:
+                yield pltpu.make_async_copy(
+                    ks_hbm.at[:, pid],
+                    ks_buf.at[slot, :, pl.ds(i * bs, bs)],
+                    sem.at[slot, 2])
+                yield pltpu.make_async_copy(
+                    vs_hbm.at[:, pid],
+                    vs_buf.at[slot, :, pl.ds(i * bs, bs)],
+                    sem.at[slot, 3])
+
+    def start(row, c, slot):
+        for dma in _copies(row, c, slot):
+            dma.start()
+
+    def wait(row, c, slot):
+        for dma in _copies(row, c, slot):
+            dma.wait()
+
+    return start, wait
+
+
 def _decode_kernel(
     # scalar prefetch
     tables_ref,   # [B, n_chunks * bpc] int32 physical block ids
@@ -79,17 +138,21 @@ def _decode_kernel(
     q_ref,        # [1, nkv, group, hd] VMEM (this sequence's query)
     k_hbm,        # [nkv, num_blocks, hd, bs] ANY (stays in HBM)
     v_hbm,
-    # output
-    o_ref,        # [1, nkv, group, hd] VMEM
-    # scratch
-    k_buf,        # [2, nkv, hd, S] VMEM
-    v_buf,
-    sem,          # DMA semaphores [2 slots, 2 (k/v)]
-    *,
+    # int8 caches add (ks_hbm, vs_hbm) [nkv, num_blocks, bs] fp32 ANY,
+    # then: o_ref [1, nkv, group, hd] VMEM; scratch k_buf/v_buf
+    # [2, nkv, hd, S] VMEM (+ks_buf/vs_buf [2, nkv, S] fp32), DMA
+    # semaphores [2 slots, 2 (k/v) or 4 (+scales)]
+    *rest,
     bpc: int,
     bs: int,
+    quantized: bool = False,
     debug_mode: str = "",  # "" | "dma_only" | "compute_only" (profiling)
 ):
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf, sem) = rest
+    else:
+        (o_ref, k_buf, v_buf, sem) = rest
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
     b = pl.program_id(0)
     B = pl.num_programs(0)
     nkv = k_hbm.shape[0]
@@ -98,31 +161,11 @@ def _decode_kernel(
     kv_len = kv_lens_ref[b]
     n_chunks = pl.cdiv(kv_len, S)
 
-    def start_chunk(seq, c, slot):
-        """One strided descriptor per block per tensor: [nkv, hd, bs]
-        (all heads) landing at the block's S-offset in the chunk buffer."""
-        for i in range(bpc):
-            pid = tables_ref[seq, c * bpc + i]
-            pltpu.make_async_copy(
-                k_hbm.at[:, pid], k_buf.at[slot, :, :, pl.ds(i * bs, bs)],
-                sem.at[slot, 0],
-            ).start()
-            pltpu.make_async_copy(
-                v_hbm.at[:, pid], v_buf.at[slot, :, :, pl.ds(i * bs, bs)],
-                sem.at[slot, 1],
-            ).start()
-
-    def wait_chunk(seq, c, slot):
-        for i in range(bpc):
-            pid = tables_ref[seq, c * bpc + i]
-            pltpu.make_async_copy(
-                k_hbm.at[:, pid], k_buf.at[slot, :, :, pl.ds(i * bs, bs)],
-                sem.at[slot, 0],
-            ).wait()
-            pltpu.make_async_copy(
-                v_hbm.at[:, pid], v_buf.at[slot, :, :, pl.ds(i * bs, bs)],
-                sem.at[slot, 1],
-            ).wait()
+    # the chunk DMA contract (descriptor shapes, semaphore pairing, int8
+    # scale lanes) is shared with the packed-prefill kernel
+    start_chunk, wait_chunk = make_chunk_dma(
+        tables_ref, k_hbm, v_hbm, k_buf, v_buf, sem, bpc=bpc, bs=bs,
+        ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf)
 
     # the very first grid step primes the pipeline; afterwards chunk 0 of
     # sequence b was prefetched by sequence b-1's last chunk, so the DMA
@@ -176,6 +219,16 @@ def _decode_kernel(
 
         # scores [nkv, g, S]: ONE batched bf16 matmul for the whole chunk
         k = k_buf[slot]  # [nkv, hd, S]
+        v = v_buf[slot]
+        if quantized:
+            # fused dequant on the chunk consume: int8 streamed from
+            # HBM (half the traffic), per-position fp32 scale multiply
+            # in VMEM, operands cast to the query dtype for the MXU
+            # (bf16 on the serving path) with fp32 accumulation below
+            k = (k.astype(jnp.float32)
+                 * ks_buf[slot][:, None, :]).astype(q.dtype)
+            v = (v.astype(jnp.float32)
+                 * vs_buf[slot][:, None, :]).astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -187,10 +240,11 @@ def _decode_kernel(
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
         l = l * alpha + jnp.sum(p, axis=2, keepdims=True)
-        # out [nkv, g, hd]: p is cast to bf16 for the MXU (standard flash
-        # practice; the fp32 running accumulation keeps the precision)
+        # out [nkv, g, hd]: p is cast to the operand dtype for the MXU
+        # (standard flash practice; fp32 running accumulation keeps the
+        # precision).  `v` is the dequantized chunk on an int8 cache.
         pv = jax.lax.dot_general(
-            p.astype(v_buf.dtype), v_buf[slot],
+            p.astype(v.dtype), v,
             (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
@@ -220,13 +274,21 @@ def paged_attention_decode_pallas(
     blocks_per_chunk: int | None = None,
     interpret: bool = False,
     debug_mode: str = "",
+    k_scale: jax.Array = None,  # [L, nkv, num_blocks, bs] fp32 (int8)
+    v_scale: jax.Array = None,
 ) -> jax.Array:
-    """Drop-in fast path for paged_attention.paged_attention_decode."""
+    """Drop-in fast path for paged_attention.paged_attention_decode.
+
+    With `k_scale`/`v_scale` (an int8 cache's per-position fp32 scale
+    planes, quant/kv.py) the kernel DMAs int8 blocks plus their scale
+    rows into VMEM and fuses the dequantizing multiply into the chunk
+    consume — int8's halved HBM traffic lands inside the fast path."""
     B, nh, hd = q.shape
     kc, vc = k_cache[layer], v_cache[layer]
     nkv, _, _, bs = kc.shape
     group = nh // nkv
     max_blocks = block_tables.shape[1]
+    quantized = k_scale is not None
 
     # chunk of up to 8 blocks (S = 1024 lanes at bs=128): big enough that
     # the two per-chunk matmuls amortize their pipeline fills and DMA
@@ -246,25 +308,37 @@ def paged_attention_decode_pallas(
     qg = qg.reshape(B, nkv, group, hd)
 
     S = bpc * bs
+    inputs = [qg, kc, vc]
+    in_specs = [
+        pl.BlockSpec((1, nkv, group, hd),
+                     lambda b, *refs: (b, 0, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((2, nkv, hd, S), kc.dtype),
+        pltpu.VMEM((2, nkv, hd, S), vc.dtype),
+    ]
+    if quantized:
+        inputs += [k_scale[layer], v_scale[layer]]
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((2, nkv, S), jnp.float32),
+                    pltpu.VMEM((2, nkv, S), jnp.float32)]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 4 if quantized else 2)))
+    # bytes per context position per head: int8 streams 1-byte elements
+    # plus one fp32 scale per (head, position)
+    pos_bytes = hd * kc.dtype.itemsize + (4 if quantized else 0)
     out = pl.pallas_call(
         functools.partial(_decode_kernel, bpc=bpc, bs=bs,
-                          debug_mode=debug_mode),
+                          quantized=quantized, debug_mode=debug_mode),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B,),
-            in_specs=[
-                pl.BlockSpec((1, nkv, group, hd),
-                             lambda b, *refs: (b, 0, 0, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, nkv, group, hd),
                                    lambda b, *refs: (b, 0, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((2, nkv, hd, S), kc.dtype),
-                pltpu.VMEM((2, nkv, hd, S), vc.dtype),
-                pltpu.SemaphoreType.DMA((2, 2)),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct((B, nkv, group, hd), q.dtype),
         compiler_params=tpu_compiler_params(
@@ -273,10 +347,9 @@ def paged_attention_decode_pallas(
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * 2 * B * nh * hd * max_blocks * bs,
-            bytes_accessed=2 * B * nkv * max_blocks * bs * hd
-            * kc.dtype.itemsize,
+            bytes_accessed=2 * B * nkv * max_blocks * bs * pos_bytes,
             transcendentals=B * nh * max_blocks * bs,
         ),
         interpret=interpret,
-    )(block_tables, kv_lens, qg, kc, vc)
+    )(block_tables, kv_lens, *inputs)
     return out.reshape(B, nh, hd)

@@ -26,13 +26,16 @@ quantization error is bounded per token at absmax/254.  The overhead is
 (head_dim + 4) / (2 * head_dim) — 1.94× blocks at head_dim 128, 1.88×
 at 64, comfortably above the 1.8× capacity target.
 
-Dequantization happens at the attention read (ops/paged_attention.py
-`_gather_ctx`): the int8 block gather is what streams from HBM, the
-scale gather adds ~3% traffic, and the upcast feeds the existing fp32 /
-bf16 MXU paths unchanged.  An int8-native MXU matmul (fp32 accumulation)
-is left to a future Pallas kernel — the quantized cache currently
-routes `impl="pallas"` requests to the jnp gather path, which round 5
-measured FASTER than the kernel on this platform anyway.
+Dequantization happens at the attention read.  On the jnp/XLA paths
+(ops/paged_attention.py `_gather_ctx`) the int8 block gather is what
+streams from HBM, the scale gather adds ~3% traffic, and the upcast
+feeds the existing fp32 / bf16 MXU paths unchanged.  On the Pallas
+paths (`impl="pallas"`, ops/pallas_paged_attention.py decode +
+ops/pallas_packed_prefill.py packed prefill) the kernels DMA int8
+blocks plus their fp32 scale rows into VMEM and fuse the dequantizing
+multiply into the chunk consume (bf16 MXU operands on the serving
+path, fp32 softmax/accumulate) — the bandwidth win happens inside the
+fast attention path rather than routing around it.
 """
 
 from __future__ import annotations

@@ -49,6 +49,10 @@ def test_bench_help_exits_zero(path):
     )
     assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
     assert "usage" in r.stdout.lower()
+    if os.path.basename(path) == "bench_prefill_phases.py":
+        # the attention-impl A/B mode (Pallas tile-skip kernel vs the
+        # masked XLA reference, one JSON line with both variants' MFU)
+        assert "--impl" in r.stdout
     if os.path.basename(path) == "bench_serving.py":
         # the timeline-tracing hook (obs/): --trace-out records the run
         # and prints the gap-attribution line

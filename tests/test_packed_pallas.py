@@ -1,0 +1,446 @@
+"""Pallas kernel unification suite (ROADMAP item 1 / PR 12).
+
+Two kernels under test, both interpret-mode on CPU (the same code path
+compiles on TPU):
+
+  * the packed-prefill tile-skip kernel
+    (ops/pallas_packed_prefill.py) vs the XLA masked reference
+    (ops/packed_prefill.py) across segment layouts — uneven lengths,
+    prefix-cache committed KV, spec_verify-shaped k+1 rows, int8
+    caches, tp sharding;
+  * the paged-attention decode kernel's in-kernel int8 dequant
+    (ops/pallas_paged_attention.py) vs the jnp gather path.
+
+Plus the engine-level contracts: greedy byte-identity at
+impl=pallas_interpret with kv_cache_dtype=int8 (overlap scheduling
+ON), the zero-recompile steady state with the kernels in the watched
+families, and the --attn-impl config/CLI plumbing.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# real-JAX-engine tests: XLA compiles (seconds at tier-1's -O0) and
+# device work run inside the async test bodies, so the conftest's
+# event-loop slow-callback gate (DYN004's runtime twin) cannot hold
+# here; mocker/frontend/router fleets keep it armed.
+pytestmark = pytest.mark.allow_slow_callbacks
+
+from dynamo_tpu.ops.packed_prefill import (
+    packed_prefill_attention,
+    write_packed_kv,
+)
+from dynamo_tpu.ops.paged_attention import (
+    paged_attention_decode_jnp,
+    write_prompt_kv,
+)
+from dynamo_tpu.ops.pallas_packed_prefill import (
+    packed_prefill_attention_pallas,
+)
+from dynamo_tpu.ops.pallas_paged_attention import (
+    paged_attention_decode_pallas,
+)
+
+
+def _packed_case(rng, lens, *, nkv=2, group=2, hd=16, bs=4, mb=8, L=2,
+                 bucket=None, ctx0=None, dtype=jnp.float32, int8=False):
+    """Build one packed-stream case: per-segment chunk lengths `lens`
+    (0 = unused row), optional committed prefix lengths `ctx0` already
+    in cache before the chunk, KV written through the real write ops so
+    int8 cases round-trip the quantizer exactly like serving."""
+    S = len(lens)
+    nh = nkv * group
+    num_blocks = 1 + S * mb
+    ctx0 = ctx0 or [0] * S
+    T = sum(lens)
+    bucket = bucket or T
+    pad = bucket - T
+    seg_ids = np.concatenate(
+        [np.full(n, i, np.int32) for i, n in enumerate(lens)]
+        + [np.zeros(pad, np.int32)])
+    positions = np.concatenate(
+        [c + np.arange(n, dtype=np.int32) for c, n in zip(ctx0, lens)]
+        + [np.zeros(pad, np.int32)])
+    valid = np.concatenate([np.ones(T, bool), np.zeros(pad, bool)])
+    tables = np.zeros((S, mb), np.int32)
+    perm = rng.permutation(num_blocks - 1) + 1
+    for s in range(S):
+        tables[s] = perm[s * mb:(s + 1) * mb]
+    tables = jnp.asarray(tables)
+    seg_ids = jnp.asarray(seg_ids)
+    positions = jnp.asarray(positions)
+    valid = jnp.asarray(valid)
+
+    cache_shape = (L, nkv, num_blocks, hd, bs)
+    if int8:
+        kc = jnp.zeros(cache_shape, jnp.int8)
+        vc = jnp.zeros(cache_shape, jnp.int8)
+        ks = jnp.zeros((L, nkv, num_blocks, bs), jnp.float32)
+        vs = jnp.zeros((L, nkv, num_blocks, bs), jnp.float32)
+    else:
+        kc = jnp.asarray(rng.standard_normal(cache_shape), dtype)
+        vc = jnp.asarray(rng.standard_normal(cache_shape), dtype)
+        ks = vs = None
+
+    # committed prefixes first (prefix-cache hits): written through the
+    # prompt write op, exactly as a previous chunk would have
+    for li in range(L):
+        for s, c in enumerate(ctx0):
+            if c == 0:
+                continue
+            kp = jnp.asarray(rng.standard_normal((c, nkv, hd)), dtype)
+            vp = jnp.asarray(rng.standard_normal((c, nkv, hd)), dtype)
+            out = write_prompt_kv(kc, vc, li, kp, vp, tables[s],
+                                  jnp.int32(0), jnp.int32(c),
+                                  k_scale=ks, v_scale=vs)
+            kc, vc, ks, vs = out if len(out) == 4 else (*out, None, None)
+        kch = jnp.asarray(rng.standard_normal((bucket, nkv, hd)), dtype)
+        vch = jnp.asarray(rng.standard_normal((bucket, nkv, hd)), dtype)
+        out = write_packed_kv(kc, vc, li, kch, vch, tables, seg_ids,
+                              positions, valid, k_scale=ks, v_scale=vs)
+        kc, vc, ks, vs = out if len(out) == 4 else (*out, None, None)
+    q = jnp.asarray(rng.standard_normal((bucket, nh, hd)), dtype)
+    return q, kc, vc, ks, vs, tables, seg_ids, positions, valid
+
+
+def _assert_packed_parity(case, L=2, **pallas_kw):
+    q, kc, vc, ks, vs, tables, seg_ids, positions, valid = case
+    for li in range(L):
+        ref = packed_prefill_attention(
+            q, kc, vc, li, tables, seg_ids, positions, valid,
+            impl="xla", k_scale=ks, v_scale=vs)
+        out = packed_prefill_attention_pallas(
+            q, kc, vc, li, tables, seg_ids, positions, valid,
+            interpret=True, k_scale=ks, v_scale=vs, **pallas_kw)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("lens,bucket", [
+    ([7, 1, 12, 4], 32),       # uneven lengths + padded tail
+    ([16, 16], 32),            # balanced, no tail
+    ([3], 8),                  # single segment
+    ([5, 0, 9, 0], 16),        # unused segment rows (waterfill leftovers)
+])
+def test_packed_pallas_matches_xla_segment_layouts(lens, bucket):
+    """Tile-skip kernel vs the masked XLA reference across the segment
+    layouts the packing planner actually produces."""
+    rng = np.random.default_rng(0)
+    case = _packed_case(rng, lens, bucket=bucket)
+    _assert_packed_parity(case)
+
+
+def test_packed_pallas_multi_tile_and_chunking():
+    """Small token_block + chunk_cols force the tile grid and the
+    double-buffered context chunk loop through many iterations, with a
+    segment boundary landing mid-tile."""
+    rng = np.random.default_rng(1)
+    case = _packed_case(rng, [11, 9, 6], bucket=32)
+    _assert_packed_parity(case, token_block=8, chunk_cols=2)
+
+
+def test_packed_pallas_committed_prefix():
+    """Prefix-cache hits: chunk tokens at positions ctx0.. attend to the
+    committed KV written by earlier chunks through the block table."""
+    rng = np.random.default_rng(2)
+    case = _packed_case(rng, [6, 10], ctx0=[5, 13], mb=8, bucket=16)
+    _assert_packed_parity(case, token_block=8, chunk_cols=2)
+
+
+def test_packed_pallas_spec_verify_rows():
+    """spec_verify's layout: S rows of k+1 tokens each at large committed
+    positions (the draft window riding a long context)."""
+    rng = np.random.default_rng(3)
+    k = 4
+    case = _packed_case(rng, [k + 1] * 3, ctx0=[17, 9, 26], mb=8,
+                        bucket=16)
+    _assert_packed_parity(case)
+
+
+def test_packed_pallas_int8_dequant():
+    """Int8 cache: the kernel's fused in-VMEM dequant must match the
+    XLA reference's gather-side dequant on the same quantized cache
+    (both read the identical int8+scale planes)."""
+    rng = np.random.default_rng(4)
+    case = _packed_case(rng, [7, 1, 12, 4], bucket=32, int8=True,
+                        ctx0=[3, 0, 0, 5])
+    _assert_packed_parity(case, token_block=8, chunk_cols=2)
+
+
+def test_packed_pallas_bf16_tolerance():
+    rng = np.random.default_rng(5)
+    case = _packed_case(rng, [9, 7], bucket=16, dtype=jnp.bfloat16)
+    q, kc, vc, ks, vs, tables, seg_ids, positions, valid = case
+    ref = packed_prefill_attention(
+        q, kc, vc, 0, tables, seg_ids, positions, valid, impl="xla")
+    out = packed_prefill_attention_pallas(
+        q, kc, vc, 0, tables, seg_ids, positions, valid, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=0.05, atol=0.05)
+
+
+def test_packed_pallas_tp_sharded_matches_xla():
+    """The packed kernel under shard_map over a tp>1 mesh (each shard
+    owning its kv-head slice) must match the unsharded XLA reference —
+    the path multi-chip packed prefill takes at impl=pallas."""
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    rng = np.random.default_rng(6)
+    case = _packed_case(rng, [7, 9], nkv=4, group=2, bucket=16)
+    q, kc, vc, ks, vs, tables, seg_ids, positions, valid = case
+    ref = packed_prefill_attention(
+        q, kc, vc, 0, tables, seg_ids, positions, valid, impl="xla")
+    mesh = make_mesh(MeshConfig(dp=2, tp=4))  # 8 virtual CPU devices
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = NamedSharding(mesh, P(None, "tp", None, None, None))
+    with mesh:
+        kc_s = jax.device_put(kc, spec)
+        vc_s = jax.device_put(vc, spec)
+        out = jax.jit(
+            lambda q_, kc_, vc_, t_, s_, p_, v_: packed_prefill_attention(
+                q_, kc_, vc_, 0, t_, s_, p_, v_,
+                impl="pallas_interpret", mesh=mesh)
+        )(q, kc_s, vc_s, tables, seg_ids, positions, valid)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# decode kernel: in-kernel int8 dequant
+# ---------------------------------------------------------------------------
+
+
+def _int8_decode_case(rng, kv_lens, *, nkv=2, group=2, hd=16, bs=4,
+                      mb=6, L=2):
+    B = len(kv_lens)
+    nh = nkv * group
+    num_blocks = 1 + B * mb
+    kc = jnp.zeros((L, nkv, num_blocks, hd, bs), jnp.int8)
+    vc = jnp.zeros((L, nkv, num_blocks, hd, bs), jnp.int8)
+    ks = jnp.zeros((L, nkv, num_blocks, bs), jnp.float32)
+    vs = jnp.zeros((L, nkv, num_blocks, bs), jnp.float32)
+    tables = np.zeros((B, mb), np.int32)
+    perm = rng.permutation(num_blocks - 1) + 1
+    for b in range(B):
+        tables[b] = perm[b * mb:(b + 1) * mb]
+    tables = jnp.asarray(tables)
+    for b in range(B):
+        n = int(kv_lens[b])
+        kt = jnp.asarray(rng.standard_normal((n, nkv, hd)), jnp.float32)
+        vt = jnp.asarray(rng.standard_normal((n, nkv, hd)), jnp.float32)
+        for li in range(L):
+            kc, vc, ks, vs = write_prompt_kv(
+                kc, vc, li, kt, vt, tables[b], jnp.int32(0),
+                jnp.int32(n), k_scale=ks, v_scale=vs)
+    q = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+    return q, kc, vc, ks, vs, tables, jnp.asarray(
+        np.asarray(kv_lens, np.int32))
+
+
+def test_int8_decode_pallas_matches_jnp():
+    """In-kernel dequant vs the jnp gather path's dequant-on-gather, on
+    the same quantized cache — uneven lengths incl. partial blocks, and
+    blocks_per_chunk forced small so the double-buffered scale DMA loop
+    runs several iterations."""
+    rng = np.random.default_rng(7)
+    q, kc, vc, ks, vs, tables, kv_lens = _int8_decode_case(
+        rng, [17, 24, 5])
+    for li in range(2):
+        ref = paged_attention_decode_jnp(q, kc, vc, li, tables, kv_lens,
+                                         k_scale=ks, v_scale=vs)
+        out = paged_attention_decode_pallas(
+            q, kc, vc, li, tables, kv_lens, interpret=True,
+            k_scale=ks, v_scale=vs, blocks_per_chunk=2)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_int8_decode_pallas_tp_sharded_matches_jnp():
+    """The int8 kernel under shard_map over tp>1: each shard DMAs and
+    dequantizes its own cache+scale slab (kv_scale_spec sharding)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.ops.paged_attention import paged_attention_decode
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    rng = np.random.default_rng(8)
+    q, kc, vc, ks, vs, tables, kv_lens = _int8_decode_case(
+        rng, [13, 7, 21], nkv=4)
+    ref = paged_attention_decode_jnp(q, kc, vc, 1, tables, kv_lens,
+                                     k_scale=ks, v_scale=vs)
+    mesh = make_mesh(MeshConfig(dp=2, tp=4))
+    cspec = NamedSharding(mesh, P(None, "tp", None, None, None))
+    sspec = NamedSharding(mesh, P(None, "tp", None, None))
+    with mesh:
+        kc_s, vc_s = jax.device_put(kc, cspec), jax.device_put(vc, cspec)
+        ks_s, vs_s = jax.device_put(ks, sspec), jax.device_put(vs, sspec)
+        out = jax.jit(
+            lambda q_, kc_, vc_, ks_, vs_, t_, l_: paged_attention_decode(
+                q_, kc_, vc_, 1, t_, l_, impl="pallas_interpret",
+                mesh=mesh, k_scale=ks_, v_scale=vs_)
+        )(q, kc_s, vc_s, ks_s, vs_s, tables, kv_lens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_int8_no_longer_reroutes_pallas_to_jnp():
+    """The PR 3 caveat is dead: impl="pallas_interpret" with scales must
+    run the KERNEL, not silently fall back to the gather path.  The
+    kernel's online softmax reassociates differently from the one-shot
+    softmax, so bit-identical output to the jnp path would itself be
+    suspicious; instead pin the dispatch by breaking the kernel's
+    input contract and seeing the kernel's own failure mode."""
+    from dynamo_tpu.ops.paged_attention import paged_attention_decode
+
+    rng = np.random.default_rng(9)
+    q, kc, vc, ks, vs, tables, kv_lens = _int8_decode_case(rng, [9, 12])
+    out = paged_attention_decode(q, kc, vc, 0, tables, kv_lens,
+                                 impl="pallas_interpret",
+                                 k_scale=ks, v_scale=vs)
+    ref = paged_attention_decode_jnp(q, kc, vc, 0, tables, kv_lens,
+                                     k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    # and the result is NOT the bf16-operand fallback the old reroute
+    # produced (jnp_bf16 quantizes operands to bf16; the kernel keeps
+    # the query dtype fp32 here, so a max-abs-diff this small vs the
+    # fp32 reference is only reachable through the kernel)
+    bf16 = paged_attention_decode_jnp(q, kc, vc, 0, tables, kv_lens,
+                                      native_dtype=True,
+                                      k_scale=ks, v_scale=vs)
+    assert float(jnp.max(jnp.abs(out - ref))) < \
+        float(jnp.max(jnp.abs(bf16 - ref)))
+
+
+# ---------------------------------------------------------------------------
+# engine-level composition
+# ---------------------------------------------------------------------------
+
+
+def _engine_cfg(**kw):
+    from test_engine import FP32 as _FP32
+
+    from dynamo_tpu.engine import EngineConfig
+
+    defaults = dict(model_config=_FP32, block_size=4, num_blocks=128,
+                    max_blocks_per_seq=16, max_num_seqs=2,
+                    prefill_buckets=(8, 16), seed=7)
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+async def _greedy(cfg, prompt, n, rid):
+    from test_engine import collect, greedy_req
+
+    from dynamo_tpu.engine import JaxEngine
+
+    eng = JaxEngine(cfg)
+    toks = await collect(eng, greedy_req(list(prompt), n, rid))
+    await eng.close()
+    return toks
+
+
+async def test_engine_greedy_int8_pallas_byte_identity():
+    """The acceptance gate: greedy byte-identity at impl=pallas_interpret
+    for BOTH kernels with kv_cache_dtype=int8 and overlap scheduling ON
+    — quantization composes with the fast path end to end."""
+    prompt = [5, 9, 13, 2, 7, 11, 3, 1, 8, 20]
+    ref = await _greedy(
+        _engine_cfg(kv_cache_dtype="int8", overlap_scheduling=True),
+        prompt, 8, "i8-jnp")
+    pal = await _greedy(
+        _engine_cfg(kv_cache_dtype="int8", overlap_scheduling=True,
+                    attn_impl="pallas_interpret",
+                    packed_attn_impl="pallas_interpret"),
+        prompt, 8, "i8-pal")
+    assert len(ref) == 8  # a crashed engine's empty stream is vacuous
+    assert pal == ref
+
+
+async def test_zero_recompiles_with_pallas_kernels():
+    """The new kernels ride the watched compile families (prefill_packed
+    / decode): warmup + the first request compile each shape ONCE, two
+    more same-shape requests compile NOTHING — the PR 11 pinned
+    out_shardings invariant holds with pallas_call in the programs and
+    the int8 4-tuple riding donation."""
+    from dynamo_tpu.engine import JaxEngine
+
+    # decode_fused_steps=1 keeps the warmup to the single-step decode
+    # program (each interpret-mode pallas compile costs seconds on CPU;
+    # the family-count contract is identical)
+    eng = JaxEngine(_engine_cfg(
+        kv_cache_dtype="int8", attn_impl="pallas_interpret",
+        packed_attn_impl="pallas_interpret", decode_fused_steps=1))
+    try:
+        await asyncio.to_thread(eng.warmup_decode)
+        from test_engine import collect, greedy_req
+
+        await collect(eng, greedy_req([5, 9, 13, 2, 7, 11, 3, 1, 8, 20],
+                                      12, "pk-r0"))
+        counts = dict(eng.compile_watch.counts)
+        assert counts.get("prefill_packed", 0) == 1
+        assert counts.get("decode", 0) >= 1
+        await collect(eng, greedy_req([6, 10, 14, 3, 8, 12, 4, 2, 9, 21],
+                                      12, "pk-r1"))
+        await collect(eng, greedy_req([9, 13, 17, 6, 11, 15, 7, 5, 12, 24],
+                                      12, "pk-r2"))
+        assert dict(eng.compile_watch.counts) == counts, \
+            "steady-state serving recompiled a pallas-kernel program"
+    finally:
+        await eng.close()
+
+
+def test_engine_config_attn_impl_override_and_validation():
+    """EngineConfig.attn_impl/packed_attn_impl replace the resolved
+    model config's fields; junk values fail fast at engine init."""
+    from dynamo_tpu.engine import JaxEngine
+
+    eng = JaxEngine(_engine_cfg(attn_impl="jnp_bf16",
+                                packed_attn_impl="xla"))
+    assert eng.model_cfg.attn_impl == "jnp_bf16"
+    assert eng.model_cfg.packed_attn_impl == "xla"
+    with pytest.raises(ValueError, match="attn_impl"):
+        JaxEngine(_engine_cfg(attn_impl="triton"))
+    with pytest.raises(ValueError, match="packed_attn_impl"):
+        JaxEngine(_engine_cfg(packed_attn_impl="cuda"))
+
+
+def test_engine_cli_parses_attn_impl_flags():
+    from dynamo_tpu.engine.__main__ import build_args
+
+    a = build_args().parse_args(
+        ["--attn-impl", "pallas", "--packed-attn-impl", "pallas"])
+    assert a.attn_impl == "pallas"
+    assert a.packed_attn_impl == "pallas"
+    # default keeps the model family's choice
+    d = build_args().parse_args([])
+    assert d.attn_impl == "" and d.packed_attn_impl == ""
+    with pytest.raises(SystemExit):
+        build_args().parse_args(["--attn-impl", "triton"])
+
+
+def test_mla_rejects_attn_impl_overrides():
+    """MLA consults neither knob: its absorbed-latent decode never
+    dispatches paged_attention_decode (SUPPORTED_ATTN_IMPLS = jnp) and
+    it has no packed path — asking its worker for a kernel must be a
+    config error, not a silent no-op the MDC then mis-advertises."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    def mla_cfg(**kw):
+        return EngineConfig(model="tiny-mla", block_size=4,
+                            num_blocks=32, max_blocks_per_seq=8, **kw)
+
+    with pytest.raises(ValueError, match="packed_attn_impl"):
+        JaxEngine(mla_cfg(packed_attn_impl="pallas_interpret"))
+    with pytest.raises(ValueError, match="attn_impl"):
+        JaxEngine(mla_cfg(attn_impl="pallas"))
+    # the one value MLA actually runs passes through
+    eng = JaxEngine(mla_cfg(attn_impl="jnp"))
+    assert eng.model_cfg.attn_impl == "jnp"
