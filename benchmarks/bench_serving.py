@@ -97,6 +97,80 @@ class RunTrace:
         return report(events_of_doc(self.tracer.chrome_trace()))["gap"]
 
 
+class ForensicCapture:
+    """Frontend-analogue forensics over the worker-contract stream: a
+    RequestTracker per replayed request records the hop timeline
+    (dispatched → first_token → decode_stall → finish) and the worker's
+    forensic stamps, feeding a ForensicsPlane — so the bench exercises
+    the always-on plane end to end and its JSON line carries the `tail`
+    block.  Token streams are captured in BOTH modes (identical capture
+    cost on either side of the A/B), so `--forensics ab` can assert the
+    plane changes nothing about what clients see."""
+
+    def __init__(self, enabled: bool, metrics=None):
+        from dynamo_tpu.obs.forensics import ForensicsPlane
+
+        self.enabled = enabled
+        self.plane = ForensicsPlane(metrics) if enabled else None
+        self.streams: dict = {}  # request_id -> [token ids]
+
+    def wrap(self, client_fn, pass_tracker=False):
+        from dynamo_tpu.frontend.request_trace import RequestTracker
+
+        async def wrapped(req_dict):
+            rid = req_dict.get("request_id", "")
+            toks = self.streams.setdefault(rid, [])
+            tracker = None
+            if self.enabled:
+                tracker = RequestTracker(
+                    request_id=rid, model="bench", forensics=self.plane,
+                    input_tokens=len(req_dict.get("token_ids") or ()))
+                tracker.on_dispatch(None)
+            finish = None
+            # pass_tracker: a composite client (disagg orchestration)
+            # records its own prefill_open/prefill_done hops, exactly
+            # like the real frontend pipeline brackets maybe_prefill
+            stream = (client_fn(req_dict, tracker=tracker) if pass_tracker
+                      else client_fn(req_dict))
+            async for item in stream:
+                ids = item.get("token_ids") or ()
+                toks.extend(ids)
+                if tracker is not None:
+                    stamp = (item.get("metrics") or {}).get("forensic")
+                    if stamp is not None:
+                        tracker.on_worker_stamp(stamp)
+                    tracker.on_tokens(len(ids))
+                    finish = item.get("finish_reason") or finish
+                yield item
+            if tracker is not None:
+                tracker.finish(finish_reason=finish)
+
+        return wrapped
+
+    def tail_block(self, rt):
+        """The bench JSON `tail` block: realized-overlap rate read back
+        off the run's own metrics registry with the real parser (the
+        fleet/roofline-block idiom), plus the worst retained exemplar's
+        exact phase partition — the reservoir IS the tail, so its worst
+        entry is the p99+ autopsy."""
+        if self.plane is None:
+            return None
+        from prometheus_client.parser import text_string_to_metric_families
+
+        out = dict(self.plane.counts())
+        for fam in text_string_to_metric_families(
+                rt.metrics.render().decode()):
+            if fam.name == "dynamo_frontend_realized_overlap_ratio":
+                out["realized_overlap_ratio"] = round(
+                    fam.samples[0].value, 4)
+        worst = self.plane.worst("ttft")
+        if worst is not None:
+            out["p99_ttft_ms"] = round(worst.ttft_ms or 0.0, 3)
+            out["p99_partition"] = {p: round(v, 3) for p, v in
+                                    worst.partition.items()}
+        return out
+
+
 async def sample_fleet_peaks(workers, stop: asyncio.Event, peaks: dict):
     """Track the fleet-plane headline AT PEAK while the replay runs:
     worst load imbalance, worst straggler count, minimum KV headroom —
@@ -181,7 +255,8 @@ async def collect_roofline(rt):
     return out
 
 
-async def bench_agg(rows, n_workers, args, overlap=True, label="agg"):
+async def bench_agg(rows, n_workers, args, overlap=True, label="agg",
+                    forensics=True):
     rt = await fresh_runtime().start()
     workers = [
         await MockerWorker(rt, engine_args(overlap=overlap),
@@ -191,27 +266,30 @@ async def bench_agg(rows, n_workers, args, overlap=True, label="agg"):
     client = await (rt.namespace("dynamo").component("backend")
                     .endpoint("generate").client()).start()
     await client.wait_for_instances()
+    cap = ForensicCapture(forensics,
+                          rt.metrics.scoped(component="frontend"))
     stop, peaks = asyncio.Event(), {}
     sampler = asyncio.create_task(sample_fleet_peaks(workers, stop, peaks))
     with RunTrace(label, args.trace_out) as rtrace:
         try:
-            report = await replay(client.generate, rows, block_size=BLOCK,
-                                  speedup=args.speedup)
+            report = await replay(cap.wrap(client.generate), rows,
+                                  block_size=BLOCK, speedup=args.speedup)
         finally:
             stop.set()
             await sampler
         roofline = await collect_roofline(rt)
     gap = rtrace.gap()
     fleet = await collect_fleet(rt, workers, peaks)
+    tail = cap.tail_block(rt)
     await client.close()
     for w in workers:
         await w.close()
     await rt.shutdown()
-    return report, roofline, fleet, gap, rtrace.path
+    return report, roofline, fleet, gap, rtrace.path, tail, cap
 
 
 async def bench_disagg(rows, n_prefill, n_decode, args, overlap=True,
-                       label="disagg"):
+                       label="disagg", forensics=True):
     rt = await fresh_runtime().start()
     prefills = [
         await MockerWorker(rt, engine_args("prefill", overlap=overlap),
@@ -232,32 +310,46 @@ async def bench_disagg(rows, n_prefill, n_decode, args, overlap=True,
     orch = PrefillOrchestrator(
         pclient, ConditionalDisaggConfig(always_remote=True))
 
-    async def client_fn(req_dict):
+    async def client_fn(req_dict, tracker=None):
+        import time as _time
+
+        t_hop = _time.monotonic()
         routed = await orch.maybe_prefill(
             PreprocessedRequest.from_dict(req_dict))
+        if tracker is not None and routed.disaggregated_params:
+            # same bracketing as the frontend pipeline: the remote
+            # prefill IS the first dispatch, and first_token after the
+            # decode dispatch partitions as `transfer`
+            tracker.hop("prefill_open", at=t_hop)
+            tracker.hop("prefill_done")
+            tracker.mark_dispatching(at=t_hop)
         async for item in dclient.generate(routed.to_dict()):
             yield item
 
+    cap = ForensicCapture(forensics,
+                          rt.metrics.scoped(component="frontend"))
     stop, peaks = asyncio.Event(), {}
     sampler = asyncio.create_task(
         sample_fleet_peaks(prefills + decodes, stop, peaks))
     with RunTrace(label, args.trace_out) as rtrace:
         try:
-            report = await replay(client_fn, rows, block_size=BLOCK,
-                                  speedup=args.speedup)
+            report = await replay(cap.wrap(client_fn, pass_tracker=True),
+                                  rows,
+                                  block_size=BLOCK, speedup=args.speedup)
         finally:
             stop.set()
             await sampler
         roofline = await collect_roofline(rt)
     gap = rtrace.gap()
     fleet = await collect_fleet(rt, prefills + decodes, peaks)
+    tail = cap.tail_block(rt)
     await orch.close()
     await pclient.close()
     await dclient.close()
     for w in prefills + decodes:
         await w.close()
     await rt.shutdown()
-    return report, roofline, fleet, gap, rtrace.path
+    return report, roofline, fleet, gap, rtrace.path, tail, cap
 
 
 async def main():
@@ -291,6 +383,16 @@ async def main():
                         "run every topology in BOTH modes so the "
                         "overlapped scheduler's win is measurable in "
                         "one invocation")
+    p.add_argument("--forensics", choices=["on", "off", "ab"],
+                   default="on",
+                   help="per-request forensics plane "
+                        "(obs/forensics.py): on (default — every JSON "
+                        "line carries a `tail` block), off, or 'ab' — "
+                        "run the agg topology with the plane off then "
+                        "on over the SAME trace, assert byte-identical "
+                        "token streams, and print a forensics_ab line "
+                        "with the measured throughput overhead "
+                        "(target <1%%)")
     args = p.parse_args()
 
     rows = synthesize(args.requests, rate_rps=args.rate,
@@ -309,7 +411,7 @@ async def main():
     GAP_KEYS = ("sched_overhead_frac", "enqueue_ahead_frac",
                 "device_wait_frac", "idle_frac", "cont_burst_frac")
 
-    def line(config, summary, roofline, fleet, gap):
+    def line(config, summary, roofline, fleet, gap, tail=None):
         # stable bench JSON schema: the `slo` block mirrors the
         # frontend SLO plane's vocabulary (targets + goodput fraction),
         # `roofline` the worker gauges, `fleet` the obs.fleet headline
@@ -330,26 +432,71 @@ async def main():
             "roofline": roofline,
             "fleet": fleet,
             "gap": {k: gap[k] for k in GAP_KEYS if k in gap},
+            # tail-forensics block (obs/forensics.py via the replay's
+            # per-request trackers): worst retained exemplar's exact
+            # phase partition + the realized-overlap rate, read back
+            # off the run's own registry
+            **({"tail": tail} if tail is not None else {}),
         })
+
+    if args.forensics == "ab":
+        # A/B smoke: the SAME trace against the agg topology with the
+        # plane off then on.  The plane is pure observation — the token
+        # streams must be byte-identical (hard assert), and the
+        # throughput delta is the always-on overhead (target <1%; the
+        # open-loop arrival schedule makes the rate comparison stable)
+        # throwaway warmup so the first measured run doesn't eat the
+        # process's import/infra cold start and bias the comparison
+        await bench_agg(rows[: min(len(rows), 8)], args.workers, args,
+                        label="agg-forensics-warmup", forensics=True)
+        off, *_rest_off, cap_off = await bench_agg(
+            rows, args.workers, args, label="agg-forensics-off",
+            forensics=False)
+        on, _roof, _fleet, _gap, _path, tail, cap_on = await bench_agg(
+            rows, args.workers, args, label="agg-forensics-on",
+            forensics=True)
+        s_off = off.summary(slo_ttft_s, slo_itl_s)
+        s_on = on.summary(slo_ttft_s, slo_itl_s)
+        tps_off = s_off["output_tokens_per_s"]
+        tps_on = s_on["output_tokens_per_s"]
+        overhead = (1.0 - tps_on / tps_off) if tps_off else 0.0
+        identical = cap_off.streams == cap_on.streams
+        print(json.dumps({
+            "config": "forensics_ab",
+            "streams_identical": identical,
+            "tok_s_off": tps_off, "tok_s_on": tps_on,
+            "overhead_frac": round(overhead, 4),
+            "overhead_target_frac": 0.01,
+            "overhead_ok": overhead < 0.01,
+            "tail": tail,
+        }))
+        if not identical:
+            raise SystemExit(
+                "forensics plane changed the token streams — it must be "
+                "pure observation")
+        return
 
     modes = {"on": [(True, "overlap")], "off": [(False, "sync")],
              "ab": [(False, "sync"), (True, "overlap")]}[args.overlap]
+    forensics_on = args.forensics == "on"
     np_, nd = max(1, args.workers // 2), max(1, args.workers // 2)
     trace_paths = []
     for ov, tag in modes:
         suffix = f"-{tag}" if args.overlap == "ab" else ""
         label = f"agg-{args.workers}w{suffix}"
-        agg, roof, fleet, gap, path = await bench_agg(
-            rows, args.workers, args, overlap=ov, label=label)
+        agg, roof, fleet, gap, path, tail, _cap = await bench_agg(
+            rows, args.workers, args, overlap=ov, label=label,
+            forensics=forensics_on)
         trace_paths.append(path)
         print(line(label, agg.summary(slo_ttft_s, slo_itl_s), roof,
-                   fleet, gap))
+                   fleet, gap, tail))
         label = f"disagg-{np_}p{nd}d{suffix}"
-        dis, roof, fleet, gap, path = await bench_disagg(
-            rows, np_, nd, args, overlap=ov, label=label)
+        dis, roof, fleet, gap, path, tail, _cap = await bench_disagg(
+            rows, np_, nd, args, overlap=ov, label=label,
+            forensics=forensics_on)
         trace_paths.append(path)
         print(line(label, dis.summary(slo_ttft_s, slo_itl_s), roof,
-                   fleet, gap))
+                   fleet, gap, tail))
 
     if args.trace_out:
         from dynamo_tpu.obs.report import report_paths

@@ -77,12 +77,22 @@ class PrefillOrchestrator:
             instance_id = await self.prefill_route(prefill_req, avoid=None)
         try:
             params = None
+            forensic = None
             async for item in self.client.generate(
                 prefill_req.to_dict(), instance_id=instance_id, token=token
             ):
                 out = LLMEngineOutput.from_dict(item)
                 if out.kv_transfer_params is not None:
                     params = out.kv_transfer_params
+                if out.metrics and "forensic" in out.metrics:
+                    # the prefill worker's stamp (realized prefix reuse,
+                    # queue position — obs/forensics.py): ride it on the
+                    # transfer params so the frontend's prefill_done hop
+                    # carries the hop's own facts (the decode worker's
+                    # stream only ever stamps the decode side)
+                    forensic = out.metrics["forensic"]
+            if params is not None and forensic is not None:
+                params = {**params, "prefill_forensic": forensic}
             if params is None:
                 logger.warning(
                     "prefill worker returned no kv_transfer_params for %s; "

@@ -100,6 +100,11 @@ class _Slot:
     cached_tokens: int = 0   # prefix-cache reuse (for metrics)
     lora_idx: int = 0        # adapter bank slot (0 = no adapter)
     enqueued_t: float = 0.0
+    # forensics plane (obs/forensics.py): waiting-queue position at
+    # enqueue and prefill chunk count, stamped back to the frontend on
+    # the stream's first-token/finish frames (`forensic` metrics block)
+    queue_pos: int = 0
+    prefill_chunks: int = 0
     first_token_t: float = 0.0
     last_push_t: float = 0.0  # previous streamed-token time (ITL EMA)
 
@@ -1224,6 +1229,7 @@ class JaxEngine:
             slot.pulling = True
             slot.admitted = asyncio.Event()
         with self._qlock:
+            slot.queue_pos = len(self.waiting)
             self.waiting.append(slot)
         if lora_idx:
             # enqueued: the waiting/_slots scan now holds the reference
@@ -2384,6 +2390,7 @@ class JaxEngine:
         deferred (_pending_first — the flush completes it next step)."""
         self.metrics["prefill_tokens"] += chunk
         slot.prefill_pos += chunk
+        slot.prefill_chunks += 1
         slot.ctx_len = slot.prefill_pos
         # register blocks this chunk completed (registration is deferred to
         # materialization, so commit must track prefill progress chunkwise)
@@ -2689,7 +2696,10 @@ class JaxEngine:
         out = LLMEngineOutput(
             token_ids=[first_token], finish_reason="stop",
             kv_transfer_params=params,
-            metrics={"ttft_s": slot.first_token_t - slot.enqueued_t},
+            metrics={"ttft_s": slot.first_token_t - slot.enqueued_t,
+                     # disagg one-shot: the prefill hop's own realized
+                     # reuse/queue facts ride its single frame
+                     "forensic": self._forensic(slot)},
         )
         if self._loop_ref is not None:
             self._loop_ref.call_soon_threadsafe(slot.out_q.put_nowait, out)
@@ -3373,7 +3383,13 @@ class JaxEngine:
         slot.generated += 1
         self.metrics["decode_tokens"] += 1
         self._commit_full_blocks(slot)
-        out = LLMEngineOutput(token_ids=[tok], finish_reason=finish)
+        out = LLMEngineOutput(
+            token_ids=[tok], finish_reason=finish,
+            # same first/finish forensic stamping as _push_token
+            metrics=({"forensic": self._forensic(slot)}
+                     if (finish is not None or slot.generated == 1)
+                     else None),
+        )
         if self._loop_ref is not None:
             self._loop_ref.call_soon_threadsafe(slot.out_q.put_nowait, out)
         else:
@@ -3403,11 +3419,11 @@ class JaxEngine:
             completion = ""
         toks = codec.encode(completion) if completion else []
         slot.guided_out.extend(toks)
-        metrics = None
+        metrics: Dict[str, Any] = {"forensic": self._forensic(slot)}
         if toks or forced:
             self.metrics["guided_forced_closes"] = \
                 self.metrics.get("guided_forced_closes", 0) + 1
-            metrics = {"guided_forced_close_tokens": len(toks)}
+            metrics["guided_forced_close_tokens"] = len(toks)
         out = LLMEngineOutput(token_ids=list(toks), finish_reason="stop",
                               metrics=metrics)
         if self._loop_ref is not None:
@@ -3603,6 +3619,21 @@ class JaxEngine:
             self._emit_events(res)
             slot.committed_blocks += 1
 
+    def _forensic(self, slot: _Slot) -> Dict[str, Any]:
+        """Worker-side forensic facts for the stream's first-token and
+        finish frames (frontend/request_trace.py on_worker_stamp):
+        REALIZED prefix-cache reuse (what this worker actually served
+        from cache — the router's prediction-staleness feedback), the
+        slot's waiting-queue position at enqueue, and step counts.
+        Wire-safe scalars only; a handful of bytes on two frames per
+        request is the plane's whole stream overhead."""
+        return {
+            "cached_tokens": slot.cached_tokens,
+            "queue_pos": slot.queue_pos,
+            "prefill_chunks": slot.prefill_chunks,
+            "generated": slot.generated,
+        }
+
     def _push_token(self, slot: _Slot, tok: int) -> None:
         """Append a generated token, stream it, handle finish."""
         now = time.monotonic()
@@ -3618,15 +3649,24 @@ class JaxEngine:
         slot.generated += 1
         self._commit_full_blocks(slot)
         finish = self._finish_reason(slot, tok)
+        # forensic stamp on the FIRST token frame and the finish frame
+        # (frontend RequestTracker.on_worker_stamp): realized prefix
+        # reuse lands with the first token — when the router's
+        # predicted-vs-realized feedback wants it — and the finish
+        # frame's step counts supersede it as the record's truth
+        if finish:
+            metrics = {"kv_usage": self.kv_usage(),
+                       "cached_tokens": slot.cached_tokens,
+                       "ttft_s": slot.first_token_t - slot.enqueued_t,
+                       "forensic": self._forensic(slot)}
+        elif slot.generated == 1:
+            metrics = {"forensic": self._forensic(slot)}
+        else:
+            metrics = None
         out = LLMEngineOutput(
             token_ids=[tok],
             finish_reason=finish,
-            metrics=(
-                {"kv_usage": self.kv_usage(),
-                 "cached_tokens": slot.cached_tokens,
-                 "ttft_s": slot.first_token_t - slot.enqueued_t}
-                if finish else None
-            ),
+            metrics=metrics,
         )
         if self._loop_ref is not None:
             self._loop_ref.call_soon_threadsafe(slot.out_q.put_nowait, out)

@@ -247,7 +247,9 @@ class AnthropicRoutes:
             request.headers)
         tracker = RequestTracker.from_headers(
             request.headers, req.request_id, model, svc.trace_sink,
-            slo=svc.slo_plane, session_id=req.session_id,
+            slo=svc.slo_plane, forensics=svc.forensics,
+            timeline_on=svc.forensics is not None,
+            session_id=req.session_id,
             endpoint="anthropic_messages",
             input_tokens=len(req.token_ids))
         from .. import obs

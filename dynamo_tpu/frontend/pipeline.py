@@ -28,6 +28,15 @@ MIGRATABLE_MARKERS = ("connection lost", "no handler", "worker draining",
                       "not found", "worker engine error", "worker stalled")
 
 
+def _route_attr(route, name: str):
+    """Resolve an optional hook on the route or its wrapped inner
+    router (SessionAffinityRouter wraps the KvRouter as `.inner`)."""
+    fn = getattr(route, name, None)
+    if fn is None:
+        fn = getattr(getattr(route, "inner", None), name, None)
+    return fn
+
+
 def is_migratable(err: Exception) -> bool:
     """Worker-death errors are retryable on another instance; user
     cancellations and model errors are not (ref: migration.rs:60-75).
@@ -92,6 +101,7 @@ class MigrationOperator:
                                      max_tokens=request.stop.max_tokens - len(emitted)),
                     )
                 instance_id = None
+                decision = None
                 if route is not None:
                     live = self.client.instance_ids
                     if avoid and all(i in avoid for i in live):
@@ -107,8 +117,19 @@ class MigrationOperator:
                             sorted(avoid))
                         avoid.clear()
                     instance_id = await route(req, avoid=avoid)
+                    # forensics: the decision's WHY (per-candidate cost
+                    # scores, predicted overlap, best rejected, regret)
+                    # rides the routed hop, and is held for this attempt
+                    # so the worker's realized-reuse stamp can close the
+                    # predicted-vs-realized loop on the router
+                    pop = _route_attr(route, "pop_decision")
+                    if pop is not None:
+                        decision = pop(request.request_id)
+                    if tracker is not None:
+                        tracker.on_routed(instance_id, decision)
                 try:
                     first = True
+                    stamped = False
                     picked: list = []
 
                     def on_pick(iid, _picked=picked):
@@ -125,6 +146,21 @@ class MigrationOperator:
                             stream, self.stream_idle_s)
                     async for item in stream:
                         out = LLMEngineOutput.from_dict(item)
+                        stamp = (out.metrics or {}).get("forensic")
+                        if stamp is not None:
+                            if tracker is not None:
+                                tracker.on_worker_stamp(
+                                    stamp, attempt=attempts + 1)
+                            if not stamped and decision is not None:
+                                # realized prefix reuse vs THIS
+                                # attempt's prediction: the indexer-
+                                # staleness feedback signal
+                                # (router/kv_router.py on_realized)
+                                stamped = True
+                                feed = _route_attr(route, "on_realized")
+                                if feed is not None:
+                                    feed(decision,
+                                         stamp.get("cached_tokens"))
                         if out.finish_reason == "error":
                             # not a completion: surface as an error (HTTP
                             # 5xx / SSE error upstream).  Worker-side
@@ -222,13 +258,30 @@ class ModelPipeline:
         if self.prefill is not None:
             t_hop = time.monotonic()
             request = await self.prefill.maybe_prefill(request, token=token)
+            if request.disaggregated_params:
+                # the prefill worker's forensic stamp rode the transfer
+                # params (prefill_router.py); popped HERE so it lands on
+                # the prefill_done hop instead of riding the wire to the
+                # decode worker, which has its own stamp
+                prefill_stamp = request.disaggregated_params.pop(
+                    "prefill_forensic", None)
             if tracker is not None and request.disaggregated_params:
                 # a remote prefill actually ran: IT was the first
                 # worker dispatch, so queue time ends where the hop
                 # began (backdated — stamping after would absorb the
                 # whole prefill as phantom admission wait).  A request
                 # conditional disagg kept local stamps via on_dispatch,
-                # keeping the decode routing wait in queue_ms.
+                # keeping the decode routing wait in queue_ms.  The
+                # forensics hops bracket the hop itself: open backdated
+                # to the dispatch, done now — the partition's `prefill`
+                # phase is exactly this interval, and first_token after
+                # the decode dispatch reads as `transfer`.
+                tracker.hop("prefill_open", at=t_hop,
+                            **({"worker": request.disaggregated_params
+                                .get("instance_id")}
+                               if request.disaggregated_params
+                               .get("instance_id") else {}))
+                tracker.hop("prefill_done", **(prefill_stamp or {}))
                 tracker.mark_dispatching(at=t_hop)
                 if request.disaggregated_params.get("instance_id"):
                     tracker.on_prefill_worker(
@@ -236,11 +289,19 @@ class ModelPipeline:
         detok = self.preprocessor.tokenizer.make_detokenizer()
         stops = request.stop.stop or []
         pending = ""  # holdback buffer for partial stop-string matches
+        # request-scoped trace id for the per-delta spans: in a
+        # multi-process fleet the frontend ring never sees worker
+        # spans, so the forensics breach pin (obs/forensics.py) joins
+        # on the frontend's OWN detok/frame_egress spans — they must
+        # carry the trace_id to be pinnable
+        tid_obs = getattr(tracker, "trace_id", None) if obs.enabled() \
+            else None
         async for out in self.migration.generate(request, token=token,
                                                  tracker=tracker):
             t_obs = obs.begin()
             delta = detok.push(out.token_ids)
-            obs.end("detok", t_obs, tokens=len(out.token_ids))
+            obs.end("detok", t_obs, tokens=len(out.token_ids),
+                    trace_id=tid_obs)
             finish = out.finish_reason
             if stops:
                 pending += delta

@@ -120,7 +120,19 @@ def parse_traceparent(value: Optional[str]):
 @dataclass
 class RequestTracker:
     """Accumulates one request's timing/placement facts; emits the
-    request_end record (ref record.rs emit_request_end)."""
+    request_end record (ref record.rs emit_request_end).
+
+    Forensics plane (obs/forensics.py): the tracker also accumulates an
+    ordered **hop timeline** — received → routed → dispatched →
+    remote-prefill open/done → first_token → coarse decode_stall hops →
+    finish — with workers stamping realized prefix reuse / queue
+    position / step counts back via ``worker_stamp`` hops, so
+    migration, drain-abort, and disagg paths keep ONE coherent record
+    across dispatch attempts.  Hop names come from ``obs.HOP_KINDS``
+    (DYN012-checked); the cost is a handful of dict appends per request
+    plus one gap compare per token delta, which is what lets the plane
+    default on (``timeline_on``; byte-identical streams proven by the
+    bench A/B smoke)."""
 
     request_id: str
     model: str
@@ -128,6 +140,12 @@ class RequestTracker:
     # SLO plane (obs/slo.py SloPlane): finish() feeds every terminal
     # record into the frontend's latency histograms / goodput windows
     slo: Optional[object] = None
+    # forensics plane (obs/forensics.py ForensicsPlane): finish() offers
+    # every terminal record to the tail-exemplar reservoir
+    forensics: Optional[object] = None
+    # hop-timeline recording switch (independent of the reservoir: tests
+    # and the bench record timelines without a plane attached)
+    timeline_on: bool = True
     x_request_id: Optional[str] = None
     trace_id: Optional[str] = None
     parent_span_id: Optional[str] = None
@@ -153,6 +171,40 @@ class RequestTracker:
     tool_call_names: List[str] = field(default_factory=list)
     _dispatches: int = 0
     _finished: bool = False
+    # -- hop timeline (obs/forensics.py vocabulary) -----------------------
+    hops: List[Dict[str, Any]] = field(default_factory=list)
+    # exact accumulated stall time (every stall counts here even past
+    # the per-record hop cap — partition exactness depends on it)
+    stall_ms: float = 0.0
+    stall_threshold_s: float = 0.0  # 0 = resolve from env on first token
+    # last worker_stamp facts (realized prefix reuse etc.) — the final
+    # dispatch attempt's truth wins, matching decode_worker_id
+    worker_stamp: Optional[Dict[str, Any]] = None
+    _stall_hops: int = 0
+
+    MAX_HOPS = 256          # decode_stall/worker_stamp flood guard
+    MAX_STALL_HOPS = 64     # coarse stalls; stall_ms stays exact
+
+    def hop(self, kind: str, at: Optional[float] = None, **attrs) -> None:
+        """Append one timeline hop.  `at` backdates (monotonic clock);
+        unregistered kinds raise — the same loud contract as
+        ``chaos.rule()`` on an unregistered seam (a typo'd hop would be
+        an orphan row the partition silently never joins on)."""
+        from ..obs.forensics import HOP_KINDS
+
+        if not self.timeline_on or self._finished:
+            return
+        if kind not in HOP_KINDS:
+            raise ValueError(f"hop kind {kind!r} not in obs.HOP_KINDS")
+        if not self.hops:
+            self.hops.append({"hop": "received", "t_ms": 0.0})
+        if len(self.hops) >= self.MAX_HOPS:
+            return
+        t = at if at is not None else time.monotonic()
+        entry: Dict[str, Any] = dict(attrs)
+        entry["hop"] = kind
+        entry["t_ms"] = round((t - self._t0) * 1000.0, 3)
+        self.hops.append(entry)
 
     @staticmethod
     def from_headers(headers, request_id: str, model: str,
@@ -174,10 +226,43 @@ class RequestTracker:
         self._dispatches += 1
         self.migrations = self._dispatches - 1
         self.decode_worker_id = instance_id
+        self.hop("dispatched", attempt=self._dispatches,
+                 **({"worker": instance_id} if instance_id is not None
+                    else {}))
         if self._dispatch_t is None:
             # queue time = received -> FIRST dispatch (preprocessing +
             # routing + admission wait); replays don't re-queue
             self._dispatch_t = time.monotonic()
+
+    def on_routed(self, instance_id: Optional[int],
+                  decision: Optional[Dict[str, Any]] = None) -> None:
+        """Router decision made (MigrationOperator, per attempt): the
+        routed hop carries the decision's WHY — per-candidate cost
+        scores, predicted overlap blocks, best rejected candidate,
+        regret (router/kv_router.py decision dict) — so a tail autopsy
+        can say not just where the request went but what it beat."""
+        attrs: Dict[str, Any] = {"attempt": self._dispatches + 1}
+        if instance_id is not None:
+            attrs["worker"] = instance_id
+        if decision:
+            attrs.update(decision)
+        self.hop("routed", **attrs)
+
+    def on_worker_stamp(self, stamp: Dict[str, Any],
+                        attempt: Optional[int] = None) -> None:
+        """Worker-side facts stamped back via the stream (engine/mocker
+        `forensic` metrics block): realized prefix-cache reuse, queue
+        position at admission, step counts.  The LAST stamp wins as the
+        record's truth (matching decode_worker_id after a migration),
+        and realized reuse replaces the router-predicted cached_tokens
+        the frontend guessed at first delta."""
+        self.worker_stamp = dict(stamp)
+        if stamp.get("cached_tokens") is not None:
+            self.cached_tokens = int(stamp["cached_tokens"])
+        self.hop("worker_stamp",
+                 attempt=attempt if attempt is not None
+                 else max(self._dispatches, 1),
+                 **stamp)
 
     def mark_dispatching(self, at: Optional[float] = None) -> None:
         """Queue time ends the moment the request leaves the frontend
@@ -206,6 +291,26 @@ class RequestTracker:
         now = time.monotonic()
         if self._first_token_t is None:
             self._first_token_t = now
+            self.hop("first_token", at=now)
+        elif self.timeline_on and self._last_token_t is not None:
+            # coarse decode-stall hops: a token gap past the threshold
+            # is a stall.  stall_ms stays EXACT past the hop cap (the
+            # partition subtracts it from decode), the hops are the
+            # coarse where-did-it-stall markers
+            if not self.stall_threshold_s:
+                from ..obs.forensics import stall_threshold_s
+
+                # -1 = explicitly disabled (DYN_STALL_THRESHOLD_S<=0);
+                # 0 stays "unresolved" and would re-read env per token
+                self.stall_threshold_s = stall_threshold_s() or -1.0
+            gap = now - self._last_token_t
+            if self.stall_threshold_s > 0.0 \
+                    and gap >= self.stall_threshold_s:
+                self.stall_ms += gap * 1000.0
+                if self._stall_hops < self.MAX_STALL_HOPS:
+                    self._stall_hops += 1
+                    self.hop("decode_stall", at=now,
+                             dur_ms=round(gap * 1000.0, 3))
         self._last_token_t = now
         self.output_tokens += n
 
@@ -328,6 +433,30 @@ class RequestTracker:
             }
         if self.session_id:
             record["agent_context"] = {"session_id": self.session_id}
+        if self.hops:
+            # forensics timeline: the terminal hop is appended directly
+            # (the hop() gate is already closed by _finished, which is
+            # what keeps a late on_tokens from mutating an emitted
+            # record), and the partition is computed HERE so the JSONL
+            # sink and the reservoir carry identical autopsies.  The
+            # six phases sum to total_time_ms exactly by construction
+            # (obs/forensics.py phase_partition).
+            from ..obs.forensics import phase_partition
+
+            self.hops.append({"hop": "finish",
+                              "t_ms": round(total_ms, 3),
+                              "outcome": outcome})
+            partition = phase_partition(self.hops, total_ms,
+                                        self.stall_ms)
+            timeline: Dict[str, Any] = {
+                "hops": self.hops,
+                "stall_ms": round(self.stall_ms, 3),
+                "partition": {p: round(v, 3)
+                              for p, v in partition.items()},
+            }
+            if self.worker_stamp is not None:
+                timeline["worker"] = self.worker_stamp
+            record["timeline"] = timeline
         self._record = record
         if self.sink is not None:
             self.sink.emit(record)
@@ -336,4 +465,9 @@ class RequestTracker:
             # SLO plane's histograms/goodput (obs/slo.py; it guards its
             # own exceptions — a metrics bug must not fail the request)
             self.slo.observe_finish(self, record)
+        if self.forensics is not None:
+            # tail-exemplar reservoir (obs/forensics.py): retains this
+            # record if it is tail-worthy or breached; guards its own
+            # exceptions like the SLO plane
+            self.forensics.observe_finish(self, record)
         return record

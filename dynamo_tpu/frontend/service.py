@@ -360,6 +360,17 @@ class HttpService:
         from ..obs.slo import SloConfig, SloPlane
 
         self.slo_plane = SloPlane(m, slo or SloConfig())
+        # forensics plane (obs/forensics.py): always-on tail-exemplar
+        # reservoir fed from RequestTracker.finish, served on the
+        # token-gated /debug/requests route (runtime/system_status.py).
+        # DYN_FORENSICS=0 disables BOTH the reservoir and per-request
+        # hop recording (timeline_on below) — the bench A/B smoke
+        # proves token streams are byte-identical either way.
+        from ..obs.forensics import ForensicsPlane, forensics_enabled
+
+        self.forensics = (ForensicsPlane(m,
+                                         slo_config=self.slo_plane.config)
+                          if forensics_enabled() else None)
         self.app = web.Application()
         self.app.router.add_get("/v1/models", self.h_models)
         self.app.router.add_post("/v1/chat/completions", self.h_chat)
@@ -581,7 +592,9 @@ class HttpService:
 
         tracker = RequestTracker.from_headers(
             request.headers, req.request_id, model, self.trace_sink,
-            slo=self.slo_plane, session_id=req.session_id,
+            slo=self.slo_plane, forensics=self.forensics,
+            timeline_on=self.forensics is not None,
+            session_id=req.session_id,
             endpoint="chat" if chat else "completions",
             input_tokens=len(req.token_ids))
         # mint/propagate the trace context (request_trace.propagate):
@@ -879,7 +892,9 @@ class HttpService:
                                            reasoning=reasoning,
                                            tool_calls=calls))
                     obs.end("frame_egress", t_obs,
-                            tokens=d.token_count)
+                            tokens=d.token_count,
+                            trace_id=(tracker.trace_id
+                                      if tracker is not None else None))
                     first = False
                 if d.finish_reason:
                     final_finish = finish or d.finish_reason
@@ -912,9 +927,11 @@ class HttpService:
 
     def debug_state(self) -> dict:
         """Frontend half of /debug/state (fleet introspection plane):
-        served models, in-flight count, and the SLO plane's rolling
-        summary — what the fleet aggregator folds into goodput spread."""
-        return {
+        served models, in-flight count, the SLO plane's rolling
+        summary, and — when KV routers are attached — each router's
+        predicted-vs-realized overlap stats (the indexer-staleness
+        signal the fleet reduction surfaces)."""
+        state = {
             "kind": "frontend",
             "instance_id": self._fleet_instance_id,
             "models": sorted(self.manager.models),
@@ -922,6 +939,22 @@ class HttpService:
             "busy_threshold": self.busy_threshold,
             "slo": self.slo_plane.summary(),
         }
+        from .pipeline import _route_attr
+
+        routers = {}
+        for name, p in self.manager.models.items():
+            fn = _route_attr(p.migration.route, "overlap_stats")
+            if fn is not None:
+                routers[name] = fn()
+        if routers:
+            state["router"] = routers
+        if self.forensics is not None:
+            state["tail"] = {
+                **self.forensics.counts(),
+                "realized_overlap":
+                    self.forensics.realized_overlap()["ratio"],
+            }
+        return state
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> "HttpService":
@@ -942,6 +975,12 @@ class HttpService:
         self._fleet_instance_id = new_instance_id()
         rt.register_debug_source(f"frontend:{self._fleet_instance_id}",
                                  self.debug_state)
+        if self.forensics is not None:
+            # tail-exemplar dump on the token-gated /debug/requests
+            # route (runtime/system_status.py), discovered by the fleet
+            # aggregator exactly like /debug/state
+            rt.register_forensics_source(
+                f"frontend:{self._fleet_instance_id}", self.forensics.dump)
         self._fleet_instance = None
         if rt.system_address:
             port = self._runner.addresses[0][1]
@@ -975,6 +1014,8 @@ class HttpService:
     async def close(self) -> None:
         if getattr(self, "_fleet_instance_id", None) is not None:
             self.runtime.unregister_debug_source(
+                f"frontend:{self._fleet_instance_id}")
+            self.runtime.unregister_forensics_source(
                 f"frontend:{self._fleet_instance_id}")
         if getattr(self, "_fleet_instance", None) is not None:
             try:

@@ -1,4 +1,4 @@
-"""dynlint rules DYN001–DYN010: each one encodes a bug this repo really
+"""dynlint rules DYN001–DYN012: each one encodes a bug this repo really
 shipped (the PR it came from is named per rule), turning a
 found-late-by-review-or-live-fleet failure into a permanently-enforced
 invariant.  The README "Static analysis" table is generated from the
@@ -574,3 +574,35 @@ def print_in_library(mod: Module) -> Iterable[Finding]:
                 "DYN010", node,
                 "print() in library code: use runtime/logging (levels, "
                 "TraceIdFilter correlation) — stdout is not scraped")
+
+
+# ---------------------------------------------------------------------------
+# DYN012 — forensics hop-kind literal not in the central registry
+# ---------------------------------------------------------------------------
+
+def _hop_kinds():
+    from .. import obs
+
+    return obs.HOP_KINDS
+
+
+@register(
+    "DYN012",
+    "forensics hop-kind literal not in obs.HOP_KINDS",
+    "forensics-plane twin of DYN006: a typo'd hop name would be an orphan "
+    "timeline row the phase partition and the tail autopsy silently never "
+    "join on; obs.HOP_KINDS is the single source of truth",
+    applies=_in_pkg_or_tests)
+def hop_literals(mod: Module) -> Iterable[Finding]:
+    kinds = _hop_kinds()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or terminal(node.func) != "hop":
+            continue
+        kind = str_arg(node)
+        if kind is not None and kind not in kinds:
+            yield mod.finding(
+                "DYN012", node,
+                f"hop kind {kind!r} is not in obs.HOP_KINDS — the exact "
+                "phase partition and the tail autopsy join on the "
+                "registered taxonomy; register the kind (and its "
+                "docstring-table row) or fix the typo")

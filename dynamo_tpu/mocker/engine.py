@@ -136,6 +136,13 @@ class _Seq:
     prefill_pos: int = 0  # tokens prefetched so far (chunked prefill)
     generated: int = 0
     cached_blocks: int = 0
+    # forensics parity with the JAX engine (engine/core.py _forensic):
+    # queue position at enqueue + prefill chunk count, stamped back on
+    # the first-token/finish frames so the whole plane — realized
+    # overlap included, from the capacity sim's prefix matching — is
+    # tier-1 testable CPU-only
+    queue_pos: int = 0
+    prefill_chunks: int = 0
     finished: bool = False
     disagg_prefill: bool = False   # prefill-only hop; return transfer params
     remote_prefilled: bool = False  # KV arrives via transfer; skip prefill
@@ -331,6 +338,7 @@ class MockEngine:
         seq.disagg_prefill = DISAGG_ANNOTATION in (request.annotations or [])
         dp = request.disaggregated_params
         seq.remote_prefilled = bool(dp) and dp.get("engine") == "mock"
+        seq.queue_pos = len(self.waiting)
         self.waiting.append(seq)
         self._wake.set()
         from ..runtime.aio import CANCELLED, next_or_cancel
@@ -494,6 +502,7 @@ class MockEngine:
                 if chunk <= 0:
                     continue
                 seq.prefill_pos += chunk
+                seq.prefill_chunks += 1
                 prefill_tokens += chunk
                 prefill_rows += 1
                 budget -= chunk
@@ -576,6 +585,7 @@ class MockEngine:
                         "first_token": tok,
                         "prompt_len": seq.num_prompt_tokens,
                     },
+                    metrics={"forensic": self._forensic(seq)},
                 ))
                 seq.finished = True
                 self.running.remove(seq)
@@ -642,13 +652,23 @@ class MockEngine:
                     self.metrics["decode_tokens"] += 1
 
                     finish = self._finish_reason(seq, tok)
+                    # forensic stamp on first-token + finish frames —
+                    # the JAX engine's exact contract
+                    # (engine/core.py _push_token)
+                    if finish:
+                        step_metrics = {
+                            "kv_usage": self.kv_usage(),
+                            "active_seqs": len(self.running),
+                            "forensic": self._forensic(seq),
+                        }
+                    elif seq.generated == 1:
+                        step_metrics = {"forensic": self._forensic(seq)}
+                    else:
+                        step_metrics = None
                     out = LLMEngineOutput(
                         token_ids=[tok],
                         finish_reason=finish,
-                        metrics={
-                            "kv_usage": self.kv_usage(),
-                            "active_seqs": len(self.running),
-                        } if finish else None,
+                        metrics=step_metrics,
                     )
                     seq.out_queue.put_nowait(out)
                     if finish is not None:
@@ -681,6 +701,18 @@ class MockEngine:
                               serving=True)
         obs.end("step", t_step, track=self._obs_track,
                 active=len(self.running), waiting=len(self.waiting))
+
+    def _forensic(self, seq: _Seq) -> dict:
+        """Worker-side forensic stamp (the JAX engine's _forensic
+        contract): realized prefix reuse comes from the capacity sim's
+        prefix matching, so predicted-vs-realized routing tests run
+        CPU-only."""
+        return {
+            "cached_tokens": seq.cached_blocks * self.args.block_size,
+            "queue_pos": seq.queue_pos,
+            "prefill_chunks": seq.prefill_chunks,
+            "generated": seq.generated,
+        }
 
     def _next_token(self, seq: _Seq) -> int:
         canned = self.args.canned_text

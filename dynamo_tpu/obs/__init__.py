@@ -293,6 +293,12 @@ class Tracer:
 
 _TRACER: Optional[Tracer] = None
 
+# the forensics plane's hop taxonomy, re-exported here so call sites
+# (and the DYN012 lint) address it as ``obs.HOP_KINDS`` — the same
+# one-registry pattern as SPAN_KINDS above (forensics.py is stdlib-only,
+# so this import stays cheap for the lint's registry load)
+from .forensics import HOP_KINDS  # noqa: E402
+
 
 def tracer() -> Optional[Tracer]:
     return _TRACER
@@ -443,6 +449,7 @@ def install_from_env() -> Optional[Tracer]:
 
 __all__ = [
     "DEFAULT_RING",
+    "HOP_KINDS",
     "SPAN_KINDS",
     "STEP_PHASES",
     "Tracer",

@@ -70,6 +70,9 @@ class WorkerView:
     state: str                # live | stale | unreachable
     debug: Optional[dict] = None    # this worker's /debug/state source
     metrics: Dict[str, float] = field(default_factory=dict)
+    # this frontend's /debug/requests forensics dump (tail exemplars;
+    # obs/forensics.py) — best-effort, never affects `state`
+    tail: Optional[dict] = None
     error: str = ""
 
     def to_dict(self) -> dict:
@@ -79,6 +82,7 @@ class WorkerView:
             "endpoint": self.endpoint, "address": self.address,
             "system_addr": self.system_addr, "state": self.state,
             "debug": self.debug, "metrics": self.metrics,
+            **({"tail": self.tail} if self.tail is not None else {}),
             **({"error": self.error} if self.error else {}),
         }
 
@@ -143,21 +147,34 @@ def _parse_headline_metrics(text: str) -> Dict[str, float]:
             for s in fam.samples:
                 out[f"{fam.name}:{s.labels.get('phase', '')}"] = s.value
         elif fam.name in ("dynamo_frontend_slo_goodput",
-                          "dynamo_engine_itl_ema_seconds"):
+                          "dynamo_engine_itl_ema_seconds",
+                          # router decision attribution (kv_router.py):
+                          # index-staleness + realized reuse, scraped
+                          # into the merged view so a stale indexer is
+                          # visible fleet-wide
+                          "dynamo_router_overlap_staleness_ratio",
+                          "dynamo_frontend_realized_overlap_ratio"):
             for s in fam.samples:
                 out[fam.name] = s.value
     return out
 
 
 async def _scrape_addr(session, addr: str, token: str,
-                       timeout_s: float) -> Tuple[Optional[dict],
-                                                  Optional[Dict[str, float]],
-                                                  str]:
-    """(debug_state, headline_metrics, error) for one process; each
-    surface fails independently (partial data beats none)."""
+                       timeout_s: float,
+                       want_requests: bool = False
+                       ) -> Tuple[Optional[dict],
+                                  Optional[Dict[str, float]],
+                                  Optional[dict], str]:
+    """(debug_state, headline_metrics, forensics, error) for one
+    process; each surface fails independently (partial data beats
+    none).  The forensics surface (/debug/requests, obs/forensics.py)
+    is scraped only for frontend-bearing addresses and NEVER affects
+    the worker's live/stale classification — tail exemplars are an
+    autopsy bonus, not a health signal."""
     headers = {"X-Dyn-Admin-Token": token} if token else {}
     debug: Optional[dict] = None
     metrics: Optional[Dict[str, float]] = None
+    forensics: Optional[dict] = None
     errs = []
     try:
         body = await _fetch(session, f"http://{addr}/debug/state", headers,
@@ -171,7 +188,15 @@ async def _scrape_addr(session, addr: str, token: str,
         metrics = _parse_headline_metrics(text)
     except Exception as e:
         errs.append(f"metrics: {type(e).__name__}: {e}")
-    return debug, metrics, "; ".join(errs)
+    if want_requests:
+        try:
+            body = await _fetch(session, f"http://{addr}/debug/requests",
+                                headers, timeout_s)
+            forensics = json.loads(body)
+        except Exception:
+            logger.debug("forensics scrape of %s failed", addr,
+                         exc_info=True)
+    return debug, metrics, forensics, "; ".join(errs)
 
 
 async def snapshot(discovery, namespace: Optional[str] = None,
@@ -205,14 +230,21 @@ async def snapshot(discovery, namespace: Optional[str] = None,
         addr = str(inst.metadata.get("system_addr", ""))
         if addr:
             by_addr.setdefault(addr, []).append(inst)
-    scraped: Dict[str, Tuple[Optional[dict], Optional[dict], str]] = {}
+
+    def _frontendish(insts: List[Instance]) -> bool:
+        return any(i.endpoint == "http"
+                   or i.metadata.get("kind") == "frontend"
+                   for i in insts)
+
+    scraped: Dict[str, tuple] = {}
     if by_addr:
         import aiohttp
 
         async with aiohttp.ClientSession() as session:
             results = await asyncio.gather(
-                *(_scrape_addr(session, addr, token, timeout_s)
-                  for addr in by_addr))
+                *(_scrape_addr(session, addr, token, timeout_s,
+                               want_requests=_frontendish(insts))
+                  for addr, insts in by_addr.items()))
         scraped = dict(zip(by_addr, results))
 
     workers: List[WorkerView] = []
@@ -228,9 +260,19 @@ async def snapshot(discovery, namespace: Optional[str] = None,
         if not addr:
             view.error = "no system_addr advertised (DYN_SYSTEM_PORT off?)"
         else:
-            debug, metrics, err = scraped[addr]
+            debug, metrics, forensics, err = scraped[addr]
             view.error = err
             view.metrics = metrics or {}
+            if forensics is not None:
+                # ONLY this instance's forensics source (keyed
+                # "frontend:<instance_id>" by the HttpService) — a
+                # strict match, because co-located workers share the
+                # same system_addr and must not have the frontend's
+                # whole tail dump misattributed onto their views
+                srcs = forensics.get("sources") or {}
+                view.tail = next(
+                    (v for k, v in srcs.items()
+                     if k.endswith(f":{inst.instance_id}")), None)
             if debug is not None:
                 sources = debug.get("sources", {})
                 mine = next(
@@ -319,6 +361,17 @@ def summarize_states(states: List[dict], frontend_states: List[dict] = (),
     goodputs = [float(f["slo"]["goodput"]) for f in frontend_states
                 if isinstance(f.get("slo"), dict)
                 and f["slo"].get("goodput") is not None]
+    # router decision attribution (kv_router.py overlap_stats via the
+    # frontend's debug dump): the WORST per-model staleness across all
+    # frontends — the ROADMAP-item-2 indexer-accuracy headline
+    stalenesses = [
+        float(st["staleness_ratio"])
+        for f in frontend_states
+        for st in (f.get("router") or {}).values()
+        if isinstance(st, dict) and st.get("staleness_ratio") is not None]
+    # tail-forensics headline (obs/forensics.py counts via debug dump)
+    tails = [f["tail"] for f in frontend_states
+             if isinstance(f.get("tail"), dict)]
     return {
         "workers": live + stale + unreachable,
         "live": live,
@@ -343,6 +396,13 @@ def summarize_states(states: List[dict], frontend_states: List[dict] = (),
                      "max": round(max(goodputs), 4),
                      "spread": round(max(goodputs) - min(goodputs), 4)}
                     if goodputs else None),
+        "router_staleness_max": (round(max(stalenesses), 4)
+                                 if stalenesses else None),
+        "tail": ({"exemplars": sum(int(t.get("exemplars", 0))
+                                   for t in tails),
+                  "breaches": sum(int(t.get("breaches", 0))
+                                  for t in tails)}
+                 if tails else None),
     }
 
 
@@ -413,6 +473,20 @@ def export_fleet_gauges(metrics, snap: FleetSnapshot,
     metrics.set("dynamo_fleet_kv_headroom_min",
                 float(s["kv_headroom_min"]))
     metrics.set("dynamo_fleet_frontends", float(s["frontends"]))
+    if s.get("router_staleness_max") is not None:
+        metrics.set("dynamo_fleet_router_staleness_max",
+                    float(s["router_staleness_max"]),
+                    "worst per-model router overlap-staleness ratio "
+                    "across frontends (kv_router.py overlap_stats)")
+    else:
+        metrics.remove("dynamo_fleet_router_staleness_max")
+    if s.get("tail") is not None:
+        metrics.set("dynamo_fleet_tail_breaches",
+                    float(s["tail"]["breaches"]),
+                    "SLO-breach exemplars retained across frontends "
+                    "(obs/forensics.py)")
+    else:
+        metrics.remove("dynamo_fleet_tail_breaches")
     if s.get("goodput") is not None:
         metrics.set("dynamo_fleet_goodput_spread",
                     float(s["goodput"]["spread"]))

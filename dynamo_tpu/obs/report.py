@@ -310,17 +310,138 @@ def report(events: List[Dict[str, Any]], peak_tflops: float = 0.0,
     }
 
 
+# ---------------------------------------------------------------------------
+# tail autopsy (forensics dumps — obs/forensics.py dynamo.forensics.v1)
+# ---------------------------------------------------------------------------
+
+
+def forensics_docs(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The forensics dumps inside one JSON document: a raw
+    ForensicsPlane.dump(), or a /debug/requests response wrapping one
+    dump per registered source."""
+    out = []
+    if doc.get("schema") == "dynamo.forensics.v1":
+        out.append(doc)
+    for v in (doc.get("sources") or {}).values():
+        if isinstance(v, dict) and v.get("schema") == "dynamo.forensics.v1":
+            out.append(v)
+    return out
+
+
+def tail_autopsy(dumps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Reduce forensics dumps to the tail-autopsy section: per model,
+    the worst exemplar by TTFT and by mean ITL with their EXACT
+    queue/route/prefill/transfer/decode/stall partitions, the mean
+    phase mix across every retained exemplar, breach counts by reason,
+    and the partition-exactness check (max |Σphases − e2e| / e2e — a
+    property of the recording, verified here on every exemplar, not an
+    accounting trick)."""
+    per_model: Dict[str, Dict[str, Any]] = {}
+    realized = {"realized_tokens": 0, "input_tokens": 0}
+    for dump in dumps:
+        ro = dump.get("realized_overlap") or {}
+        realized["realized_tokens"] += int(ro.get("realized_tokens") or 0)
+        realized["input_tokens"] += int(ro.get("input_tokens") or 0)
+        for model, windows in (dump.get("models") or {}).items():
+            m = per_model.setdefault(model, {
+                "seen": {}, "breach_reasons": {}, "breaches": 0,
+            })
+            for w in windows:
+                for kind in ("ttft", "itl", "breach"):
+                    for ex in w.get(kind) or ():
+                        # the same exemplar can sit in several ranked
+                        # lists; dedupe by request id
+                        m["seen"][ex.get("request_id", id(ex))] = ex
+                for ex in w.get("breach") or ():
+                    m["breaches"] += 1
+                    r = ex.get("breach", "unknown")
+                    m["breach_reasons"][r] = \
+                        m["breach_reasons"].get(r, 0) + 1
+    models: Dict[str, Any] = {}
+    n_total = 0
+    worst_err = 0.0
+    for model, m in per_model.items():
+        exemplars = list(m["seen"].values())
+        n_total += len(exemplars)
+        phase_sum: Dict[str, float] = {}
+        e2e_sum = 0.0
+        for ex in exemplars:
+            part = ex.get("partition") or {}
+            e2e = float(ex.get("e2e_ms") or 0.0)
+            e2e_sum += e2e
+            for p, v in part.items():
+                phase_sum[p] = phase_sum.get(p, 0.0) + float(v)
+            if e2e > 0.0:
+                worst_err = max(worst_err, abs(
+                    sum(float(v) for v in part.values()) - e2e) / e2e)
+
+        def _brief(ex):
+            if ex is None:
+                return None
+            return {k: ex.get(k) for k in
+                    ("request_id", "ttft_ms", "avg_itl_ms", "e2e_ms",
+                     "outcome", "breach", "partition") if k in ex}
+
+        models[model] = {
+            "exemplars": len(exemplars),
+            "breaches": m["breaches"],
+            "breach_reasons": m["breach_reasons"],
+            # mean phase mix over the retained tail (fractions of the
+            # summed e2e, so phases with rounding dust stay comparable)
+            "phase_mix": ({p: round(v / e2e_sum, 4)
+                           for p, v in sorted(phase_sum.items(),
+                                              key=lambda kv: -kv[1])}
+                          if e2e_sum > 0.0 else {}),
+            "worst_ttft": _brief(max(
+                (e for e in exemplars if e.get("ttft_ms") is not None),
+                key=lambda e: e["ttft_ms"], default=None)),
+            "worst_itl": _brief(max(
+                (e for e in exemplars if e.get("avg_itl_ms") is not None),
+                key=lambda e: e["avg_itl_ms"], default=None)),
+        }
+    return {
+        "exemplars": n_total,
+        "partition_err_max": round(worst_err, 6),
+        "realized_overlap_ratio": (
+            round(realized["realized_tokens"] / realized["input_tokens"], 4)
+            if realized["input_tokens"] else None),
+        "models": models,
+    }
+
+
 def report_paths(paths: Iterable[str], peak_tflops: float = 0.0,
                  peak_hbm_gbps: float = 0.0) -> Dict[str, Any]:
-    return report(load_events(paths), peak_tflops, peak_hbm_gbps)
+    """Reduce a mixed set of dumps: Chrome traces feed the gap/roofline
+    sections, forensics dumps (/debug/requests or ForensicsPlane.dump
+    files) feed the tail-autopsy section — pass both and the report
+    carries both."""
+    events: List[Dict[str, Any]] = []
+    tails: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        found = forensics_docs(doc)
+        if found:
+            tails.extend(found)
+        else:
+            events.extend(events_of_doc(doc))
+    rep = report(events, peak_tflops, peak_hbm_gbps)
+    if tails:
+        rep["tail"] = tail_autopsy(tails)
+    return rep
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "dynamo_tpu.obs.report",
         description="Gap-attribution report over Chrome trace dumps "
-                    "(DYN_TRACE_OUT / bench_serving.py --trace-out).")
-    p.add_argument("paths", nargs="+", help="Chrome trace JSON dump(s)")
+                    "(DYN_TRACE_OUT / bench_serving.py --trace-out); "
+                    "forensics dumps (/debug/requests JSON or "
+                    "ForensicsPlane.dump files) additionally render "
+                    "the tail-autopsy section.")
+    p.add_argument("paths", nargs="+",
+                   help="Chrome trace JSON dump(s) and/or "
+                        "dynamo.forensics.v1 dumps")
     p.add_argument("--indent", type=int, default=2,
                    help="JSON indent (0 = one line)")
     p.add_argument("--peak-tflops", type=float, default=0.0,

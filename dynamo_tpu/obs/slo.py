@@ -64,6 +64,34 @@ OUTCOME_OK = "ok"
 OUTCOME_ERROR = "error"                    # errored after ≥1 token
 OUTCOME_NO_FIRST_TOKEN = "no_first_token"  # errored before any token
 
+def breach_reason(config, record: dict) -> Optional[str]:
+    """Why one request_end record breached, or None when it was good.
+
+    THE shared breach predicate: SloPlane's per-reason counters and the
+    forensics plane's breach retention (obs/forensics.py) must agree on
+    what a breach is, so both call this.  A non-ok outcome is always a
+    breach reason (even with no latency targets configured — an errored
+    request is a tail event worth pinning); with targets set, a missed
+    TTFT/ITL target breaches with that target's name.  A request with
+    ≤1 token has no ITL and passes that check (the goodput convention
+    above)."""
+    req = record.get("request", {})
+    outcome = req.get("outcome", OUTCOME_OK)
+    if outcome != OUTCOME_OK:
+        return outcome
+    if config is None or not config.targets_set:
+        return None
+    ttft_ms = req.get("ttft_ms")
+    if config.ttft_ms is not None and (ttft_ms is None
+                                       or ttft_ms > config.ttft_ms):
+        return "ttft"
+    itl_ms = req.get("avg_itl_ms")
+    if config.itl_ms is not None and itl_ms is not None \
+            and itl_ms > config.itl_ms:
+        return "itl"
+    return None
+
+
 _E2E_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                 10.0, 30.0, 60.0, 120.0, 300.0)
 _QUEUE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
@@ -163,17 +191,9 @@ class SloPlane:
                            float(req["queue_ms"]) / 1000.0, model=model)
         if not c.targets_set:
             return
-        good = outcome == OUTCOME_OK
-        if good and c.ttft_ms is not None:
-            good = ttft_ms is not None and ttft_ms <= c.ttft_ms
-        if good and c.itl_ms is not None and itl_ms is not None:
-            good = itl_ms <= c.itl_ms
+        reason = breach_reason(c, record)
+        good = reason is None
         if not good:
-            reason = (outcome if outcome != OUTCOME_OK else
-                      ("ttft" if (c.ttft_ms is not None
-                                  and (ttft_ms is None
-                                       or ttft_ms > c.ttft_ms))
-                       else "itl"))
             self.m.inc("dynamo_frontend_slo_breach_total",
                        model=model, reason=reason)
         now = time.monotonic()

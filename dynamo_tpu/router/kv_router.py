@@ -68,10 +68,37 @@ class KvRouter:
         # per-worker routing observability (ref metrics.rs): a skewed
         # fleet or a dead-prefix regression shows up here first
         self._metrics = runtime.metrics.scoped(component="router")
+        _OVERLAP_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
         self._metrics.histogram(
             "dynamo_router_overlap_blocks",
             "prefix-cache overlap of the chosen worker (blocks)",
-            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+            buckets=_OVERLAP_BUCKETS)
+        # decision attribution (forensics plane): what the chosen
+        # worker BEAT, and whether the index's predictions hold up —
+        # the indexer-staleness feedback ROADMAP item 2 is steered by
+        self._metrics.histogram(
+            "dynamo_router_overlap_best_rejected_blocks",
+            "prefix-cache overlap of the best rejected candidate per "
+            "decision (what routing left on the table)",
+            buckets=_OVERLAP_BUCKETS)
+        self._metrics.histogram(
+            "dynamo_router_overlap_realized_blocks",
+            "worker-realized prefix-cache reuse of routed requests "
+            "(stamped back via the stream's forensic block)",
+            buckets=_OVERLAP_BUCKETS)
+        self._metrics.histogram(
+            "dynamo_router_decision_regret_blocks",
+            "chosen candidate's cost minus the best candidate's cost "
+            "(block units; 0 = argmin picked — nonzero under "
+            "temperature sampling or avoid sets)",
+            buckets=_OVERLAP_BUCKETS)
+        # per-decision records awaiting their realized-overlap stamp
+        # (MigrationOperator pops one per routed attempt); bounded so
+        # never-dispatched requests can't grow it
+        from collections import OrderedDict, deque
+
+        self._decisions: "OrderedDict[str, dict]" = OrderedDict()
+        self._pred_real: "deque" = deque(maxlen=512)
         self._cancel = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
         self._replay_client: Optional[Client] = None
@@ -274,7 +301,7 @@ class KvRouter:
         for t in candidates:
             st = self.states.setdefault(t, WorkerState())
             st.active_blocks = self.sequences.active_blocks(t)
-        choice = self.selector.select(
+        choice, logits = self.selector.select_verbose(
             candidates, request_blocks, overlaps, self.states,
             avoid=avoid_targets,
         )
@@ -291,12 +318,106 @@ class KvRouter:
             self._metrics.inc("dynamo_router_routed_requests_total",
                               worker=str(choice))
             self._metrics.observe("dynamo_router_overlap_blocks", overlap)
+            self._record_decision(request.request_id, choice,
+                                  request_blocks, overlap, logits,
+                                  overlaps)
             # the wire needs the instance; the engine needs the rank
             worker_id, dp_rank = self.targets.resolve(choice)
             request.dp_rank = dp_rank
             return worker_id
         self._metrics.inc("dynamo_router_no_worker_total")
         return None
+
+    # -- decision attribution / predicted-vs-realized feedback -------------
+    def _record_decision(self, request_id: str, choice: int,
+                         request_blocks: int, overlap: int,
+                         logits: Dict[int, float],
+                         overlaps: Dict[int, int]) -> dict:
+        """One decision record per pick: the chosen target's predicted
+        overlap + cost, every candidate's score (top-8 by cost), the
+        best REJECTED candidate (what routing left on the table — the
+        satellite fix: the overlap histogram alone only ever showed the
+        winner), and the decision's regret vs the argmin.  The record
+        rides the forensics `routed` hop and is the correlation anchor
+        for the worker's realized-reuse stamp (on_realized)."""
+        chosen = logits.get(choice, 0.0)
+        best = min(logits.values()) if logits else 0.0
+        regret = max(0.0, chosen - best)
+        rejected = {t: c for t, c in logits.items() if t != choice}
+        decision: dict = {
+            "target": choice,
+            "predicted_overlap_blocks": int(overlap),
+            "request_blocks": int(request_blocks),
+            "score": round(chosen, 3),
+            "regret": round(regret, 3),
+            "scores": {str(t): round(c, 3) for t, c in
+                       sorted(logits.items(), key=lambda kv: kv[1])[:8]},
+        }
+        if rejected:
+            t = min(rejected, key=rejected.get)
+            decision["best_rejected"] = {
+                "target": t, "score": round(rejected[t], 3),
+                "overlap_blocks": int(overlaps.get(t, 0)),
+            }
+            self._metrics.observe(
+                "dynamo_router_overlap_best_rejected_blocks",
+                overlaps.get(t, 0))
+        self._metrics.observe("dynamo_router_decision_regret_blocks",
+                              regret)
+        self._decisions[request_id] = decision
+        while len(self._decisions) > 4096:
+            self._decisions.popitem(last=False)
+        return decision
+
+    def pop_decision(self, request_id: str) -> Optional[dict]:
+        """Hand the latest decision for `request_id` to the dispatcher
+        (frontend MigrationOperator) — popped so a migration's re-route
+        records a fresh decision for its own attempt."""
+        return self._decisions.pop(request_id, None)
+
+    def on_realized(self, decision: Optional[dict],
+                    realized_tokens) -> None:
+        """Worker-realized prefix reuse for one routed attempt (stamped
+        back via the stream's forensic block): the ONE signal that says
+        whether the indexer's predictions are accurate or stale.
+        Staleness ratio = 1 - matched/predicted over a rolling window,
+        where matched = min(predicted, realized) per decision — 0 means
+        every predicted block was actually reused, 1 means the index
+        promised overlap the workers no longer had."""
+        if realized_tokens is None:
+            return
+        realized = max(0, int(realized_tokens)) // self.block_size
+        predicted = int((decision or {}).get(
+            "predicted_overlap_blocks", 0))
+        self._metrics.observe("dynamo_router_overlap_realized_blocks",
+                              realized)
+        self._pred_real.append((predicted, realized))
+        preds = sum(p for p, _ in self._pred_real)
+        if preds:
+            matched = sum(min(p, r) for p, r in self._pred_real)
+            self._metrics.set("dynamo_router_overlap_staleness_ratio",
+                              1.0 - matched / preds,
+                              "rolling fraction of router-predicted "
+                              "overlap blocks the workers did NOT "
+                              "actually reuse (0 = index accurate)")
+
+    def overlap_stats(self) -> dict:
+        """Predicted-vs-realized rollup for /debug/state and the fleet
+        reduction (obs/fleet.py surfaces the max staleness across
+        frontends)."""
+        n = len(self._pred_real)
+        preds = sum(p for p, _ in self._pred_real)
+        reals = sum(r for _, r in self._pred_real)
+        matched = sum(min(p, r) for p, r in self._pred_real)
+        return {
+            "decisions": n,
+            "predicted_blocks": preds,
+            "realized_blocks": reals,
+            "staleness_ratio": (round(1.0 - matched / preds, 4)
+                                if preds else None),
+            "realized_minus_predicted_mean": (round((reals - preds) / n, 3)
+                                              if n else None),
+        }
 
     def charge(self, request: PreprocessedRequest, worker_id: int) -> None:
         """Record a placement decided outside this router (session
@@ -329,6 +450,9 @@ class KvRouter:
             self.sync.publish_prefill_done(request_id)
 
     def complete(self, request_id: str) -> None:
+        # a decision that never got dispatched/stamped must not outlive
+        # its request (the dict is bounded anyway; this is hygiene)
+        self._decisions.pop(request_id, None)
         self.sequences.free(request_id)
         if self.sync is not None:
             self.sync.publish_free(request_id)

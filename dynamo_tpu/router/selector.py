@@ -52,12 +52,27 @@ class DefaultWorkerSelector:
         states: Dict[int, "WorkerState"],
         avoid: Optional[set] = None,
     ) -> Optional[int]:
+        return self.select_verbose(workers, request_blocks, overlaps,
+                                   states, avoid=avoid)[0]
+
+    def select_verbose(
+        self,
+        workers: Sequence[int],
+        request_blocks: int,
+        overlaps: Dict[int, int],
+        states: Dict[int, "WorkerState"],
+        avoid: Optional[set] = None,
+    ) -> tuple:
+        """(choice, logits): the pick plus every candidate's cost —
+        what the router's decision attribution (kv_router.py) records
+        on the forensics `routed` hop and scores regret against.  The
+        pick itself is identical to select()."""
         cfg = self.config
         candidates = [w for w in workers if not avoid or w not in avoid]
         if not candidates:
             candidates = list(workers)
         if not candidates:
-            return None
+            return None, {}
         logits = {}
         for w in candidates:
             overlap = overlaps.get(w, 0)
@@ -72,10 +87,11 @@ class DefaultWorkerSelector:
         if cfg.temperature <= 0.0:
             best = min(logits.values())
             ties = [w for w, l in logits.items() if l == best]
-            return self._rng.choice(ties)
+            return self._rng.choice(ties), logits
         # softmax over -logit/T
         mn = min(logits.values())
         weights = [
             math.exp(-(logits[w] - mn) / cfg.temperature) for w in candidates
         ]
-        return self._rng.choices(candidates, weights=weights, k=1)[0]
+        return self._rng.choices(candidates, weights=weights, k=1)[0], \
+            logits

@@ -64,6 +64,12 @@ class DistributedRuntime:
         # and system_address is what instances advertise in discovery so
         # the fleet aggregator can find this process's scrape surface
         self.debug_sources: dict = {}
+        # forensics plane (obs/forensics.py): frontends register their
+        # tail-exemplar dump callables here; the token-gated
+        # /debug/requests route merges them (same shape as
+        # debug_sources, kept separate so the heavier per-request
+        # payload never rides a plain /debug/state scrape)
+        self.forensics_sources: dict = {}
         self.system_address: str = ""
         self._closed = False
 
@@ -84,6 +90,15 @@ class DistributedRuntime:
 
     def unregister_debug_source(self, name: str) -> None:
         self.debug_sources.pop(name, None)
+
+    def register_forensics_source(self, name: str, fn) -> None:
+        """Register a callable returning a dynamo.forensics.v1 dump
+        dict, merged into /debug/requests under `name` (the forensics
+        analogue of register_debug_source)."""
+        self.forensics_sources[name] = fn
+
+    def unregister_forensics_source(self, name: str) -> None:
+        self.forensics_sources.pop(name, None)
 
     async def start(self) -> "DistributedRuntime":
         await self.discovery.start()

@@ -1,5 +1,6 @@
 """Per-process system status server: /health /live /metrics + the
-token-gated admin debug surface /debug/state and /debug/profile.
+token-gated admin debug surface /debug/state, /debug/requests and
+/debug/profile.
 
 Ref: lib/runtime/src/system_status_server.rs:159-222 for the health
 trio.  The debug surface is the per-process half of the fleet
@@ -173,6 +174,37 @@ class SystemStatusServer:
             ],
         }
 
+    # -- /debug/requests --------------------------------------------------
+    async def _debug_requests(self, request: web.Request) -> web.Response:
+        """Tail-latency forensics dump (obs/forensics.py): the retained
+        slowest-K request timelines + every SLO breach with its pinned
+        span snapshot, per registered source.  Token-gated exactly like
+        /debug/state — timelines are metadata, never payload, but they
+        still carry request ids and worker placements."""
+        err = self._authorize(request)
+        if err is not None:
+            return err
+        rt = self.runtime
+        sources = {}
+        for name, fn in list(rt.forensics_sources.items()):
+            try:
+                v = fn()
+                if inspect.isawaitable(v):
+                    v = await v
+                sources[name] = v
+            except Exception as e:  # a broken source must not kill the dump
+                logger.warning("forensics source %s failed", name,
+                               exc_info=True)
+                sources[name] = {"error": f"{type(e).__name__}: {e}"}
+        body = json.dumps({
+            "worker_id": rt.worker_id,
+            "pid": os.getpid(),
+            "ts_unix": time.time(),
+            "sources": sources,
+        }, default=repr)
+        return web.Response(body=body.encode(),
+                            content_type="application/json")
+
     # -- /debug/profile ---------------------------------------------------
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """On-demand, time-bounded `jax.profiler` capture + a device
@@ -245,6 +277,7 @@ class SystemStatusServer:
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/debug/state", self._debug_state)
+        app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/debug/profile", self._debug_profile)
         app.router.add_post("/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(app)

@@ -60,6 +60,8 @@ def test_bench_help_exits_zero(path):
         # SLO plane flags (obs/slo.py vocabulary, ms like the frontend)
         assert "--slo-ttft-ms" in r.stdout
         assert "--slo-itl-ms" in r.stdout
+        # forensics plane A/B hook (obs/forensics.py)
+        assert "--forensics" in r.stdout
 
 
 def test_bench_serving_json_carries_slo_and_roofline_blocks():
@@ -98,3 +100,42 @@ def test_bench_serving_json_carries_slo_and_roofline_blocks():
         assert fleet["imbalance"] >= 1.0
         assert fleet["stragglers"] >= 0
         assert 0.0 <= fleet["kv_headroom_min"] <= 1.0
+        # tail-forensics block (obs/forensics.py, plane on by default):
+        # worst retained exemplar's EXACT phase partition + the
+        # realized-overlap rate read off the run's own registry
+        tail = rep["tail"]
+        assert tail["exemplars"] >= 1
+        part = tail["p99_partition"]
+        assert set(part) == {"queue", "route", "prefill", "transfer",
+                             "decode", "stall"}
+        # the pre-first-token phases sum to the exemplar's TTFT (the
+        # partition's exactness property, visible in the bench block)
+        pre = (part["queue"] + part["route"] + part["prefill"]
+               + part["transfer"])
+        assert abs(pre - tail["p99_ttft_ms"]) <= 0.02 * pre + 0.02
+
+
+def test_bench_serving_forensics_ab_streams_identical():
+    """--forensics ab: the always-on plane must be pure observation —
+    byte-identical token streams with it on vs off (hard assert inside
+    the bench), and a measured throughput overhead.  The <1% overhead
+    target is a bench-scale number; at smoke scale under suite-parallel
+    CPU contention the rate comparison carries timing noise, so the
+    gate here is a generous sanity bound on top of the identity
+    assert."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_serving.py"),
+         "--requests", "12", "--rate", "50", "--input-len", "64",
+         "--output-len", "8", "--speedup", "4", "--forensics", "ab"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    (rep,) = [json.loads(line) for line in r.stdout.splitlines()
+              if line.startswith("{")]
+    assert rep["config"] == "forensics_ab"
+    assert rep["streams_identical"] is True
+    assert rep["overhead_target_frac"] == 0.01
+    assert rep["overhead_frac"] < 0.5, rep
+    assert rep["tail"]["exemplars"] >= 1

@@ -1,0 +1,610 @@
+"""Tail-latency forensics plane (obs/forensics.py + the RequestTracker
+hop timeline + router decision attribution):
+
+- exact phase partition: queue/route/prefill/transfer/decode/stall sum
+  to the e2e (synthetic hop sets + a live tracker)
+- tail-exemplar reservoir: slowest-K retention/eviction order, window
+  rotation, breach retention with pinned flight-recorder spans
+- timeline coherence: mid-stream migration and drain-abort keep TWO
+  dispatched hops on ONE record; disagg brackets prefill_open/done and
+  first_token partitions as transfer
+- predicted-vs-realized overlap: a 2-worker mocker fleet with shared
+  prefixes converges the router's staleness ratio toward 0
+- the token-gated /debug/requests surface on a live fleet, with a
+  forced SLO breach pinned (timeline + span snapshot), folded into the
+  fleet snapshot
+"""
+
+import asyncio
+import json
+import time
+import types
+import uuid
+
+import aiohttp
+import pytest
+
+from dynamo_tpu import obs
+from dynamo_tpu.frontend.pipeline import MigrationOperator
+from dynamo_tpu.frontend.request_trace import RequestTracker
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.obs.forensics import (
+    HOP_KINDS,
+    PHASES,
+    ForensicsPlane,
+    phase_partition,
+)
+from dynamo_tpu.obs.slo import SloConfig, breach_reason
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+TOKEN = "forensics-test-token"
+
+
+def fresh_runtime(**cfg_kw) -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc",
+                        **cfg_kw)
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+def mock_args(**kw):
+    base = dict(model_name="m", block_size=4, base_step_s=0.0005,
+                prefill_s_per_token=0.0, decode_s_per_seq=0.0)
+    base.update(kw)
+    return MockEngineArgs(**base)
+
+
+def greedy_request(tokens, n, rid):
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    return PreprocessedRequest(
+        token_ids=list(tokens), request_id=rid,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=n, ignore_eos=True),
+    )
+
+
+# --------------------------- partition ----------------------------------
+
+
+def test_partition_exact_synthetic():
+    """The six phases sum to the e2e EXACTLY (telescoping), on local,
+    disagg, stalled, and died-early hop layouts."""
+    cases = [
+        # local: route/queue/prefill/decode
+        ([{"hop": "routed", "t_ms": 2.0},
+          {"hop": "dispatched", "t_ms": 3.5},
+          {"hop": "first_token", "t_ms": 53.0}], 100.0, 0.0),
+        # disagg: prefill hop then decode dispatch -> transfer phase
+        ([{"hop": "prefill_open", "t_ms": 1.0},
+          {"hop": "prefill_done", "t_ms": 41.0},
+          {"hop": "routed", "t_ms": 42.0},
+          {"hop": "dispatched", "t_ms": 43.0},
+          {"hop": "first_token", "t_ms": 60.0}], 90.0, 0.0),
+        # stalled decode: stall carved out of the decode interval
+        ([{"hop": "dispatched", "t_ms": 1.0},
+          {"hop": "first_token", "t_ms": 10.0}], 200.0, 75.0),
+        # died before any token
+        ([{"hop": "routed", "t_ms": 2.0},
+          {"hop": "dispatched", "t_ms": 3.0}], 50.0, 0.0),
+        # no hops at all (preprocess failure): everything is queue
+        ([], 30.0, 0.0),
+    ]
+    for hops, total, stall in cases:
+        part = phase_partition(hops, total, stall)
+        assert set(part) == set(PHASES)
+        assert all(v >= 0.0 for v in part.values()), part
+        assert abs(sum(part.values()) - total) < 1e-9, (hops, part)
+    # stall really lands in stall, not decode
+    part = phase_partition(cases[2][0], 200.0, 75.0)
+    assert part["stall"] == 75.0 and part["decode"] == 115.0
+
+
+def test_partition_exact_from_live_tracker():
+    """Partition exactness as recorded by a real tracker (the tested
+    acceptance property: phases sum to e2e within 1%), with a forced
+    decode stall producing a decode_stall hop AND exact stall_ms."""
+    tr = RequestTracker(request_id="r1", model="m",
+                        stall_threshold_s=0.02)
+    tr.on_routed(7, {"predicted_overlap_blocks": 3, "regret": 0.0})
+    tr.on_dispatch(7)
+    tr.on_tokens(1)
+    time.sleep(0.05)          # > stall threshold: one stall
+    tr.on_tokens(1)
+    tr.on_tokens(2)
+    rec = tr.finish(finish_reason="stop")
+    t = rec["timeline"]
+    kinds = [h["hop"] for h in t["hops"]]
+    assert kinds[0] == "received" and kinds[-1] == "finish"
+    assert "routed" in kinds and "dispatched" in kinds
+    assert "first_token" in kinds and "decode_stall" in kinds
+    assert all(k in HOP_KINDS for k in kinds)
+    assert t["stall_ms"] >= 50.0 * 0.9
+    total = rec["request"]["total_time_ms"]
+    part = t["partition"]
+    assert abs(sum(part.values()) - total) <= 0.01 * total
+    assert part["stall"] > 0.0
+    # the routed hop carries the decision attribution
+    routed = next(h for h in t["hops"] if h["hop"] == "routed")
+    assert routed["predicted_overlap_blocks"] == 3
+    assert routed["worker"] == 7
+
+
+def test_worker_stamp_replaces_predicted_cached_tokens():
+    tr = RequestTracker(request_id="r", model="m", input_tokens=20)
+    tr.on_dispatch(1)
+    tr.cached_tokens = 12    # frontend's router-predicted guess
+    tr.on_tokens(1)
+    tr.on_worker_stamp({"cached_tokens": 8, "queue_pos": 2,
+                        "prefill_chunks": 1, "generated": 1})
+    rec = tr.finish(finish_reason="stop")
+    # realized reuse wins as the record's truth
+    assert rec["request"]["cached_tokens"] == 8
+    assert rec["request"]["kv_hit_rate"] == 0.4
+    assert rec["timeline"]["worker"]["queue_pos"] == 2
+    stamp = next(h for h in rec["timeline"]["hops"]
+                 if h["hop"] == "worker_stamp")
+    assert stamp["cached_tokens"] == 8 and stamp["attempt"] == 1
+
+
+def test_unregistered_hop_kind_raises():
+    tr = RequestTracker(request_id="r", model="m")
+    with pytest.raises(ValueError):
+        tr.hop("dispatchd")  # dynlint: disable=DYN012 the negative test
+
+
+def test_timeline_off_records_nothing():
+    tr = RequestTracker(request_id="r", model="m", timeline_on=False)
+    tr.on_dispatch(1)
+    tr.on_tokens(3)
+    rec = tr.finish(finish_reason="stop")
+    assert tr.hops == [] and "timeline" not in rec
+
+
+# --------------------------- reservoir ----------------------------------
+
+
+def mk_record(rid, ttft=10.0, itl=None, e2e=100.0, outcome="ok",
+              model="m"):
+    req = {"request_id": rid, "model": model, "outcome": outcome,
+           "total_time_ms": e2e, "input_tokens": 10}
+    if ttft is not None:
+        req["ttft_ms"] = ttft
+    if itl is not None:
+        req["avg_itl_ms"] = itl
+    return {
+        "schema": "dynamo.request.trace.v1",
+        "request": req,
+        "timeline": {
+            "hops": [{"hop": "received", "t_ms": 0.0},
+                     {"hop": "dispatched", "t_ms": 1.0},
+                     {"hop": "first_token", "t_ms": ttft or 1.0}],
+            "stall_ms": 0.0,
+        },
+    }
+
+
+STUB = types.SimpleNamespace(trace_id=None)
+
+
+def test_reservoir_keeps_slowest_k_evicts_fastest():
+    plane = ForensicsPlane(k=3, window_s=600.0)
+    for rid, ttft in (("a", 10.0), ("b", 30.0), ("c", 20.0),
+                      ("d", 40.0), ("e", 5.0)):
+        plane.observe_finish(STUB, mk_record(rid, ttft=ttft, itl=ttft / 10))
+    (w,) = plane._windows.values()
+    ranked = w["m"]["ttft"]
+    # descending by TTFT, fastest exemplars evicted first
+    assert [e.request_id for e in ranked] == ["d", "b", "c"]
+    assert [e.request_id for e in w["m"]["itl"]] == ["d", "b", "c"]
+    # a new slow request displaces exactly the CURRENT fastest
+    plane.observe_finish(STUB, mk_record("f", ttft=25.0))
+    assert [e.request_id for e in w["m"]["ttft"]] == ["d", "b", "f"]
+    # counts dedupe across the ranked lists (d/b sit in BOTH): distinct
+    # retained requests are {d, b, c, f} — the same dedupe the tail
+    # autopsy applies, so the two surfaces agree
+    assert plane.counts() == {"exemplars": 4, "breaches": 0}
+    assert plane.dump()["exemplars"] == 4
+    # dump carries the partition for every exemplar
+    dump = plane.dump()
+    assert dump["schema"] == "dynamo.forensics.v1"
+    ex = dump["models"]["m"][0]["ttft"][0]
+    assert ex["request_id"] == "d"
+    assert abs(sum(ex["partition"].values()) - ex["e2e_ms"]) \
+        <= 0.01 * ex["e2e_ms"]
+
+
+def test_reservoir_window_rotation_evicts_oldest():
+    plane = ForensicsPlane(k=2, window_s=0.05, max_windows=2)
+    plane.observe_finish(STUB, mk_record("w0", ttft=10.0))
+    first_widx = next(iter(plane._windows))
+    time.sleep(0.06)
+    plane.observe_finish(STUB, mk_record("w1", ttft=10.0))
+    time.sleep(0.06)
+    plane.observe_finish(STUB, mk_record("w2", ttft=10.0))
+    assert len(plane._windows) == 2
+    assert first_widx not in plane._windows  # oldest window went first
+
+
+def test_breach_retained_and_pins_flight_spans():
+    tid = "ab" * 16
+    cfg = SloConfig(ttft_ms=1.0)  # everything breaches
+    plane = ForensicsPlane(slo_config=cfg, k=2)
+    tracker = types.SimpleNamespace(trace_id=tid)
+    with obs.Tracer(ring=256):
+        t0 = obs.begin()
+        obs.end("worker_request", t0, trace_id=tid, request_id="b1")
+        t0 = obs.begin()
+        obs.end("request", t0, trace_id="ff" * 16)  # other request
+        plane.observe_finish(tracker, mk_record("b1", ttft=500.0))
+    (w,) = plane._windows.values()
+    breaches = list(w["m"]["breach"])
+    assert len(breaches) == 1 and breaches[0].breach == "ttft"
+    # the pinned snapshot holds ONLY this trace's spans, and survives
+    # the tracer being uninstalled (the ring is gone, the pin is not)
+    kinds = [s["kind"] for s in breaches[0].spans]
+    assert kinds == ["worker_request"]
+    # non-ok outcomes breach even without latency targets
+    plane2 = ForensicsPlane()
+    plane2.observe_finish(STUB, mk_record("e1", ttft=None,
+                                          outcome="no_first_token"))
+    (w2,) = plane2._windows.values()
+    assert [e.breach for e in w2["m"]["breach"]] == ["no_first_token"]
+
+
+def test_breach_reason_is_the_shared_predicate():
+    cfg = SloConfig(ttft_ms=100.0, itl_ms=10.0)
+    assert breach_reason(cfg, mk_record("r", ttft=50.0, itl=5.0)) is None
+    assert breach_reason(cfg, mk_record("r", ttft=500.0)) == "ttft"
+    assert breach_reason(cfg, mk_record("r", ttft=50.0, itl=50.0)) == "itl"
+    assert breach_reason(cfg, mk_record("r", outcome="error")) == "error"
+    assert breach_reason(None, mk_record("r", outcome="error")) == "error"
+    assert breach_reason(None, mk_record("r")) is None
+    no_targets = SloConfig()
+    assert breach_reason(no_targets, mk_record("r", ttft=1e9)) is None
+
+
+def test_tail_autopsy_report_section(tmp_path):
+    plane = ForensicsPlane(k=4, slo_config=SloConfig(ttft_ms=15.0))
+    for rid, ttft in (("a", 10.0), ("b", 99.0), ("c", 20.0)):
+        plane.observe_finish(STUB, mk_record(rid, ttft=ttft, itl=ttft / 7))
+    from dynamo_tpu.obs.report import report_paths, tail_autopsy
+
+    tail = tail_autopsy([plane.dump()])
+    assert tail["partition_err_max"] <= 0.01
+    m = tail["models"]["m"]
+    assert m["worst_ttft"]["request_id"] == "b"
+    assert m["breaches"] == 2 and m["breach_reasons"] == {"ttft": 2}
+    assert abs(sum(m["phase_mix"].values()) - 1.0) < 0.02
+    # the CLI path: a /debug/requests-shaped file mixes with trace dumps
+    p = tmp_path / "requests.json"
+    p.write_text(json.dumps({"worker_id": 1,
+                             "sources": {"frontend:1": plane.dump()}}))
+    rep = report_paths([str(p)])
+    assert rep["tail"]["models"]["m"]["exemplars"] == 3
+
+
+# --------------------------- worker stamps ------------------------------
+
+
+async def test_mocker_stamps_first_and_finish_frames():
+    """The mocker's forensic stamps (realized overlap from the capacity
+    sim, queue position, step counts) ride exactly the first-token and
+    finish frames — the JAX engine's contract."""
+    from dynamo_tpu.mocker.engine import MockEngine
+
+    eng = MockEngine(mock_args(enable_prefix_caching=True))
+    prompt = list(range(1, 17))  # 4 full blocks at block_size=4
+
+    async def run(rid):
+        outs = []
+        async for out in eng.generate(greedy_request(prompt, 5, rid)):
+            outs.append(out)
+        return outs
+
+    cold = await run("cold")
+    warm = await run("warm")
+    await eng.close()
+    for outs in (cold, warm):
+        stamped = [o for o in outs
+                   if o.metrics and "forensic" in o.metrics]
+        assert len(stamped) == 2          # first token + finish
+        assert stamped[0] is outs[0] and stamped[1] is outs[-1]
+        assert stamped[1].metrics["forensic"]["generated"] == 5
+        assert stamped[1].metrics["forensic"]["queue_pos"] == 0
+    # the cold request computed its prefill (≥1 chunk); the warm one
+    # skipped it entirely off the cache (0 chunks is the right answer)
+    assert cold[-1].metrics["forensic"]["prefill_chunks"] >= 1
+    assert warm[-1].metrics["forensic"]["prefill_chunks"] == 0
+    assert cold[0].metrics["forensic"]["cached_tokens"] == 0
+    # warm request REALIZED the shared prefix from the capacity sim
+    assert warm[0].metrics["forensic"]["cached_tokens"] == 16
+
+
+# --------------------------- timeline coherence -------------------------
+
+
+async def test_migration_two_dispatch_hops_one_record():
+    """A worker death mid-stream replays on the survivor: the ONE
+    record carries both dispatched hops (attempt 1 and 2), one finish,
+    and the worker ids of both attempts."""
+    rt = await fresh_runtime().start()
+    dying = await MockerWorker(rt, mock_args(fail_after_tokens=3),
+                               component="backend").start()
+    healthy = await MockerWorker(rt, mock_args(),
+                                 component="backend").start()
+    client = await (rt.namespace("dynamo").component("backend")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+    op = MigrationOperator(client, migration_limit=2)
+    try:
+        migrated = None
+        for i in range(8):
+            tr = RequestTracker(request_id=f"mig-{i}", model="m")
+            req = greedy_request(list(range(8)), 10, f"mig-{i}")
+            toks = []
+            async for out in op.generate(req, tracker=tr):
+                toks.extend(out.token_ids)
+            rec = tr.finish(finish_reason="stop")
+            assert len(toks) == 10  # migration is client-invisible
+            if rec["request"].get("migrations"):
+                migrated = (tr, rec)
+                break
+        assert migrated is not None, "no request hit the dying worker"
+        tr, rec = migrated
+        dispatched = [h for h in rec["timeline"]["hops"]
+                      if h["hop"] == "dispatched"]
+        assert [h["attempt"] for h in dispatched] == [1, 2]
+        assert dispatched[0]["worker"] == dying.served.instance_id
+        assert dispatched[1]["worker"] == healthy.served.instance_id
+        assert sum(h["hop"] == "finish"
+                   for h in rec["timeline"]["hops"]) == 1
+        assert rec["request"]["outcome"] == "ok"
+        total = rec["request"]["total_time_ms"]
+        assert abs(sum(rec["timeline"]["partition"].values())
+                   - total) <= 0.01 * total
+    finally:
+        await client.close()
+        await dying.close()
+        await healthy.close()
+        await rt.shutdown()
+
+
+async def test_drain_abort_one_coherent_record():
+    """Graceful drain mid-stream: the aborted attempt and its replay
+    stay ONE record — two dispatched hops, full-length stream, ok."""
+    rt = await fresh_runtime().start()
+    # sync lockstep decode (no fused bursts): the stream must still be
+    # mid-flight when the drain deadline expires
+    w1 = await MockerWorker(rt, mock_args(base_step_s=0.005,
+                                          overlap_scheduling=False),
+                            component="backend").start()
+    w2 = await MockerWorker(rt, mock_args(base_step_s=0.005,
+                                          overlap_scheduling=False),
+                            component="backend").start()
+    client = await (rt.namespace("dynamo").component("backend")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+    op = MigrationOperator(client, migration_limit=2)
+    by_id = {w.served.instance_id: w for w in (w1, w2)}
+    try:
+        tr = RequestTracker(request_id="drain-1", model="m")
+        req = greedy_request(list(range(8)), 40, "drain-1")
+        toks = []
+        drained = False
+        async for out in op.generate(req, tracker=tr):
+            toks.extend(out.token_ids)
+            if not drained and len(toks) >= 2:
+                drained = True
+                await by_id[tr.decode_worker_id].drain(0.02)
+        rec = tr.finish(finish_reason="stop")
+        assert len(toks) == 40
+        dispatched = [h for h in rec["timeline"]["hops"]
+                      if h["hop"] == "dispatched"]
+        assert len(dispatched) == 2
+        assert rec["request"]["migrations"] == 1
+        assert rec["request"]["outcome"] == "ok"
+    finally:
+        await client.close()
+        await w1.close()
+        await w2.close()
+        await rt.shutdown()
+
+
+async def test_disagg_timeline_brackets_prefill_and_transfer():
+    """Disagg path through the real frontend pipeline: prefill_open /
+    prefill_done bracket the remote hop, the prefill worker id lands on
+    the hop, and the partition's prefill phase is nonzero."""
+    from dynamo_tpu.disagg.prefill_router import ConditionalDisaggConfig
+    from dynamo_tpu.frontend import ModelManager, ModelWatcher
+
+    rt = await fresh_runtime().start()
+    decode_w = await MockerWorker(rt, mock_args(role="decode"),
+                                  component="backend").start()
+    prefill_w = await MockerWorker(rt, mock_args(role="prefill"),
+                                   component="prefill").start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        rt, manager,
+        disagg_config=ConditionalDisaggConfig(min_effective_isl=8,
+                                              min_effective_ratio=0.0),
+    ).start()
+    try:
+        for _ in range(100):
+            p = manager.get("m")
+            if p is not None and p.prefill is not None:
+                break
+            await asyncio.sleep(0.02)
+        pipeline = manager.get("m")
+        assert pipeline is not None and pipeline.prefill is not None
+        tr = RequestTracker(request_id="d1", model="m", input_tokens=40)
+        req = greedy_request(list(range(40)), 5, "d1")
+        deltas = [d async for d in
+                  pipeline.generate_deltas(req, tracker=tr)]
+        assert sum(d.token_count for d in deltas) == 5
+        rec = tr.finish(finish_reason="stop")
+        kinds = [h["hop"] for h in rec["timeline"]["hops"]]
+        assert "prefill_open" in kinds and "prefill_done" in kinds
+        assert kinds.index("prefill_done") < kinds.index("dispatched")
+        # (mock transfer params carry no instance_id; the JAX disagg
+        # path stamps the prefill worker on the hop)
+        # the PREFILL worker's own forensic stamp rides the
+        # prefill_done hop (prefill_router.py popped it off the
+        # transfer params), not the decode worker's stream
+        done = next(h for h in rec["timeline"]["hops"]
+                    if h["hop"] == "prefill_done")
+        # generated==0: the prefill hop decodes nothing (its first
+        # token rides the transfer params) — same on both engines
+        assert done["generated"] == 0 and done["prefill_chunks"] >= 1
+        assert "cached_tokens" in done
+        part = rec["timeline"]["partition"]
+        assert part["prefill"] > 0.0
+        total = rec["request"]["total_time_ms"]
+        assert abs(sum(part.values()) - total) <= 0.01 * total
+        # queue_ms still ends at the prefill hop (the PR 7 semantics)
+        assert rec["request"]["queue_ms"] <= part["queue"] + 0.01
+    finally:
+        await watcher.close()
+        await prefill_w.close()
+        await decode_w.close()
+        await rt.shutdown()
+
+
+# ----------------- predicted vs realized (router feedback) --------------
+
+
+async def test_predicted_vs_realized_overlap_converges():
+    """2-worker mocker fleet, shared-prefix traffic through the KV
+    router: after the cache warms, the router's predicted overlap is
+    REALIZED by the workers (staleness ratio near 0), the realized
+    histogramed blocks match, and the decision attribution (scores,
+    best rejected, regret) rides the routed hop."""
+    from dynamo_tpu.router.kv_router import KvRouter
+
+    rt = await fresh_runtime().start()
+    workers = [
+        await MockerWorker(rt, mock_args(), component="mocker").start()
+        for _ in range(2)
+    ]
+    client = await (rt.namespace("dynamo").component("mocker")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+    router = await KvRouter(rt, "dynamo", "mocker", client,
+                            block_size=4, replica_sync=False).start()
+    op = MigrationOperator(client, migration_limit=0, route=router)
+    prompt = list(range(100, 132))  # 8 full blocks, shared by everyone
+    trackers = []
+    try:
+        for i in range(6):
+            tr = RequestTracker(request_id=f"warm-{i}", model="m")
+            req = greedy_request(prompt, 4, f"warm-{i}")
+            async for _out in op.generate(req, tracker=tr):
+                pass
+            tr.finish(finish_reason="stop")
+            trackers.append(tr)
+            await asyncio.sleep(0.15)  # let KV events reach the indexer
+        stats = router.overlap_stats()
+        assert stats["decisions"] == 6
+        # warm requests predicted AND realized the shared prefix
+        assert stats["predicted_blocks"] >= 8
+        assert stats["realized_blocks"] >= 8
+        assert stats["staleness_ratio"] is not None
+        assert stats["staleness_ratio"] <= 0.2, stats
+        last = trackers[-1]
+        routed = next(h for h in last.hops if h["hop"] == "routed")
+        assert routed["predicted_overlap_blocks"] == 8
+        assert "scores" in routed and "best_rejected" in routed
+        assert routed["regret"] >= 0.0
+        stamp = next(h for h in last.hops if h["hop"] == "worker_stamp")
+        # realized == predicted on the warm path: the index is accurate
+        assert stamp["cached_tokens"] == 32
+        # the new router gauges render on the process registry (what a
+        # fleet scrape picks up via _parse_headline_metrics)
+        scrape = rt.metrics.render()
+        assert b"dynamo_router_overlap_staleness_ratio" in scrape
+        assert b"dynamo_router_overlap_realized_blocks" in scrape
+        assert b"dynamo_router_overlap_best_rejected_blocks" in scrape
+        assert b"dynamo_router_decision_regret_blocks" in scrape
+    finally:
+        await router.close()
+        await client.close()
+        for w in workers:
+            await w.close()
+        await rt.shutdown()
+
+
+# --------------------------- /debug/requests e2e ------------------------
+
+
+async def test_debug_requests_breach_pinned_on_live_fleet():
+    """Acceptance e2e: a live mocker fleet with an impossible TTFT
+    target — /debug/requests is token-gated, returns the breach's
+    pinned timeline + span snapshot, and the fleet snapshot folds the
+    tail + router block in."""
+    from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+    from dynamo_tpu.obs import fleet as obs_fleet
+
+    rt = await fresh_runtime(system_port=-1, admin_token=TOKEN).start()
+    worker = await MockerWorker(rt, mock_args(base_step_s=0.002),
+                                component="backend").start()
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    tracer = obs.Tracer(ring=4096).install()
+    service = await HttpService(
+        rt, manager, host="127.0.0.1", port=0,
+        slo=SloConfig(ttft_ms=0.01),   # impossible: every request breaches
+    ).start()
+    port = service._runner.addresses[0][1]
+    try:
+        for _ in range(100):
+            if manager.get("m"):
+                break
+            await asyncio.sleep(0.02)
+        body = {"model": "m",
+                "messages": [{"role": "user", "content": "hello there"}],
+                "max_tokens": 6, "ignore_eos": True}
+        base = f"http://127.0.0.1:{port}"
+        dbg = f"http://{rt.system_address}/debug/requests"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+            # token gate: 401 without, payload with
+            async with s.get(dbg) as r:
+                assert r.status == 401
+            async with s.get(
+                    dbg, headers={"X-Dyn-Admin-Token": TOKEN}) as r:
+                assert r.status == 200
+                dump = await r.json()
+        src = dump["sources"][f"frontend:{service._fleet_instance_id}"]
+        assert src["schema"] == "dynamo.forensics.v1"
+        assert src["breaches"] >= 1
+        breach = src["models"]["m"][0]["breach"][0]
+        assert breach["breach"] == "ttft"
+        hops = [h["hop"] for h in breach["record"]["timeline"]["hops"]]
+        assert "dispatched" in hops and "first_token" in hops
+        part = breach["partition"]
+        assert abs(sum(part.values()) - breach["e2e_ms"]) \
+            <= 0.01 * breach["e2e_ms"]
+        # the breach pinned its span snapshot by trace_id (tracing on:
+        # the frontend minted a trace_id and the worker's spans joined)
+        assert breach.get("spans"), breach.get("spans")
+        assert any(s["kind"] == "worker_request" for s in breach["spans"])
+        # worker stamps flowed back through the live stream
+        assert breach["record"]["timeline"]["worker"]["generated"] == 6
+        # fleet snapshot folds the forensics + tail summary in
+        snap = await obs_fleet.snapshot(rt.discovery, token=TOKEN)
+        fe = next(f for f in snap.frontends
+                  if f.worker_id == service._fleet_instance_id)
+        assert fe.tail is not None and fe.tail["breaches"] >= 1
+        assert snap.summary["tail"]["breaches"] >= 1
+    finally:
+        tracer.uninstall()
+        await service.close()
+        await watcher.close()
+        await worker.close()
+        await rt.shutdown()
+    assert not rt.forensics_sources  # close() unregistered the source

@@ -23,7 +23,7 @@ from dynamo_tpu.lint.core import canon_path
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-ALL_RULES = tuple(f"DYN{i:03d}" for i in range(1, 12))
+ALL_RULES = tuple(f"DYN{i:03d}" for i in range(1, 13))
 
 
 def run(src, path="dynamo_tpu/engine/snippet.py", rules=None):
@@ -263,6 +263,14 @@ def test_registries_are_canonical():
     assert set(obs.STEP_PHASES) <= obs.SPAN_KINDS
     assert COMPILE_KIND in obs.SPAN_KINDS
     assert "engine.step" in chaos.SEAMS
+    # forensics hop taxonomy (obs/forensics.py, DYN012's registry)
+    from dynamo_tpu.obs.forensics import PHASES
+
+    assert {"received", "routed", "dispatched", "prefill_open",
+            "prefill_done", "worker_stamp", "first_token",
+            "decode_stall", "finish"} == set(obs.HOP_KINDS)
+    assert set(PHASES) == {"queue", "route", "prefill", "transfer",
+                           "decode", "stall"}
 
 
 # --------------------------- DYN007: inline markers ---------------------
@@ -423,6 +431,35 @@ def test_dyn011_suppression_with_reason():
            "    # dynlint: disable=DYN011 host-side numpy descriptor\n"
            "    return np.asarray(a['temps'])\n")
     assert lint.run_source(src, "dynamo_tpu/engine/core.py") == []
+
+
+# ------------------- DYN012: forensics hop registry ---------------------
+
+def test_dyn012_hop_literals():
+    bad = run("""
+        def on_dispatch(self, iid):
+            self.hop("dispatchd", worker=iid)
+            tracker.hop("prefil_open")
+        """, path="dynamo_tpu/frontend/request_trace.py")
+    assert rule_ids(bad) == ["DYN012"]
+    assert len(bad) == 2
+    good = run("""
+        def on_dispatch(self, iid):
+            self.hop("dispatched", worker=iid)
+            tracker.hop("prefill_open", at=t0)
+            tracker.hop(kind_variable)  # non-literal: not judged
+        """, path="dynamo_tpu/frontend/request_trace.py")
+    assert good == []
+
+
+def test_dyn012_applies_in_tests_and_suppresses():
+    bad = run("""
+        tr.hop("first_tokn")
+        """, path="tests/test_forensics.py")
+    assert rule_ids(bad) == ["DYN012"]
+    src = ('tr.hop("first_tokn")  '
+           "# dynlint: disable=DYN012 the negative-test literal\n")
+    assert lint.run_source(src, "tests/test_forensics.py") == []
 
 
 # --------------------------- suppressions -------------------------------

@@ -384,8 +384,13 @@ async def test_dp_ranks_are_distinct_routing_targets():
     wid = w.served.instance_id
     comp = rt.namespace("dynamo").component("mocker")
     client = await comp.endpoint("generate").client().start()
+    # seeded tie-break: cold requests are exact cost TIES between the
+    # two ranks, and the default OS-entropy seed made "all 6 land on
+    # one rank" a ~3% full-run flake — a fixed seed keeps the spread
+    # assertion deterministic (KvRouterConfig documents test seeding)
     router = await KvRouter(rt, "dynamo", "mocker", client,
-                            block_size=4).start()
+                            block_size=4,
+                            config=KvRouterConfig(seed=7)).start()
     await client.wait_for_instances()
     # both ranks visible as targets (load metrics carry per-rank state)
     for _ in range(200):
