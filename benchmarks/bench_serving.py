@@ -48,13 +48,14 @@ SIM_PEAK_TFLOPS = 50.0
 SIM_PEAK_HBM_GBPS = 100.0
 
 
-def engine_args(role="both", overlap=True, fused=8):
+def engine_args(role="both", overlap=True, fused=8, ledger=None):
     return MockEngineArgs(model_name="bench", block_size=BLOCK,
                           num_blocks=8192, speedup_ratio=1.0, role=role,
                           peak_tflops=SIM_PEAK_TFLOPS,
                           peak_hbm_gbps=SIM_PEAK_HBM_GBPS,
                           overlap_scheduling=overlap,
-                          decode_fused_steps=fused)
+                          decode_fused_steps=fused,
+                          kv_ledger=ledger)
 
 
 class RunTrace:
@@ -228,6 +229,24 @@ async def collect_fleet(rt, workers, peaks: dict):
     return out
 
 
+def collect_kv_ledger(workers):
+    """`kv_ledger` entry for the bench JSON `fleet` block: run each
+    worker's ON-DEMAND ledger audit (the /debug/kv path) after the
+    replay and reduce with the fleet's own rollup — a clean bench run
+    must reconcile exactly (violations_total == 0), which is the
+    acceptance gate --kv-ledger ab asserts."""
+    from dynamo_tpu.obs.fleet import reduce_kv_ledgers
+
+    rollup = reduce_kv_ledgers([w.kv_debug() for w in workers])
+    if rollup is None:
+        return {}
+    return {"kv_ledger": {
+        "violations_total": rollup["violations_total"],
+        "violations": rollup["violations"],
+        "occupancy": rollup["occupancy"],
+    }}
+
+
 async def collect_roofline(rt):
     """Scrape the run's worker gauges (one load-loop tick after the
     replay) into the bench JSON's roofline block: per-phase MFU/MBU and
@@ -256,10 +275,11 @@ async def collect_roofline(rt):
 
 
 async def bench_agg(rows, n_workers, args, overlap=True, label="agg",
-                    forensics=True):
+                    forensics=True, ledger=None):
     rt = await fresh_runtime().start()
     workers = [
-        await MockerWorker(rt, engine_args(overlap=overlap),
+        await MockerWorker(rt, engine_args(overlap=overlap,
+                                           ledger=ledger),
                            component="backend").start()
         for _ in range(n_workers)
     ]
@@ -280,6 +300,7 @@ async def bench_agg(rows, n_workers, args, overlap=True, label="agg",
         roofline = await collect_roofline(rt)
     gap = rtrace.gap()
     fleet = await collect_fleet(rt, workers, peaks)
+    fleet.update(collect_kv_ledger(workers))
     tail = cap.tail_block(rt)
     await client.close()
     for w in workers:
@@ -289,15 +310,17 @@ async def bench_agg(rows, n_workers, args, overlap=True, label="agg",
 
 
 async def bench_disagg(rows, n_prefill, n_decode, args, overlap=True,
-                       label="disagg", forensics=True):
+                       label="disagg", forensics=True, ledger=None):
     rt = await fresh_runtime().start()
     prefills = [
-        await MockerWorker(rt, engine_args("prefill", overlap=overlap),
+        await MockerWorker(rt, engine_args("prefill", overlap=overlap,
+                                           ledger=ledger),
                            component="prefill").start()
         for _ in range(n_prefill)
     ]
     decodes = [
-        await MockerWorker(rt, engine_args("decode", overlap=overlap),
+        await MockerWorker(rt, engine_args("decode", overlap=overlap,
+                                           ledger=ledger),
                            component="backend").start()
         for _ in range(n_decode)
     ]
@@ -342,6 +365,7 @@ async def bench_disagg(rows, n_prefill, n_decode, args, overlap=True,
         roofline = await collect_roofline(rt)
     gap = rtrace.gap()
     fleet = await collect_fleet(rt, prefills + decodes, peaks)
+    fleet.update(collect_kv_ledger(prefills + decodes))
     tail = cap.tail_block(rt)
     await orch.close()
     await pclient.close()
@@ -393,6 +417,17 @@ async def main():
                         "token streams, and print a forensics_ab line "
                         "with the measured throughput overhead "
                         "(target <1%%)")
+    p.add_argument("--kv-ledger", choices=["on", "off", "ab"],
+                   default="on",
+                   help="KV block-lifecycle ledger + auditor "
+                        "(obs/kv_ledger.py): on (default — every JSON "
+                        "line's `fleet` block carries the post-run "
+                        "audit rollup, which must reconcile clean), "
+                        "off, or 'ab' — run the agg topology with the "
+                        "plane off then on over the SAME trace, assert "
+                        "byte-identical token streams AND a clean "
+                        "audit, and print a kv_ledger_ab line with the "
+                        "measured throughput overhead (target <1%%)")
     args = p.parse_args()
 
     rows = synthesize(args.requests, rate_rps=args.rate,
@@ -439,6 +474,48 @@ async def main():
             **({"tail": tail} if tail is not None else {}),
         })
 
+    if args.kv_ledger == "ab":
+        # A/B smoke: the SAME trace against the agg topology with the
+        # ledger off then on.  The ledger is pure accounting — the
+        # token streams must be byte-identical (hard assert), the ON
+        # run's post-run audit must reconcile exactly (0 violations),
+        # and the throughput delta is the always-on overhead (target
+        # <1%; open-loop arrivals keep the rate comparison stable)
+        await bench_agg(rows[: min(len(rows), 8)], args.workers, args,
+                        label="agg-kvledger-warmup", ledger=True)
+        off, *_rest_off, cap_off = await bench_agg(
+            rows, args.workers, args, label="agg-kvledger-off",
+            ledger=False)
+        on, _roof, fleet_on, _gap, _path, _tail, cap_on = await bench_agg(
+            rows, args.workers, args, label="agg-kvledger-on",
+            ledger=True)
+        s_off = off.summary(slo_ttft_s, slo_itl_s)
+        s_on = on.summary(slo_ttft_s, slo_itl_s)
+        tps_off = s_off["output_tokens_per_s"]
+        tps_on = s_on["output_tokens_per_s"]
+        overhead = (1.0 - tps_on / tps_off) if tps_off else 0.0
+        identical = cap_off.streams == cap_on.streams
+        kvl = fleet_on.get("kv_ledger") or {}
+        print(json.dumps({
+            "config": "kv_ledger_ab",
+            "streams_identical": identical,
+            "tok_s_off": tps_off, "tok_s_on": tps_on,
+            "overhead_frac": round(overhead, 4),
+            "overhead_target_frac": 0.01,
+            "overhead_ok": overhead < 0.01,
+            "violations_total": kvl.get("violations_total"),
+            "kv_ledger": kvl,
+        }))
+        if not identical:
+            raise SystemExit(
+                "kv ledger changed the token streams — it must be pure "
+                "accounting")
+        if kvl.get("violations_total", 0) != 0:
+            raise SystemExit(
+                f"kv ledger audit did not reconcile clean: "
+                f"{kvl.get('violations')}")
+        return
+
     if args.forensics == "ab":
         # A/B smoke: the SAME trace against the agg topology with the
         # plane off then on.  The plane is pure observation — the token
@@ -479,6 +556,8 @@ async def main():
     modes = {"on": [(True, "overlap")], "off": [(False, "sync")],
              "ab": [(False, "sync"), (True, "overlap")]}[args.overlap]
     forensics_on = args.forensics == "on"
+    # on = follow DYN_KV_LEDGER (default-on); off pins the plane off
+    ledger = None if args.kv_ledger == "on" else False
     np_, nd = max(1, args.workers // 2), max(1, args.workers // 2)
     trace_paths = []
     for ov, tag in modes:
@@ -486,14 +565,14 @@ async def main():
         label = f"agg-{args.workers}w{suffix}"
         agg, roof, fleet, gap, path, tail, _cap = await bench_agg(
             rows, args.workers, args, overlap=ov, label=label,
-            forensics=forensics_on)
+            forensics=forensics_on, ledger=ledger)
         trace_paths.append(path)
         print(line(label, agg.summary(slo_ttft_s, slo_itl_s), roof,
                    fleet, gap, tail))
         label = f"disagg-{np_}p{nd}d{suffix}"
         dis, roof, fleet, gap, path, tail, _cap = await bench_disagg(
             rows, np_, nd, args, overlap=ov, label=label,
-            forensics=forensics_on)
+            forensics=forensics_on, ledger=ledger)
         trace_paths.append(path)
         print(line(label, dis.summary(slo_ttft_s, slo_itl_s), roof,
                    fleet, gap, tail))

@@ -57,6 +57,11 @@ Seam registry (name — wired at — supported actions):
   engine.step              JaxEngine._sched_step / MockEngine._step,
                            per scheduler step (fail = crash on step N,
                            wedge = stop stepping)
+  engine.kv_account        BlockAllocator free/allocate, per violation
+                           class (drop = seed the named accounting
+                           fault: key carries leak / double_free /
+                           orphan / refcount_drift — the kv-ledger
+                           auditor must catch each, obs/kv_ledger.py)
 """
 
 from __future__ import annotations
@@ -93,6 +98,7 @@ SEAMS = frozenset({
     "disagg.pull.chunk",
     "kvbm.remote_pull",
     "engine.step",
+    "engine.kv_account",
 })
 
 # how long a "wedge" blocks when no delay_s is given: effectively

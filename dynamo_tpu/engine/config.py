@@ -43,6 +43,11 @@ class EngineConfig:
     # bf16 -> int8 at a fixed budget yields ~2x blocks instead of the
     # same block count at half the memory.  0 keeps num_blocks as given.
     kv_hbm_gb: float = 0.0
+    # KV block-lifecycle ledger + invariant auditor (obs/kv_ledger.py):
+    # None = follow DYN_KV_LEDGER (always-on by default, "0" disables);
+    # True/False pins the plane per engine — bench_serving's
+    # --kv-ledger ab uses this to A/B the overhead in one invocation.
+    kv_ledger: Optional[bool] = None
 
     # batching
     max_num_seqs: int = 8

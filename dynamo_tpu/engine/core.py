@@ -261,8 +261,19 @@ class JaxEngine:
             config.num_blocks = blocks_for_hbm_budget(
                 self.family, self.model_cfg, config.block_size,
                 self.kv_dtype, int(config.kv_hbm_gb * 1e9))
+        # KV block-lifecycle ledger (obs/kv_ledger.py): an independent
+        # set of books recorded at the allocator's own mutation sites,
+        # reconciled by the invariant auditor on request finish / idle
+        # tick / on demand (/debug/kv).  None when DYN_KV_LEDGER=0 (or
+        # config.kv_ledger=False) — every hook is then one pointer
+        # compare, the obs-plane zero-cost-off contract.
+        from ..obs.kv_ledger import KvLedger, ledger_enabled
+
+        self.kv_ledger: Optional[KvLedger] = (
+            KvLedger() if ledger_enabled(config.kv_ledger) else None)
         self.allocator = BlockAllocator(
-            config.num_blocks, config.enable_prefix_caching
+            config.num_blocks, config.enable_prefix_caching,
+            ledger=self.kv_ledger,
         )
         # KVBM tiers: router-visible events for ALL tiers are netted through
         # the consolidator, so a block offloaded to G2 survives G1 eviction
@@ -1124,6 +1135,37 @@ class JaxEngine:
             out.update(self.kvbm.occupancy())
         return out
 
+    # -- KV ledger audit (obs/kv_ledger.py) --------------------------------
+    def _audit_ledger_locked(self, where: str = "step") -> dict:
+        """One reconciliation sweep: the ledger's books vs the
+        allocator's free-list/refcounts, the scheduler's live slot
+        view, and the KVBM pool manifests.  Caller holds _step_lock
+        (or IS the step)."""
+        led = self.kv_ledger
+        if led is None:
+            return {}
+        live = [self._seq_id(s) for s in self._slots if s is not None]
+        with self._qlock:
+            live += [self._seq_id(s) for s in self.waiting]
+        parked = [p.seq_id for p in self._parked.values()]
+        viol = led.audit_allocator(self.allocator, live, parked)
+        viol += led.audit_kvbm(self.kvbm)
+        return led.finish_audit(viol, where=where)
+
+    def _audit_ledger(self, where: str = "on_demand") -> dict:
+        with self._step_lock:
+            if self._closed:
+                return {}
+            return self._audit_ledger_locked(where)
+
+    async def audit_kv(self) -> dict:
+        """On-demand reconciliation (the /debug/kv handler's entry
+        point); safe on an idle engine — takes the step lock off the
+        event loop."""
+        if self.kv_ledger is None:
+            return {}
+        return await asyncio.to_thread(self._audit_ledger)
+
     @property
     def spec_enabled(self) -> bool:
         """Speculative decoding actually active: the config asked for it
@@ -1228,6 +1270,12 @@ class JaxEngine:
         if want_pull:
             slot.pulling = True
             slot.admitted = asyncio.Event()
+        if self.kv_ledger is not None:
+            # ledger tape entries for this sequence join the request's
+            # distributed trace (frontend-minted traceparent annotation)
+            self.kv_ledger.bind_seq(
+                request.request_id,
+                obs.trace_id_from_annotations(request.annotations))
         with self._qlock:
             slot.queue_pos = len(self.waiting)
             self.waiting.append(slot)
@@ -1299,6 +1347,11 @@ class JaxEngine:
         removed = list(getattr(res, "removed", []))
         if not (stored or removed):
             return
+        if tier != "g1" and self.kv_ledger is not None:
+            # KVBM tier membership for the ledger auditor (pre-netting:
+            # the ledger reconciles per-tier against the pool manifests;
+            # g1 transitions are recorded inside the allocator itself)
+            self.kv_ledger.tier_batch(stored, removed, tier)
         # G1 evictions of blocks that were offloaded must not drop the G2/G3
         # copy — the consolidator handles the netting; the pools themselves
         # only drop on their own capacity pressure.
@@ -1606,6 +1659,8 @@ class JaxEngine:
         def release():
             parked = self._parked.pop(request_id, None)
             if parked is not None:
+                if self.kv_ledger is not None:
+                    self.kv_ledger.unpark(parked.seq_id)
                 self._emit_events(self.allocator.free(parked.seq_id))
 
         await self._call_on_scheduler(release)
@@ -1616,6 +1671,8 @@ class JaxEngine:
                     if now > p.expires_t]:
             logger.warning("parked KV for %s expired unpulled", rid)
             parked = self._parked.pop(rid)
+            if self.kv_ledger is not None:
+                self.kv_ledger.unpark(parked.seq_id)
             self._emit_events(self.allocator.free(parked.seq_id))
 
     # -- scheduler loop ---------------------------------------------------
@@ -1629,14 +1686,26 @@ class JaxEngine:
                 self._reap_parked()
                 # a slot mid-pull has no step work of its own (its chunk
                 # injects arrive as sched_calls, which set _wake): don't
-                # hot-spin the step loop on its behalf
-                busy = (any(s is not None and not s.pulling
+                # hot-spin the step loop on its behalf — EXCEPT when its
+                # cancellation is pending, which needs one step to reap
+                # it (_process_cancellations); without that carve-out a
+                # request cancelled mid-pull on an otherwise idle worker
+                # held its KV blocks until unrelated traffic arrived
+                busy = (any(s is not None
+                            and (not s.pulling or s.cancel_requested)
                             for s in self._slots)
                         or bool(self._inflight))
                 if not busy and not self.waiting:
                     self._wake.clear()
                     if self._sched_calls:
                         continue
+                    if self.kv_ledger is not None \
+                            and self.kv_ledger.audit_due(5.0):
+                        # idle-tick reconciliation: an idle worker's
+                        # books still get swept (leaks hide best in
+                        # caches nobody is touching)
+                        await asyncio.to_thread(self._audit_ledger,
+                                                "idle")
                     if self._parked:
                         # wake periodically so the parked-KV TTL reaper runs
                         # even on an otherwise idle worker
@@ -1715,6 +1784,13 @@ class JaxEngine:
                 # no dispatchable decode work: flush the pipeline tail so
                 # trailing tokens/finishes are delivered promptly
                 self._drain_inflight()
+            led = self.kv_ledger
+            if led is not None and led.audit_due():
+                # reconciliation sweep on the finish cadence (a request
+                # freed its blocks since the last audit) — the books are
+                # checked while the leak is one request old, not one
+                # incident old
+                self._audit_ledger_locked("step")
             if t_step:  # attrs are only worth computing when tracing
                 obs.end("step", t_step, track=self._obs_track,
                         active=sum(1 for s in self._slots
@@ -2679,6 +2755,10 @@ class JaxEngine:
             prompt_len=slot.ctx_len,
             expires_t=time.monotonic() + self.parked_ttl_s,
         )
+        if self.kv_ledger is not None:
+            # attribution: this sequence's blocks are now
+            # pinned-by-transfer, awaiting the decode side's pull
+            self.kv_ledger.park(seq_id)
         slot.finished = True
         if slot.index >= 0:
             self._slots[slot.index] = None

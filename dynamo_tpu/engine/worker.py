@@ -447,9 +447,32 @@ class JaxEngineWorker:
         # fleet introspection: this worker's live state on /debug/state
         self._debug_source_name = f"worker:{instance_id}"
         rt.register_debug_source(self._debug_source_name, self.debug_state)
+        # KV-accounting plane: the block-lifecycle ledger's attribution
+        # + an on-demand audit on /debug/kv (obs/kv_ledger.py)
+        self._kv_source_name = f"kv:{instance_id}"
+        rt.register_kv_source(self._kv_source_name, self.kv_debug)
         logger.info("jax engine worker %d serving %s (tp=%d)",
                     instance_id, self.config.served_name, self.config.tp)
         return self
+
+    async def kv_debug(self) -> dict:
+        """/debug/kv source: the ledger dump with a FRESH reconciliation
+        sweep (audit on demand — the third cadence next to
+        request-finish and idle-tick)."""
+        eng = self.engine
+        base = {
+            "kind": "engine",
+            "instance_id": (self.served.instance_id
+                            if self.served is not None else None),
+            "namespace": self.namespace,
+            "component": self.component,
+        }
+        if eng is None or eng.kv_ledger is None:
+            return {**base, "schema": "dynamo.kv_ledger.v1",
+                    "enabled": False}
+        audit = await eng.audit_kv()
+        return {**base, **eng.kv_ledger.dump(), "audit": audit,
+                "kv": eng.kv_occupancy()}
 
     def debug_state(self) -> dict:
         """Live scheduler/KV/drain snapshot for /debug/state and the
@@ -694,7 +717,8 @@ class JaxEngineWorker:
             export_engine_gauges(
                 m, fw, peak_tflops=self.config.peak_tflops,
                 peak_hbm_gbps=self.config.peak_hbm_gbps,
-                occupancy=self.engine.kv_occupancy())
+                occupancy=self.engine.kv_occupancy(),
+                kv_ledger=self.engine.kv_ledger)
             if steps:
                 try:
                     await self.runtime.event_plane.publish(fpm_subject, {
@@ -759,6 +783,9 @@ class JaxEngineWorker:
         if self._debug_source_name is not None:
             self.runtime.unregister_debug_source(self._debug_source_name)
             self._debug_source_name = None
+        if getattr(self, "_kv_source_name", None) is not None:
+            self.runtime.unregister_kv_source(self._kv_source_name)
+            self._kv_source_name = None
         if getattr(self, "_broker_id", None) is not None:
             from ..disagg import broker
 

@@ -94,6 +94,18 @@ class TieredKvManager:
                 pass  # shared dir raced a sweep; next tick reads it
         return out
 
+    def manifest(self) -> dict:
+        """Per-tier resident hash sets — the pool ground truth the
+        kv-ledger auditor (obs/kv_ledger.py) reconciles its `stage`/
+        `tier_evict` books against.  G4 is deliberately absent: the
+        shared object store is mutated by every worker's TTL sweeps, so
+        a per-worker audit of it would report other workers' legitimate
+        activity as violations."""
+        out = {"g2": set(self.g2.keys())}
+        if self.g3 is not None:
+            out["g3"] = set(self.g3.keys())
+        return out
+
     def _mark_dropped(self, h: int) -> None:
         self._dropped[h] = None
         self._dropped.move_to_end(h)

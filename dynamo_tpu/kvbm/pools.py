@@ -96,6 +96,11 @@ class HostBlockPool:
             self._blocks.move_to_end(h)
         return blk
 
+    def keys(self) -> List[int]:
+        """Resident hashes (the pool manifest the kv-ledger auditor
+        reconciles against)."""
+        return list(self._blocks)
+
     def drop(self, h: int) -> bool:
         return self._blocks.pop(h, None) is not None
 
@@ -211,6 +216,11 @@ class DiskBlockPool:
             return False
         self._unlink(h)
         return True
+
+    def keys(self) -> List[int]:
+        """Resident hashes (the pool manifest the kv-ledger auditor
+        reconciles against)."""
+        return list(self._order)
 
     def _unlink(self, h: int) -> None:
         try:

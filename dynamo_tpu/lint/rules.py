@@ -1,4 +1,4 @@
-"""dynlint rules DYN001–DYN012: each one encodes a bug this repo really
+"""dynlint rules DYN001–DYN013: each one encodes a bug this repo really
 shipped (the PR it came from is named per rule), turning a
 found-late-by-review-or-live-fleet failure into a permanently-enforced
 invariant.  The README "Static analysis" table is generated from the
@@ -606,3 +606,77 @@ def hop_literals(mod: Module) -> Iterable[Finding]:
                 "phase partition and the tail autopsy join on the "
                 "registered taxonomy; register the kind (and its "
                 "docstring-table row) or fix the typo")
+
+
+# ---------------------------------------------------------------------------
+# DYN013 — allocator/pool book mutation outside the defining module
+# ---------------------------------------------------------------------------
+
+# the ledgered private books: BlockAllocator's refcount/free-list/hash
+# maps, the KVBM pools' manifests, and the mocker sim's hash books —
+# each mutable ONLY inside its defining module, where every transition
+# is mirrored onto the KV ledger (obs/kv_ledger.py)
+_BOOK_ATTRS = {
+    "_block_ref", "_hash_to_block", "_block_hash", "_seq_blocks",
+    "_free", "_lru",            # engine/block_allocator.py
+    "_blocks", "_order",        # kvbm/pools.py
+    "_ref", "_seq_full", "_seq_partial",  # mocker/kv_cache_sim.py
+}
+_BOOK_MODULES = (
+    "dynamo_tpu/engine/block_allocator.py",
+    "dynamo_tpu/kvbm/pools.py",
+    "dynamo_tpu/mocker/kv_cache_sim.py",
+)
+_MUTATORS = {
+    "append", "pop", "popitem", "clear", "insert", "extend", "remove",
+    "update", "setdefault", "move_to_end", "add", "discard",
+}
+
+
+def _book_attr(node: ast.AST):
+    """The `x._book` Attribute inside `node` being written through, if
+    any: the node itself, or the value of a Subscript store target."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in _BOOK_ATTRS:
+        return node
+    return None
+
+
+@register(
+    "DYN013",
+    "allocator/pool book mutated outside its defining module",
+    "kv-ledger plane (obs/kv_ledger.py): the ledger mirrors every "
+    "BlockAllocator/pool/sim book transition at its definition site — a "
+    "mutation anywhere else is invisible to the books and IS the silent "
+    "leak/double-free/orphan class the auditor exists to catch",
+    applies=lambda p: _in_pkg_or_tests(p) and p not in _BOOK_MODULES)
+def book_mutation(mod: Module) -> Iterable[Finding]:
+    def _flag(attr_node: ast.AST, how: str):
+        return mod.finding(
+            "DYN013", attr_node,
+            f"{how} of `{attr_node.attr}` outside its defining module: "
+            "the KV ledger mirrors these books at their definition "
+            "sites only (engine/block_allocator.py, kvbm/pools.py, "
+            "mocker/kv_cache_sim.py) — mutate through the owning "
+            "class's API, or the accounting drifts silently")
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                a = _book_attr(t)
+                if a is not None:
+                    yield _flag(a, "assignment")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = _book_attr(t)
+                if a is not None:
+                    yield _flag(a, "del")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            a = _book_attr(node.func.value)
+            if a is not None:
+                yield _flag(a, f".{node.func.attr}()")

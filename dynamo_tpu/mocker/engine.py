@@ -91,6 +91,13 @@ class MockEngineArgs:
     # in the MDC exactly like the JAX worker, so router/planner tier-1
     # tests cover the 2x-blocks regime without a TPU
     kv_cache_dtype: str = "bf16"
+    # KV block-lifecycle ledger + auditor (obs/kv_ledger.py, mirrors
+    # engine/config.py kv_ledger): None = follow DYN_KV_LEDGER
+    # (always-on by default), True/False pins per engine — the
+    # bench_serving --kv-ledger ab knob.  The mocker feeds the same
+    # KvLedger (hash-keyed) so /debug/kv and the auditor are tier-1
+    # testable CPU-only.
+    kv_ledger: Optional[bool] = None
     # -- simulated device-performance plane (obs satellites) --------------
     # the first dispatch of each program family emits a `compile` FPM
     # record of this duration — the exact record shape the JAX engine's
@@ -158,8 +165,13 @@ class MockEngine:
         from .kv_cache_sim import KvCacheSim
 
         self.args = args
+        from ..obs.kv_ledger import KvLedger, ledger_enabled
+
+        self.kv_ledger = (KvLedger()
+                          if ledger_enabled(args.kv_ledger) else None)
         self.cache = KvCacheSim(args.num_blocks, args.enable_prefix_caching,
-                                kv_cache_dtype=args.kv_cache_dtype)
+                                kv_cache_dtype=args.kv_cache_dtype,
+                                ledger=self.kv_ledger)
         self.publisher = kv_event_publisher
         self.waiting: List[_Seq] = []
         self.running: List[_Seq] = []
@@ -418,6 +430,11 @@ class MockEngine:
         try:
             while not self._closed:
                 if not self.running and not self.waiting:
+                    if self.kv_ledger is not None \
+                            and self.kv_ledger.audit_due(5.0):
+                        # idle-tick reconciliation (the JAX engine's
+                        # idle-branch cadence)
+                        self.audit_kv(where="idle")
                     self._wake.clear()
                     await self._wake.wait()
                     continue
@@ -699,8 +716,24 @@ class MockEngine:
             # (serving=True — the planner's storm diag input)
             self._sim_compile("decode", len(decode_seqs) or 1,
                               serving=True)
+        led = self.kv_ledger
+        if led is not None and led.audit_due():
+            # same finish/idle audit cadence as JaxEngine._sched_step
+            self.audit_kv(where="step")
         obs.end("step", t_step, track=self._obs_track,
                 active=len(self.running), waiting=len(self.waiting))
+
+    def audit_kv(self, where: str = "on_demand") -> dict:
+        """Reconcile the ledger's books against the capacity sim — the
+        JAX engine's audit contract, loop-thread synchronous (the sim
+        has no scheduler thread)."""
+        led = self.kv_ledger
+        if led is None:
+            return {}
+        live = [s.request_id for s in self.running] \
+            + [s.request_id for s in self.waiting]
+        return led.finish_audit(led.audit_sim(self.cache, live),
+                                where=where)
 
     def _forensic(self, seq: _Seq) -> dict:
         """Worker-side forensic stamp (the JAX engine's _forensic

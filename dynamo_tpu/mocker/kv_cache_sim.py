@@ -36,11 +36,17 @@ class CacheStepResult:
 
 class KvCacheSim:
     def __init__(self, num_blocks: int, enable_prefix_caching: bool = True,
-                 kv_cache_dtype: str = "bf16"):
+                 kv_cache_dtype: str = "bf16", ledger=None):
         num_blocks = kv_dtype_capacity_blocks(num_blocks, kv_cache_dtype)
         self.kv_cache_dtype = kv_cache_dtype
         self.num_blocks = num_blocks
         self.enable_prefix_caching = enable_prefix_caching
+        # block-lifecycle ledger (obs/kv_ledger.py), hash-keyed — sim
+        # blocks have no physical identity; partial blocks record as
+        # anonymous per-seq counts.  Same accounting contract as
+        # engine/block_allocator.py: this module is the only one
+        # allowed to mutate the sim's books (dynlint DYN013).
+        self.ledger = ledger
         self.free_blocks = num_blocks
         # hash -> refcount of cached full blocks
         self._ref: Dict[int, int] = {}
@@ -63,6 +69,7 @@ class KvCacheSim:
         return n_new <= self.free_blocks + self.evictable_blocks
 
     def _evict(self, n: int, out: CacheStepResult) -> bool:
+        led = self.ledger
         while n > 0:
             if not self._lru:
                 return False
@@ -70,6 +77,8 @@ class KvCacheSim:
             del self._ref[h]
             self.free_blocks += 1
             out.removed.append(h)
+            if led is not None:
+                led.evict(h, h)
             n -= 1
         return True
 
@@ -107,21 +116,30 @@ class KvCacheSim:
             if not self._evict(n_new - self.free_blocks, out):
                 return None
 
+        led = self.ledger
         # pin the cache hits
         for h in block_hashes[:hit]:
             self._pin(h)
+            if led is not None:
+                led.pin(h, seq_id)
         # allocate + store the remaining full blocks; an eviction hole can
         # leave later blocks still cached — pin those instead of re-storing
         for h in block_hashes[hit:]:
             if h in self._ref:
                 self._pin(h)
+                if led is not None:
+                    led.pin(h, seq_id)
                 continue
             self.free_blocks -= 1
             self._ref[h] = 1
             out.stored.append(h)
+            if led is not None:
+                led.alloc(h, seq_id, h=h)
         # partial blocks are held but unhashed
         n_partial = total_blocks - len(block_hashes)
         self.free_blocks -= n_partial
+        if led is not None and n_partial:
+            led.partial(seq_id, n_partial)
 
         self._seq_full[seq_id] = list(block_hashes)
         self._seq_partial[seq_id] = n_partial
@@ -139,6 +157,7 @@ class KvCacheSim:
         """Decode-step growth: optionally a partial block became full
         (``completed_hash``), optionally a new partial block is needed."""
         out = CacheStepResult()
+        led = self.ledger
         if completed_hash is not None:
             # the partial block the seq held gains its identity; the physical
             # slot it occupies is unchanged
@@ -150,19 +169,28 @@ class KvCacheSim:
                 # seq's partial slot is returned
                 self._pin(completed_hash)
                 self.free_blocks += 1
+                if led is not None:
+                    led.pin(completed_hash, seq_id)
             else:
                 self._ref[completed_hash] = 1
                 out.stored.append(completed_hash)
+                if led is not None:
+                    led.alloc(completed_hash, seq_id, h=completed_hash)
+            if led is not None:
+                led.partial(seq_id, -1)
         if need_new_block:
             if self.free_blocks < 1 and not self._evict(1, out):
                 return None
             self.free_blocks -= 1
             self._seq_partial[seq_id] += 1
+            if led is not None:
+                led.partial(seq_id, 1)
         return out
 
     def free(self, seq_id: str) -> CacheStepResult:
         """Release a sequence. Full blocks stay cached (LRU); partials drop."""
         out = CacheStepResult()
+        led = self.ledger
         for h in self._seq_full.pop(seq_id, []):
             rc = self._ref.get(h, 1) - 1
             if rc <= 0:
@@ -170,22 +198,36 @@ class KvCacheSim:
                     self._ref[h] = 0
                     self._lru[h] = None
                     self._lru.move_to_end(h)
+                    if led is not None:
+                        led.unpin(h, seq_id)
+                        led.cache(h, seq_id)
                 else:
                     del self._ref[h]
                     self.free_blocks += 1
                     out.removed.append(h)
+                    if led is not None:
+                        led.release(h, seq_id)
             else:
                 self._ref[h] = rc
+                if led is not None:
+                    led.unpin(h, seq_id)
         self.free_blocks += self._seq_partial.pop(seq_id, 0)
+        if led is not None:
+            # seq_freed drops the seq's partial counts and arms the
+            # finish-cadence audit
+            led.seq_freed(seq_id)
         return out
 
     def clear_cached(self) -> List[int]:
         """Drop every unreferenced cached block; active sequences keep
         theirs (ref: clear_kv_blocks endpoint)."""
         removed: List[int] = []
+        led = self.ledger
         while self._lru:
             h, _ = self._lru.popitem(last=False)
             del self._ref[h]
             self.free_blocks += 1
             removed.append(h)
+            if led is not None:
+                led.evict(h, h)
         return removed

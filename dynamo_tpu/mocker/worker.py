@@ -136,7 +136,18 @@ class MockerWorker:
             yield {"cleared_blocks": n}
 
         async def replay_handler(payload, ctx):
-            # per-rank replay rings: the router asks for a specific rank
+            # per-rank replay rings: the router asks for a specific
+            # rank.  A snapshot request WITHOUT a rank (a late
+            # subscriber syncing a just-discovered worker — it cannot
+            # know the ranks yet) answers with every rank's resident
+            # set; the events carry dp_rank, so the router indexes each
+            # rank's blocks under its own target.
+            if (payload or {}).get("snapshot") \
+                    and "dp_rank" not in (payload or {}):
+                for pub in self.publishers:
+                    for ev in pub.snapshot_events():
+                        yield ev
+                return
             r = int((payload or {}).get("dp_rank", 0))
             pub = self.publishers[r % len(self.publishers)]
             async for ev in pub.replay_handler(payload, ctx):
@@ -180,9 +191,48 @@ class MockerWorker:
         # fleet introspection: this worker's live state on /debug/state
         self._debug_source_name = f"worker:{instance_id}"
         rt.register_debug_source(self._debug_source_name, self.debug_state)
+        # KV-accounting plane (obs/kv_ledger.py): same /debug/kv
+        # contract the JAX worker serves, from the simulated ledgers
+        self._kv_source_name = f"kv:{instance_id}"
+        rt.register_kv_source(self._kv_source_name, self.kv_debug)
         logger.info("mocker worker %d serving model %s",
                     instance_id, self.args.model_name)
         return self
+
+    def _merged_ledgers(self):
+        from ..obs.kv_ledger import MergedLedgers
+
+        merged = MergedLedgers(e.kv_ledger
+                               for e in getattr(self, "engines", []))
+        return merged if merged else None
+
+    def kv_debug(self) -> dict:
+        """/debug/kv source (the JAX worker's contract, dp-rank-merged):
+        attribution + violation totals over every rank's ledger, a
+        fresh on-demand audit per rank, and rank 0's full dump (tape
+        tail included)."""
+        base = {
+            "kind": "mocker",
+            "instance_id": (self.served.instance_id
+                            if self.served is not None else None),
+            "namespace": self.namespace,
+            "component": self.component,
+        }
+        engines = [e for e in getattr(self, "engines", [])
+                   if e.kv_ledger is not None]
+        if not engines:
+            return {**base, "schema": "dynamo.kv_ledger.v1",
+                    "enabled": False}
+        audits = [e.audit_kv(where="on_demand") for e in engines]
+        merged = self._merged_ledgers()
+        out = {**base, **engines[0].kv_ledger.dump(),
+               "audit": audits[0]}
+        if len(engines) > 1:
+            out["attribution"] = merged.attribution()
+            out["violations_total"] = merged.violations_by_kind()
+            out["ranks"] = [{"dp_rank": r, "audit": a}
+                            for r, a in enumerate(audits)]
+        return out
 
     def debug_state(self) -> dict:
         """Live scheduler/KV/drain snapshot for /debug/state — the same
@@ -283,7 +333,8 @@ class MockerWorker:
                 m, fw, peak_tflops=self.args.peak_tflops,
                 peak_hbm_gbps=self.args.peak_hbm_gbps,
                 occupancy={"g1": {"used": used, "free": cap - used,
-                                  "capacity": cap}})
+                                  "capacity": cap}},
+                kv_ledger=self._merged_ledgers())
             if steps:
                 try:
                     await self.runtime.event_plane.publish(fpm_subject, {
@@ -358,6 +409,9 @@ class MockerWorker:
         if self._debug_source_name is not None:
             self.runtime.unregister_debug_source(self._debug_source_name)
             self._debug_source_name = None
+        if getattr(self, "_kv_source_name", None) is not None:
+            self.runtime.unregister_kv_source(self._kv_source_name)
+            self._kv_source_name = None
         if self._load_task is not None:
             self._load_task.cancel()
         for eng in getattr(self, "engines", []) or (

@@ -73,6 +73,9 @@ class WorkerView:
     # this frontend's /debug/requests forensics dump (tail exemplars;
     # obs/forensics.py) — best-effort, never affects `state`
     tail: Optional[dict] = None
+    # this worker's /debug/kv kv-ledger dump (obs/kv_ledger.py:
+    # attributed occupancy + audit) — best-effort, never affects `state`
+    kv_ledger: Optional[dict] = None
     error: str = ""
 
     def to_dict(self) -> dict:
@@ -83,6 +86,8 @@ class WorkerView:
             "system_addr": self.system_addr, "state": self.state,
             "debug": self.debug, "metrics": self.metrics,
             **({"tail": self.tail} if self.tail is not None else {}),
+            **({"kv_ledger": self.kv_ledger}
+               if self.kv_ledger is not None else {}),
             **({"error": self.error} if self.error else {}),
         }
 
@@ -161,20 +166,24 @@ def _parse_headline_metrics(text: str) -> Dict[str, float]:
 
 async def _scrape_addr(session, addr: str, token: str,
                        timeout_s: float,
-                       want_requests: bool = False
+                       want_requests: bool = False,
+                       want_kv: bool = False
                        ) -> Tuple[Optional[dict],
                                   Optional[Dict[str, float]],
-                                  Optional[dict], str]:
-    """(debug_state, headline_metrics, forensics, error) for one
+                                  Optional[dict], Optional[dict], str]:
+    """(debug_state, headline_metrics, forensics, kv, error) for one
     process; each surface fails independently (partial data beats
     none).  The forensics surface (/debug/requests, obs/forensics.py)
-    is scraped only for frontend-bearing addresses and NEVER affects
-    the worker's live/stale classification — tail exemplars are an
-    autopsy bonus, not a health signal."""
+    is scraped only for frontend-bearing addresses, the KV-accounting
+    surface (/debug/kv, obs/kv_ledger.py) only for worker-bearing
+    ones, and NEITHER affects the live/stale classification — tail
+    exemplars and ledger audits are incident context, not a health
+    signal."""
     headers = {"X-Dyn-Admin-Token": token} if token else {}
     debug: Optional[dict] = None
     metrics: Optional[Dict[str, float]] = None
     forensics: Optional[dict] = None
+    kv: Optional[dict] = None
     errs = []
     try:
         body = await _fetch(session, f"http://{addr}/debug/state", headers,
@@ -196,7 +205,15 @@ async def _scrape_addr(session, addr: str, token: str,
         except Exception:
             logger.debug("forensics scrape of %s failed", addr,
                          exc_info=True)
-    return debug, metrics, forensics, "; ".join(errs)
+    if want_kv:
+        try:
+            body = await _fetch(session, f"http://{addr}/debug/kv",
+                                headers, timeout_s)
+            kv = json.loads(body)
+        except Exception:
+            logger.debug("kv-ledger scrape of %s failed", addr,
+                         exc_info=True)
+    return debug, metrics, forensics, kv, "; ".join(errs)
 
 
 async def snapshot(discovery, namespace: Optional[str] = None,
@@ -236,6 +253,13 @@ async def snapshot(discovery, namespace: Optional[str] = None,
                    or i.metadata.get("kind") == "frontend"
                    for i in insts)
 
+    def _workerish(insts: List[Instance]) -> bool:
+        # any non-frontend instance at the address can carry a KV
+        # ledger (co-located frontend+worker addresses scrape both)
+        return any(i.endpoint != "http"
+                   and i.metadata.get("kind") != "frontend"
+                   for i in insts)
+
     scraped: Dict[str, tuple] = {}
     if by_addr:
         import aiohttp
@@ -243,7 +267,8 @@ async def snapshot(discovery, namespace: Optional[str] = None,
         async with aiohttp.ClientSession() as session:
             results = await asyncio.gather(
                 *(_scrape_addr(session, addr, token, timeout_s,
-                               want_requests=_frontendish(insts))
+                               want_requests=_frontendish(insts),
+                               want_kv=_workerish(insts))
                   for addr, insts in by_addr.items()))
         scraped = dict(zip(by_addr, results))
 
@@ -260,7 +285,7 @@ async def snapshot(discovery, namespace: Optional[str] = None,
         if not addr:
             view.error = "no system_addr advertised (DYN_SYSTEM_PORT off?)"
         else:
-            debug, metrics, forensics, err = scraped[addr]
+            debug, metrics, forensics, kv, err = scraped[addr]
             view.error = err
             view.metrics = metrics or {}
             if forensics is not None:
@@ -271,6 +296,13 @@ async def snapshot(discovery, namespace: Optional[str] = None,
                 # whole tail dump misattributed onto their views
                 srcs = forensics.get("sources") or {}
                 view.tail = next(
+                    (v for k, v in srcs.items()
+                     if k.endswith(f":{inst.instance_id}")), None)
+            if kv is not None:
+                # strict instance match, the same co-location rule:
+                # workers key their kv source "kv:<instance_id>"
+                srcs = kv.get("sources") or {}
+                view.kv_ledger = next(
                     (v for k, v in srcs.items()
                      if k.endswith(f":{inst.instance_id}")), None)
             if debug is not None:
@@ -306,6 +338,8 @@ async def snapshot(discovery, namespace: Optional[str] = None,
         stale_states=[w.debug for w in workers if w.debug is not None
                       and w.state == "stale"],
         unreachable=sum(w.state == "unreachable" for w in workers),
+        kv_states=[w.kv_ledger for w in workers
+                   if w.kv_ledger is not None],
     )
     return FleetSnapshot(ts_unix=time.time(), workers=workers,
                          frontends=frontends, summary=summary)
@@ -325,9 +359,40 @@ def _g1_headroom(state: dict) -> Optional[float]:
     return g1.get("free", 0) / cap
 
 
+def reduce_kv_ledgers(kv_states: List[dict]) -> Optional[dict]:
+    """Fleet rollup of per-worker kv-ledger dumps (obs/kv_ledger.py
+    /debug/kv sources): total violations by kind, per-tier occupancy
+    attributed by lifecycle state, and how many workers reported.
+    Pure — benches feed it worker dumps directly."""
+    kv_states = [s for s in kv_states
+                 if isinstance(s, dict) and s.get("enabled", True)
+                 and s.get("schema") == "dynamo.kv_ledger.v1"]
+    if not kv_states:
+        return None
+    violations: Dict[str, int] = {}
+    occupancy: Dict[str, Dict[str, int]] = {}
+    for s in kv_states:
+        for kind, tiers in (s.get("violations_total") or {}).items():
+            violations[kind] = violations.get(kind, 0) \
+                + sum(int(n) for n in tiers.values())
+        for tier, states_ in (s.get("attribution") or {}).items():
+            dst = occupancy.setdefault(tier, {})
+            for state in ("active", "prefix_cached",
+                          "pinned_by_transfer", "partial"):
+                if state in states_:
+                    dst[state] = dst.get(state, 0) + int(states_[state])
+    return {
+        "workers_reporting": len(kv_states),
+        "violations": violations,
+        "violations_total": sum(violations.values()),
+        "occupancy": occupancy,
+    }
+
+
 def summarize_states(states: List[dict], frontend_states: List[dict] = (),
                      stale: int = 0, unreachable: int = 0,
-                     stale_states: List[dict] = ()) -> dict:
+                     stale_states: List[dict] = (),
+                     kv_states: List[dict] = ()) -> dict:
     """Reduce per-worker /debug/state dicts to the fleet headline:
     imbalance, stragglers, KV headroom, recompile hotspots, drain
     states, goodput spread.  Pure — no I/O — so benches and tests feed
@@ -403,6 +468,11 @@ def summarize_states(states: List[dict], frontend_states: List[dict] = (),
                   "breaches": sum(int(t.get("breaches", 0))
                                   for t in tails)}
                  if tails else None),
+        # KV-accounting rollup (obs/kv_ledger.py /debug/kv dumps):
+        # per-tier occupancy attributed by state + total audit
+        # violations — a nonzero violation count means kv_headroom_min
+        # above cannot be trusted
+        "kv_ledger": reduce_kv_ledgers(list(kv_states)),
     }
 
 
@@ -487,6 +557,14 @@ def export_fleet_gauges(metrics, snap: FleetSnapshot,
                     "(obs/forensics.py)")
     else:
         metrics.remove("dynamo_fleet_tail_breaches")
+    if s.get("kv_ledger") is not None:
+        metrics.set("dynamo_fleet_kv_violations",
+                    float(s["kv_ledger"]["violations_total"]),
+                    "total kv-ledger audit violations across the fleet "
+                    "(obs/kv_ledger.py; nonzero = the KV headroom "
+                    "signals are built on corrupted books)")
+    else:
+        metrics.remove("dynamo_fleet_kv_violations")
     if s.get("goodput") is not None:
         metrics.set("dynamo_fleet_goodput_spread",
                     float(s["goodput"]["spread"]))
@@ -605,6 +683,9 @@ def _human(snap: FleetSnapshot) -> str:
     if s["serving_compile_hotspots"]:
         lines.append(f"  RECOMPILE HOTSPOTS: "
                      f"{s['serving_compile_hotspots']}")
+    kvl = s.get("kv_ledger")
+    if kvl and kvl["violations_total"]:
+        lines.append(f"  KV LEDGER VIOLATIONS: {kvl['violations']}")
     hdr = (f"  {'worker':>20} {'component':>12} {'state':>12} "
            f"{'act':>5} {'kv_used':>16} {'itl_p95_ms':>10} flags")
     lines.append(hdr)

@@ -409,25 +409,90 @@ def tail_autopsy(dumps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+# ---------------------------------------------------------------------------
+# KV accounting (kv-ledger dumps — obs/kv_ledger.py dynamo.kv_ledger.v1)
+# ---------------------------------------------------------------------------
+
+
+def kv_ledger_docs(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The kv-ledger dumps inside one JSON document: a raw
+    KvLedger.dump(), or a /debug/kv response wrapping one dump per
+    registered worker source (and the fleet CLI's --json snapshot,
+    whose worker views carry `kv_ledger` blocks)."""
+    out = []
+    if doc.get("schema") == "dynamo.kv_ledger.v1":
+        out.append(doc)
+    for v in (doc.get("sources") or {}).values():
+        if isinstance(v, dict) and v.get("schema") == "dynamo.kv_ledger.v1":
+            out.append(v)
+    for w in doc.get("workers") or ():
+        v = w.get("kv_ledger") if isinstance(w, dict) else None
+        if isinstance(v, dict) and v.get("schema") == "dynamo.kv_ledger.v1":
+            out.append(v)
+    return out
+
+
+def kv_accounting(dumps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Reduce kv-ledger dumps to the KV-accounting section: total audit
+    violations by kind+tier (with the first few violation details kept
+    verbatim — block id, hash, seq_id are the leak report's lead), the
+    fleet-summed per-tier occupancy attribution, worst fragmentation,
+    and whether every reporting worker's LAST audit reconciled clean."""
+    from .fleet import reduce_kv_ledgers
+
+    dumps = [d for d in dumps if d.get("enabled", True)]
+    rollup = reduce_kv_ledgers(dumps) or {
+        "workers_reporting": 0, "violations": {}, "violations_total": 0,
+        "occupancy": {},
+    }
+    examples: List[Dict[str, Any]] = []
+    clean = True
+    worst_frag = 0.0
+    ops: Dict[str, int] = {}
+    for d in dumps:
+        audit = d.get("audit") or d.get("last_audit") or {}
+        if audit and not audit.get("clean", True):
+            clean = False
+            examples.extend(audit.get("violations", ())[:4])
+        frag = ((d.get("attribution") or {}).get("g1") or {}).get(
+            "fragmentation") or {}
+        worst_frag = max(worst_frag, float(frag.get("dead_frac", 0.0)))
+        for op, n in (d.get("counts") or {}).items():
+            ops[op] = ops.get(op, 0) + int(n)
+    return {
+        **rollup,
+        "reconciled_clean": clean,
+        "violation_examples": examples[:8],
+        "dead_cached_frac_max": round(worst_frag, 4),
+        "ops": ops,
+    }
+
+
 def report_paths(paths: Iterable[str], peak_tflops: float = 0.0,
                  peak_hbm_gbps: float = 0.0) -> Dict[str, Any]:
     """Reduce a mixed set of dumps: Chrome traces feed the gap/roofline
     sections, forensics dumps (/debug/requests or ForensicsPlane.dump
-    files) feed the tail-autopsy section — pass both and the report
-    carries both."""
+    files) feed the tail-autopsy section, and kv-ledger dumps
+    (/debug/kv or fleet --json snapshots) feed the KV-accounting
+    section — pass any mix and the report carries what it finds."""
     events: List[Dict[str, Any]] = []
     tails: List[Dict[str, Any]] = []
+    ledgers: List[Dict[str, Any]] = []
     for path in paths:
         with open(path) as f:
             doc = json.load(f)
         found = forensics_docs(doc)
+        led = kv_ledger_docs(doc)
+        ledgers.extend(led)
         if found:
             tails.extend(found)
-        else:
+        elif not led:
             events.extend(events_of_doc(doc))
     rep = report(events, peak_tflops, peak_hbm_gbps)
     if tails:
         rep["tail"] = tail_autopsy(tails)
+    if ledgers:
+        rep["kv"] = kv_accounting(ledgers)
     return rep
 
 
@@ -438,10 +503,12 @@ def main(argv=None) -> int:
                     "(DYN_TRACE_OUT / bench_serving.py --trace-out); "
                     "forensics dumps (/debug/requests JSON or "
                     "ForensicsPlane.dump files) additionally render "
-                    "the tail-autopsy section.")
+                    "the tail-autopsy section, and kv-ledger dumps "
+                    "(/debug/kv JSON or fleet --json snapshots) the "
+                    "KV-accounting section.")
     p.add_argument("paths", nargs="+",
-                   help="Chrome trace JSON dump(s) and/or "
-                        "dynamo.forensics.v1 dumps")
+                   help="Chrome trace JSON dump(s), dynamo.forensics.v1 "
+                        "dumps, and/or dynamo.kv_ledger.v1 dumps")
     p.add_argument("--indent", type=int, default=2,
                    help="JSON indent (0 = one line)")
     p.add_argument("--peak-tflops", type=float, default=0.0,

@@ -438,13 +438,14 @@ class FpmWindow:
 
 def export_engine_gauges(metrics, fw: FpmWindow, peak_tflops: float = 0.0,
                          peak_hbm_gbps: float = 0.0,
-                         occupancy: Optional[dict] = None) -> None:
+                         occupancy: Optional[dict] = None,
+                         kv_ledger=None) -> None:
     """One shared /metrics gauge surface for BOTH workers' load loops
     (engine/worker.py, mocker/worker.py): the headline FPM aggregates,
-    the per-phase roofline MFU/MBU, and KV occupancy by tier.  A single
-    definition is what keeps the mocker's CPU-only export byte-name-
-    compatible with the JAX worker — the parity the scrape-contract
-    test pins."""
+    the per-phase roofline MFU/MBU, KV occupancy by tier, and the KV
+    ledger's violation counters.  A single definition is what keeps the
+    mocker's CPU-only export byte-name-compatible with the JAX worker —
+    the parity the scrape-contract test pins."""
     metrics.set("dynamo_engine_prefill_mfu", fw.prefill_mfu(peak_tflops))
     metrics.set("dynamo_engine_prefill_queue_depth",
                 fw.prefill_queue_depth())
@@ -477,6 +478,30 @@ def export_engine_gauges(metrics, fw: FpmWindow, peak_tflops: float = 0.0,
             if state in occ:
                 metrics.set(f"dynamo_engine_kv_blocks_{state}",
                             occ[state], tier=tier)
+    if kv_ledger is not None:
+        # block-accounting violations (obs/kv_ledger.py auditor):
+        # monotonic totals per class+tier — any nonzero sample is a
+        # page-worthy capacity-integrity signal, and the zero samples
+        # prove the auditor is actually sweeping
+        for kind, tiers in kv_ledger.violations_by_kind().items():
+            for tier, n in tiers.items():
+                metrics.set("dynamo_kv_ledger_violations_total",
+                            float(n),
+                            "kv-ledger audit violations by class "
+                            "(obs/kv_ledger.py): leak / double-free / "
+                            "orphan / refcount-drift",
+                            kind=kind, tier=tier)
+        # per-tier occupancy attribution by state (active /
+        # prefix_cached / pinned_by_transfer / partial)
+        for tier, states in kv_ledger.attribution().items():
+            for state in ("active", "prefix_cached",
+                          "pinned_by_transfer", "partial"):
+                if state in states:
+                    metrics.set("dynamo_kv_ledger_blocks",
+                                float(states[state]),
+                                "per-tier KV occupancy attributed by "
+                                "lifecycle state (obs/kv_ledger.py)",
+                                tier=tier, state=state)
 
 
 class FpmObserver(FpmWindow):

@@ -9,6 +9,18 @@ per-worker ids so routers can detect gaps; the publisher mirrors recent events
 into a local ring buffer and serves a `kv_events_replay` endpoint so a router
 that missed events (or just started) can recover without a full engine dump.
 
+**Snapshot-on-subscribe** (the ROADMAP item 2 ingestion contract): the
+publisher additionally folds its own netted stream into a resident-set
+mirror, and a replay request carrying ``{"snapshot": true}`` answers
+with the CURRENT resident blocks (grouped per tier, stamped with the
+latest assigned event id) instead of the ring.  This closes the
+late-subscriber staleness the PR 13 live drive measured: a restarted
+router predicts 0 overlap against a fully-warm fleet because no new KV
+events fire on pure cache hits — the warm cache has to be REPLAYED to
+it.  KvRouter requests a snapshot for every newly-discovered worker and
+whenever the ring cannot cover a gap.  The kv-ledger plane
+(obs/kv_ledger.py) audits the same books from the allocator side.
+
 PLHs are 128-bit, which exceeds msgpack's integer range — on the wire they are
 16-byte big-endian `bytes`; in memory they are ints.
 """
@@ -93,6 +105,13 @@ class KvEventPublisher:
         self._ring: deque[KvCacheEvent] = deque(maxlen=ring_size)
         self._out: deque[KvCacheEvent] = deque()
         self._drain_task: Optional[asyncio.Task] = None
+        # resident-set mirror of the netted stream (loop-thread only,
+        # like id assignment): hash -> tier of its latest store.  The
+        # stream is consolidator-netted, so stored fires once when a
+        # block enters its first tier and removed once when it leaves
+        # its last — membership here is exactly "this worker can serve
+        # the block", the snapshot a late subscriber needs.
+        self._resident: Dict[int, str] = {}
 
     def _mk(self, op: str, block_hashes: Sequence[int],
             parent_hash: Optional[int], tier: str) -> KvCacheEvent:
@@ -124,8 +143,12 @@ class KvEventPublisher:
         mutations never interleave on the wire."""
         if removed:
             self._out.append(self._mk("removed", removed, None, tier))
+            for h in removed:
+                self._resident.pop(int(h), None)
         if stored:
             self._out.append(self._mk("stored", stored, parent_hash, tier))
+            for h in stored:
+                self._resident[int(h)] = tier
         self._kick()
 
     def _kick(self) -> None:
@@ -173,6 +196,7 @@ class KvEventPublisher:
 
     async def cleared(self) -> None:
         self._out.append(self._mk("cleared", [], None, "g1"))
+        self._resident.clear()
         self._kick()
         await self._flush()
 
@@ -180,8 +204,33 @@ class KvEventPublisher:
     def replay_since(self, since_event_id: int) -> List[Dict[str, Any]]:
         return [e.to_wire() for e in self._ring if e.event_id >= since_event_id]
 
+    def snapshot_events(self) -> List[Dict[str, Any]]:
+        """The snapshot-on-subscribe payload: the resident set as
+        synthetic `stored` events (one per tier), each stamped with the
+        LATEST assigned event id — applying them then continuing from
+        the live stream is gap-free by construction (loop-thread
+        consistency: ids and the mirror advance together)."""
+        last_id = max(0, self._next_id - 1)
+        by_tier: Dict[str, List[int]] = {}
+        for h, tier in self._resident.items():
+            by_tier.setdefault(tier, []).append(h)
+        return [
+            KvCacheEvent(
+                worker_id=self.worker_id, event_id=last_id, op="stored",
+                block_hashes=hashes, dp_rank=self.dp_rank, tier=tier,
+            ).to_wire()
+            for tier, hashes in sorted(by_tier.items())
+        ]
+
     async def replay_handler(self, payload, ctx):
-        """Endpoint handler: router asks for events >= since_event_id."""
+        """Endpoint handler: events >= since_event_id from the ring —
+        or, with ``snapshot: true``, the current resident set (the
+        warm-cache replay a late subscriber needs when the ring cannot
+        reach back to the worker's birth)."""
+        if payload and payload.get("snapshot"):
+            for wire_ev in self.snapshot_events():
+                yield wire_ev
+            return
         since = int(payload.get("since_event_id", 0)) if payload else 0
         for wire_ev in self.replay_since(since):
             yield wire_ev

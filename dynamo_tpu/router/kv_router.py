@@ -105,6 +105,10 @@ class KvRouter:
         self._known_workers: set[int] = set()
         self._recovering: set[int] = set()   # workers with replay in flight
         self._recover_tasks: set[asyncio.Task] = set()  # strong refs
+        # workers whose warm resident set was replayed to this router
+        # (snapshot-on-subscribe — see _sync_worker); retried next watch
+        # tick on failure
+        self._synced_workers: set[int] = set()
 
     async def start(self) -> "KvRouter":
         self._tasks = [
@@ -184,16 +188,38 @@ class KvRouter:
             if events and events[0].event_id > since:
                 # the worker's replay ring evicted part of the requested
                 # range: blocks stored in the lost events would stay
-                # invisible if we just applied the tail.  Reset this
-                # target's index and rebuild from what the ring still has —
-                # a conservative miss (some resident blocks unindexed, will
-                # reappear on their next stored event) instead of a silent
-                # permanent hole presented as full recovery.
+                # invisible if we just applied the tail.  Ask for the
+                # worker's resident-set SNAPSHOT instead (the
+                # snapshot-on-subscribe surface) — the warm cache in
+                # full, not the ring's recent churn.
                 logger.warning(
                     "replay ring for target %d starts at %d > requested %d; "
-                    "resetting its index to the ring tail",
-                    tid, events[0].event_id, since,
+                    "replacing its index with the worker's resident "
+                    "snapshot", tid, events[0].event_id, since,
                 )
+                events = []
+                async for wire_ev in self._replay_client.generate(
+                    {"snapshot": True, "dp_rank": dp_rank},
+                    instance_id=worker_id,
+                ):
+                    ev = KvCacheEvent.from_wire(wire_ev)
+                    if ev.dp_rank == dp_rank:
+                        events.append(ev)
+                # top-up: live events that raced the snapshot fetch may
+                # already sit in the index (and would be wiped by the
+                # clear below) — re-request the ring tail PAST the
+                # snapshot's stamp and append it, so removals/stores
+                # from the fetch window land after the resident set.
+                # The ring covers this range by construction (the
+                # events are seconds old).
+                snap_id = max((e.event_id for e in events), default=-1)
+                if snap_id >= 0:
+                    async for wire_ev in self._replay_client.generate(
+                        {"since_event_id": snap_id + 1,
+                         "dp_rank": dp_rank},
+                        instance_id=worker_id,
+                    ):
+                        events.append(KvCacheEvent.from_wire(wire_ev))
                 self.indexer.clear_worker(tid)
             for ev in events:
                 if ev.op == "stored":
@@ -210,6 +236,49 @@ class KvRouter:
             self.indexer.remove_worker(tid)
         finally:
             self._recovering.discard(tid)
+
+    async def _sync_worker(self, worker_id: int) -> None:
+        """Snapshot-on-subscribe (ROADMAP item 2's ingestion contract):
+        replay a newly-discovered worker's CURRENT resident blocks into
+        the index.  Without it, a router that subscribed after the fleet
+        warmed predicts 0 overlap forever — pure cache hits fire no new
+        KV events (the PR 13 live-drive staleness finding)."""
+        if self._replay_client is None:
+            self._synced_workers.discard(worker_id)
+            return
+        try:
+            n = 0
+            async for wire_ev in self._replay_client.generate(
+                {"snapshot": True}, instance_id=worker_id,
+            ):
+                ev = KvCacheEvent.from_wire(wire_ev)
+                if ev.op != "stored":
+                    continue
+                tid = self.targets.observe(ev.worker_id, ev.dp_rank)
+                last = self.indexer.last_event_id.get(tid)
+                if last is not None and last > ev.event_id:
+                    # the live stream (and its own gap recovery) ran
+                    # AHEAD of this snapshot while it was in flight:
+                    # applying the older resident set would resurrect
+                    # blocks a newer `removed` event already retired
+                    # (removals fire once — the stale store would stand
+                    # forever).  The ahead view is already complete for
+                    # this target: its first live event triggered the
+                    # replay-from-birth/snapshot recovery path.
+                    continue
+                self.indexer.apply_stored(tid, ev.block_hashes)
+                self.indexer.last_event_id[tid] = max(
+                    ev.event_id, last if last is not None else -1)
+                n += len(ev.block_hashes)
+            if n:
+                logger.info("synced %d resident kv blocks from worker %d "
+                            "(snapshot-on-subscribe)", n, worker_id)
+        except Exception:
+            # retried on the next watch tick (the worker may still be
+            # registering its replay endpoint)
+            self._synced_workers.discard(worker_id)
+            logger.debug("kv snapshot sync of worker %d failed",
+                         worker_id, exc_info=True)
 
     async def _load_loop(self) -> None:
         subject = f"load_metrics.{self.namespace}.{self.component}"
@@ -256,10 +325,21 @@ class KvRouter:
                     continue
                 for gone in self._known_workers - live:
                     logger.info("worker %d gone; purging from KV index", gone)
+                    self._synced_workers.discard(gone)
                     for tid in self.targets.remove_worker(gone):
                         self.indexer.remove_worker(tid)
                         self.sequences.remove_worker(tid)
                         self.states.pop(tid, None)
+                # snapshot-on-subscribe: every live worker this router
+                # has not yet synced gets its warm resident set replayed
+                # (covers both a late-started router against a warm
+                # fleet and a worker that joined after us); failures
+                # un-mark so the next tick retries
+                for w in live - self._synced_workers:
+                    self._synced_workers.add(w)
+                    task = asyncio.ensure_future(self._sync_worker(w))
+                    self._recover_tasks.add(task)
+                    task.add_done_callback(self._recover_tasks.discard)
                 self._known_workers = live
         except asyncio.CancelledError:
             pass

@@ -70,6 +70,11 @@ class DistributedRuntime:
         # debug_sources, kept separate so the heavier per-request
         # payload never rides a plain /debug/state scrape)
         self.forensics_sources: dict = {}
+        # KV-accounting plane (obs/kv_ledger.py): workers register their
+        # ledger-dump callables here; the token-gated /debug/kv route
+        # merges them (an on-demand dump runs a reconciliation sweep,
+        # so it stays off the plain /debug/state scrape path)
+        self.kv_sources: dict = {}
         self.system_address: str = ""
         self._closed = False
 
@@ -99,6 +104,15 @@ class DistributedRuntime:
 
     def unregister_forensics_source(self, name: str) -> None:
         self.forensics_sources.pop(name, None)
+
+    def register_kv_source(self, name: str, fn) -> None:
+        """Register a callable returning a dynamo.kv_ledger.v1 dump
+        dict (on-demand audit included), merged into /debug/kv under
+        `name` (the KV-accounting analogue of register_debug_source)."""
+        self.kv_sources[name] = fn
+
+    def unregister_kv_source(self, name: str) -> None:
+        self.kv_sources.pop(name, None)
 
     async def start(self) -> "DistributedRuntime":
         await self.discovery.start()

@@ -1,6 +1,6 @@
 """Per-process system status server: /health /live /metrics + the
-token-gated admin debug surface /debug/state, /debug/requests and
-/debug/profile.
+token-gated admin debug surface /debug/state, /debug/requests,
+/debug/kv and /debug/profile.
 
 Ref: lib/runtime/src/system_status_server.rs:159-222 for the health
 trio.  The debug surface is the per-process half of the fleet
@@ -174,6 +174,24 @@ class SystemStatusServer:
             ],
         }
 
+    @staticmethod
+    async def _merge_sources(registry: dict, what: str) -> dict:
+        """Collect one registry's source callables (sync or async) into
+        a name->dump dict; a broken source degrades to an error entry
+        instead of killing the whole dump."""
+        sources = {}
+        for name, fn in list(registry.items()):
+            try:
+                v = fn()
+                if inspect.isawaitable(v):
+                    v = await v
+                sources[name] = v
+            except Exception as e:  # a broken source must not kill the dump
+                logger.warning("%s source %s failed", what, name,
+                               exc_info=True)
+                sources[name] = {"error": f"{type(e).__name__}: {e}"}
+        return sources
+
     # -- /debug/requests --------------------------------------------------
     async def _debug_requests(self, request: web.Request) -> web.Response:
         """Tail-latency forensics dump (obs/forensics.py): the retained
@@ -185,22 +203,33 @@ class SystemStatusServer:
         if err is not None:
             return err
         rt = self.runtime
-        sources = {}
-        for name, fn in list(rt.forensics_sources.items()):
-            try:
-                v = fn()
-                if inspect.isawaitable(v):
-                    v = await v
-                sources[name] = v
-            except Exception as e:  # a broken source must not kill the dump
-                logger.warning("forensics source %s failed", name,
-                               exc_info=True)
-                sources[name] = {"error": f"{type(e).__name__}: {e}"}
         body = json.dumps({
             "worker_id": rt.worker_id,
             "pid": os.getpid(),
             "ts_unix": time.time(),
-            "sources": sources,
+            "sources": await self._merge_sources(rt.forensics_sources,
+                                                 "forensics"),
+        }, default=repr)
+        return web.Response(body=body.encode(),
+                            content_type="application/json")
+
+    # -- /debug/kv --------------------------------------------------------
+    async def _debug_kv(self, request: web.Request) -> web.Response:
+        """KV-accounting dump (obs/kv_ledger.py): per registered worker
+        source, the block-lifecycle ledger's attribution (per-tier
+        occupancy by state + fragmentation), violation totals, and a
+        fresh ON-DEMAND reconciliation sweep — which is why the payload
+        gets its own route instead of riding a /debug/state scrape.
+        Token-gated exactly like the other /debug/* surfaces."""
+        err = self._authorize(request)
+        if err is not None:
+            return err
+        rt = self.runtime
+        body = json.dumps({
+            "worker_id": rt.worker_id,
+            "pid": os.getpid(),
+            "ts_unix": time.time(),
+            "sources": await self._merge_sources(rt.kv_sources, "kv"),
         }, default=repr)
         return web.Response(body=body.encode(),
                             content_type="application/json")
@@ -278,6 +307,7 @@ class SystemStatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/debug/state", self._debug_state)
         app.router.add_get("/debug/requests", self._debug_requests)
+        app.router.add_get("/debug/kv", self._debug_kv)
         app.router.add_get("/debug/profile", self._debug_profile)
         app.router.add_post("/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(app)

@@ -62,6 +62,8 @@ def test_bench_help_exits_zero(path):
         assert "--slo-itl-ms" in r.stdout
         # forensics plane A/B hook (obs/forensics.py)
         assert "--forensics" in r.stdout
+        # KV-accounting plane A/B hook (obs/kv_ledger.py)
+        assert "--kv-ledger" in r.stdout
 
 
 def test_bench_serving_json_carries_slo_and_roofline_blocks():
@@ -113,6 +115,34 @@ def test_bench_serving_json_carries_slo_and_roofline_blocks():
         pre = (part["queue"] + part["route"] + part["prefill"]
                + part["transfer"])
         assert abs(pre - tail["p99_ttft_ms"]) <= 0.02 * pre + 0.02
+
+
+def test_bench_serving_kv_ledger_ab_streams_identical_and_clean():
+    """--kv-ledger ab: the always-on accounting plane must be pure
+    observation — byte-identical token streams with it on vs off (hard
+    assert inside the bench) AND a post-run audit that reconciles
+    exactly (0 violations, also a hard assert inside the bench).  The
+    <1% overhead target is a bench-scale number; at smoke scale under
+    suite-parallel CPU contention the rate comparison carries timing
+    noise, so the gate here is a generous sanity bound on top of the
+    identity + reconciliation asserts."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_serving.py"),
+         "--requests", "12", "--rate", "50", "--input-len", "64",
+         "--output-len", "8", "--speedup", "4", "--kv-ledger", "ab"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    (rep,) = [json.loads(line) for line in r.stdout.splitlines()
+              if line.startswith("{")]
+    assert rep["config"] == "kv_ledger_ab"
+    assert rep["streams_identical"] is True
+    assert rep["violations_total"] == 0
+    assert rep["overhead_target_frac"] == 0.01
+    assert rep["overhead_frac"] < 0.5, rep
+    assert rep["kv_ledger"]["occupancy"]["g1"]["prefix_cached"] >= 0
 
 
 def test_bench_serving_forensics_ab_streams_identical():

@@ -463,3 +463,82 @@ async def test_streaming_pull_overlaps_decode_and_bounds_host_memory():
     await src.close()
     await dst.close()
     await agg.close()
+
+
+async def test_stream_pull_external_cancel_propagates(caplog):
+    """ADVICE r5 regression: an external cancellation of the pull task
+    (the generate teardown's pull_task.cancel()) delivered while
+    _stream_pull awaits its in-flight prefetch must PROPAGATE — the
+    cleanup suppresses only the prefetch future's own cancellation.
+    The old `except (CancelledError, Exception): pass` let the
+    metrics/fallback tail keep running after cancel, racing teardown:
+    observable as the local-prefill-fallback path firing for a request
+    the client already abandoned."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.llm import DISAGG_ANNOTATION
+
+    ecfg = dict(model_config=FP32, block_size=4, num_blocks=64,
+                max_blocks_per_seq=16, max_num_seqs=2,
+                prefill_buckets=(8, 16, 32), seed=7)
+    src = JaxEngine(EngineConfig(role="prefill", **ecfg))
+    dst = JaxEngine(EngineConfig(**ecfg))
+
+    prompt = list(range(30, 52))  # 22 tokens -> 6 blocks
+    pref = greedy_req(prompt, 4, "c1")
+    pref.annotations = [DISAGG_ANNOTATION]
+    park_out = None
+    async for out in src.generate(pref):
+        park_out = out
+    params = park_out.kv_transfer_params
+
+    chunk_started = asyncio.Event()
+
+    class HangingSource:
+        async def open(self):
+            from dynamo_tpu.disagg.transfer import make_header
+
+            n_blocks, plen = await src.parked_info("c1")
+            return make_header(plen, src.kv_wire_layout(n_blocks))
+
+        async def chunk(self, b0, n):
+            chunk_started.set()
+            await asyncio.Event().wait()  # hangs until cancelled
+
+        async def close(self):
+            pass
+
+    async def pull_fn(dp):
+        return HangingSource()
+
+    dst.kv_pull_fn = pull_fn
+    dst.config.transfer_chunk_bytes = 1  # multi-chunk spans
+
+    async def consume():
+        dis = greedy_req(prompt, 4, "c1")
+        dis.disaggregated_params = params
+        async for _ in dst.generate(dis):
+            pass
+
+    consumer = asyncio.create_task(consume())
+    await asyncio.wait_for(chunk_started.wait(), 20.0)
+    await asyncio.sleep(0.05)  # the pull parks on the hanging prefetch
+    consumer.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await consumer
+    # let the scheduler reap the cancelled slot and settle
+    for _ in range(100):
+        if dst.allocator.num_free == dst.config.num_blocks - 1:
+            break
+        await asyncio.sleep(0.02)
+    # the cancelled pull never ran its failure/fallback tail
+    assert "local prefill fallback" not in caplog.text
+    assert "pull_blocks" not in dst.metrics
+    # every block the cancelled request held was released — and the
+    # ledger's auditor agrees the books reconcile
+    assert dst.allocator.num_free == dst.config.num_blocks - 1
+    if dst.kv_ledger is not None:
+        report = await dst.audit_kv()
+        assert report["clean"], report
+
+    await src.close()
+    await dst.close()

@@ -1,0 +1,566 @@
+"""KV ledger plane (obs/kv_ledger.py): block-lifecycle accounting,
+the invariant auditor (leak / double-free / orphan / refcount-drift —
+each chaos-provable), per-tier attribution on /debug/kv, fleet folding,
+and the kv_events snapshot-on-subscribe replay (ROADMAP item 2's
+ingestion contract)."""
+
+import asyncio
+import json
+import uuid
+
+import pytest
+
+from dynamo_tpu import chaos
+from dynamo_tpu.engine.block_allocator import BlockAllocator
+from dynamo_tpu.obs.kv_ledger import (
+    KvLedger,
+    LEDGER_OPS,
+    ledger_enabled,
+)
+
+H = lambda i: (0xABC0000 + i) << 64  # 128-bit-ish PLH stand-ins # noqa: E731
+
+
+def kinds_of(violations):
+    return sorted({v["kind"] for v in violations})
+
+
+# ---------------------- allocator-level accounting -----------------------
+
+
+def test_allocator_lifecycle_mirrors_and_reconciles():
+    """The full G1 lifecycle — allocate (miss + prefix hit), commit,
+    append, free-to-cache, trim, clear — keeps the ledger's books in
+    exact agreement with the allocator at every stage (0 violations),
+    with the attribution states tracking the transitions."""
+    led = KvLedger()
+    a = BlockAllocator(16, ledger=led)
+
+    res = a.allocate("s1", [H(1), H(2)], 3)
+    assert res is not None and res.cached_blocks == 0
+    a.commit_block("s1", 0, H(1))
+    a.commit_block("s1", 1, H(2))
+    assert led.audit_allocator(a, live_seqs=["s1"]) == []
+    assert led.attribution()["g1"]["active"] == 3
+
+    grow = a.append_block("s1")
+    assert grow.block_id is not None
+    assert led.audit_allocator(a, live_seqs=["s1"]) == []
+    assert led.attribution()["g1"]["active"] == 4
+
+    # spec-rollback trim releases the grown block
+    a.trim_blocks("s1", 3)
+    assert led.audit_allocator(a, live_seqs=["s1"]) == []
+
+    a.free("s1")
+    assert led.audit_allocator(a, live_seqs=[]) == []
+    att = led.attribution()["g1"]
+    # the two committed blocks stay prefix-cached; the partial freed
+    assert att["active"] == 0 and att["prefix_cached"] == 2
+
+    # prefix reuse pins the cached blocks for a second sequence
+    res2 = a.allocate("s2", [H(1), H(2)], 4)
+    assert res2.cached_blocks == 2
+    assert led.audit_allocator(a, live_seqs=["s2"]) == []
+    assert led.attribution()["g1"]["active"] == 4
+    a.free("s2")
+
+    removed = a.clear_cached()
+    assert len(removed) == 2
+    assert led.audit_allocator(a, live_seqs=[]) == []
+    assert led.attribution()["g1"]["tracked"] == 0
+
+    # the event tape recorded every op class it should have
+    ops = {e[1] for e in led.events}
+    assert {"alloc", "pin", "unpin", "cache", "commit", "evict",
+            "release"} <= ops
+    assert ops <= LEDGER_OPS
+    # and op counts are exported for /debug/kv
+    assert led.dump()["counts"]["alloc"] >= 5
+
+
+def test_capacity_rollback_keeps_books_clean():
+    """An allocate() that fails capacity after pinning its prefix hits
+    must roll the ledger back too (the unpin path) — the books stay
+    clean and the hits return to prefix-cached."""
+    led = KvLedger()
+    a = BlockAllocator(5, ledger=led)  # 4 usable
+    a.allocate("s1", [H(1)], 2)
+    a.commit_block("s1", 0, H(1))
+    a.free("s1")  # H(1) cached
+    # needs 5 blocks against 4 usable: must fail and roll back the pin
+    assert a.allocate("s2", [H(1)], 5) is None
+    assert led.audit_allocator(a, live_seqs=[]) == []
+    assert led.attribution()["g1"]["prefix_cached"] == 1
+
+
+@pytest.mark.parametrize("kind", ["leak", "double_free", "orphan",
+                                  "refcount_drift"])
+def test_auditor_catches_chaos_seeded_violations(kind, tmp_path):
+    """Each accounting-fault class seeded through the engine.kv_account
+    chaos seam is caught by the reconciliation sweep, attributed to
+    tier + block (+ seq where one exists), counted into the violation
+    totals, and snapshots the flight recorder on first occurrence."""
+    from dynamo_tpu import obs
+
+    reported = kind.replace("_", "-").replace("refcount-drift",
+                                              "refcount-drift")
+    expect_kind = {"leak": "leak", "double_free": "double-free",
+                   "orphan": "orphan",
+                   "refcount_drift": "refcount-drift"}[kind]
+    led = KvLedger()
+    a = BlockAllocator(16, ledger=led)
+    plane = chaos.ChaosPlane(seed=3)
+    plane.rule("engine.kv_account", "drop", match=f"{kind}:", times=1)
+    tr = obs.Tracer(out_path=str(tmp_path / "trace.json"))
+    tr.install()
+    try:
+        with plane:
+            a.allocate("victim", [], 3)
+            a.free("victim")
+        assert plane.fired("engine.kv_account") == 1
+        viol = led.audit_allocator(a, live_seqs=[])
+        report = led.finish_audit(viol, where="test")
+    finally:
+        tr.uninstall()
+    assert not report["clean"]
+    assert expect_kind in kinds_of(viol), (reported, viol)
+    first = next(v for v in viol if v["kind"] == expect_kind)
+    assert first["tier"] == "g1"
+    assert "block" in first
+    if expect_kind in ("leak", "orphan"):
+        assert first.get("seq_id") == "victim"
+    # violation totals are monotonic and keyed (kind, tier)
+    assert led.violations_by_kind()[expect_kind]["g1"] >= 1
+    # first occurrence of the class dumped the flight recorder
+    assert tr.flight_dumps, "expected a kv_ledger flight-recorder dump"
+
+
+def test_auditor_catches_direct_mutation_orphan_and_drift():
+    """The DYN013 bug class at runtime: rogue code mutating the
+    allocator's books behind the ledger's back is exactly what the
+    auditor reports."""
+    led = KvLedger()
+    a = BlockAllocator(16, ledger=led)
+    a.allocate("s1", [], 2)
+    bid = a.seq_block_ids("s1")[0]
+    # dynlint: disable=DYN013 deliberately corrupting the books to prove the auditor catches it
+    a._block_ref[bid] += 1
+    viol = led.audit_allocator(a, live_seqs=["s1"])
+    assert "refcount-drift" in kinds_of(viol)
+
+    led2 = KvLedger()
+    b = BlockAllocator(16, ledger=led2)
+    b.allocate("s2", [], 2)
+    bid2 = b.seq_block_ids("s2")[-1]
+    # release behind the ledger's back (the books now point at a ghost)
+    # dynlint: disable=DYN013 deliberately corrupting the books to prove the auditor catches it
+    b._block_ref.pop(bid2)
+    # dynlint: disable=DYN013 deliberately corrupting the books to prove the auditor catches it
+    b._free.append(bid2)
+    viol = led2.audit_allocator(b, live_seqs=["s2"])
+    assert "orphan" in kinds_of(viol)
+
+
+def test_fragmentation_counts_dead_cached_tails():
+    """Lineage fragmentation: a cached block whose parent was evicted
+    can never be prefix-hit again (matching walks leading runs) — the
+    attribution reports it as dead capacity."""
+    led = KvLedger()
+    a = BlockAllocator(4, ledger=led)  # 3 usable
+    a.allocate("s1", [H(1), H(2)], 3)
+    a.commit_block("s1", 0, H(1))
+    a.commit_block("s1", 1, H(2))  # parent = H(1)
+    a.free("s1")  # H(1), H(2) cached (LRU order: 1 then 2), partial freed
+    frag = led.attribution()["g1"]["fragmentation"]
+    assert frag["dead_cached"] == 0
+    # two fresh blocks evict H(1) — the LRU-coldest — leaving H(2)'s
+    # parent gone
+    a.allocate("s2", [], 2)
+    assert led.audit_allocator(a, live_seqs=["s2"]) == []
+    frag = led.attribution()["g1"]["fragmentation"]
+    assert frag["dead_cached"] == 1 and frag["dead_frac"] == 1.0
+
+
+def test_kvbm_manifest_reconciliation(tmp_path):
+    """Tier books: stage/evict events keep the ledger's tier sets equal
+    to the pool manifests; a pool mutation the ledger never saw is a
+    leak (pool-only) or orphan (ledger-only), attributed to the tier."""
+    import numpy as np
+
+    from dynamo_tpu.kvbm.manager import TieredKvManager
+
+    led = KvLedger()
+    mgr = TieredKvManager(host_blocks=4)
+    k = np.zeros((1, 2, 1, 4), np.float32)
+
+    def feed(batches):
+        for stored, removed, tier in batches:
+            led.tier_batch(stored, removed, tier)
+
+    for i in range(4):
+        feed(mgr.offload(H(i), k, k))
+    assert led.audit_kvbm(mgr) == []
+    # a fifth offload LRU-evicts H(0) (no g3: dropped) — still clean
+    feed(mgr.offload(H(5), k, k))
+    assert led.audit_kvbm(mgr) == []
+    # pool mutation behind the ledger's back
+    mgr.g2.drop(H(1))
+    viol = led.audit_kvbm(mgr)
+    assert kinds_of(viol) == ["orphan"] and viol[0]["tier"] == "g2"
+    led.tier_batch([], [H(1)], "g2")  # reconcile
+    mgr.g2.put(H(9), k, k)
+    viol = led.audit_kvbm(mgr)
+    assert kinds_of(viol) == ["leak"] and viol[0]["tier"] == "g2"
+    mgr.close()
+
+
+def test_ledger_enabled_gate(monkeypatch):
+    monkeypatch.delenv("DYN_KV_LEDGER", raising=False)
+    assert ledger_enabled(None) is True
+    monkeypatch.setenv("DYN_KV_LEDGER", "0")
+    assert ledger_enabled(None) is False
+    assert ledger_enabled(True) is True  # explicit config wins
+    monkeypatch.setenv("DYN_KV_LEDGER", "1")
+    assert ledger_enabled(False) is False
+
+
+# ---------------------- engine integration -------------------------------
+
+
+@pytest.mark.allow_slow_callbacks
+async def test_engine_e2e_clean_audit_with_kvbm_and_cadence():
+    """A real tiny JAX engine serving shared-prefix requests with KVBM
+    offload enabled: the finish-cadence audit runs on its own, the
+    on-demand /debug/kv audit reconciles exactly (0 violations), and
+    the attribution carries prefix-cached blocks + tier occupancy."""
+    from test_engine import FP32, collect, greedy_req
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    eng = JaxEngine(EngineConfig(
+        model_config=FP32, block_size=4, num_blocks=32,
+        max_blocks_per_seq=8, max_num_seqs=2,
+        prefill_buckets=(8, 16, 32), seed=7,
+        host_cache_blocks=8, offload_watermark_blocks=30,
+    ))
+    assert eng.kv_ledger is not None
+    prefix = list(range(40, 52))
+    await collect(eng, greedy_req(prefix + [1, 2], 4, "r1"))
+    await collect(eng, greedy_req(prefix + [7, 8], 4, "r2"))
+    # the request-finish cadence audited without being asked
+    for _ in range(100):
+        if eng.kv_ledger.last_audit is not None:
+            break
+        await asyncio.sleep(0.02)
+    assert eng.kv_ledger.last_audit is not None
+    # on-demand audit (the /debug/kv path): clean books
+    report = await eng.audit_kv()
+    assert report["clean"], report
+    att = eng.kv_ledger.attribution()
+    assert att["g1"]["prefix_cached"] > 0
+    assert att["g1"]["active"] == 0
+    # offload staged blocks into g2 and the tier books agree
+    assert att.get("g2", {}).get("blocks", 0) > 0
+    dump = eng.kv_ledger.dump()
+    assert dump["schema"] == "dynamo.kv_ledger.v1"
+    assert dump["violations_total"] == {}
+    await eng.close()
+
+
+@pytest.mark.allow_slow_callbacks
+async def test_engine_ledger_disabled_is_none():
+    """kv_ledger=False (or DYN_KV_LEDGER=0) keeps the whole plane off:
+    no ledger object, allocator hooks are one pointer compare, serving
+    is unaffected."""
+    from test_engine import FP32, collect, greedy_req
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    eng = JaxEngine(EngineConfig(
+        model_config=FP32, block_size=4, num_blocks=32,
+        max_blocks_per_seq=8, max_num_seqs=2,
+        prefill_buckets=(8, 16), seed=7, kv_ledger=False,
+    ))
+    assert eng.kv_ledger is None and eng.allocator.ledger is None
+    toks = await collect(eng, greedy_req(list(range(10)), 4, "r1"))
+    assert len(toks) == 4
+    assert await eng.audit_kv() == {}
+    await eng.close()
+
+
+@pytest.mark.allow_slow_callbacks
+async def test_engine_parked_blocks_attributed_pinned_by_transfer():
+    """Disagg handoff accounting: a parked prefill's blocks attribute
+    as pinned-by-transfer (not active, not leaked) and reconcile clean;
+    releasing the parked KV returns them to the prefix cache."""
+    from test_engine import FP32, greedy_req
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.protocols.llm import DISAGG_ANNOTATION
+
+    eng = JaxEngine(EngineConfig(
+        model_config=FP32, block_size=4, num_blocks=32,
+        max_blocks_per_seq=8, max_num_seqs=2,
+        prefill_buckets=(8, 16, 32), seed=7, role="prefill"))
+    req = greedy_req(list(range(30, 44)), 4, "park1")
+    req.annotations = [DISAGG_ANNOTATION]
+    async for _ in eng.generate(req):
+        pass
+    att = eng.kv_ledger.attribution()["g1"]
+    assert att["pinned_by_transfer"] > 0, att
+    report = await eng.audit_kv()
+    assert report["clean"], report
+    await eng.release_parked("park1")
+    att = eng.kv_ledger.attribution()["g1"]
+    assert att["pinned_by_transfer"] == 0
+    assert att["prefix_cached"] > 0
+    report = await eng.audit_kv()
+    assert report["clean"], report
+    await eng.close()
+
+
+# ---------------------- mocker parity ------------------------------------
+
+
+async def test_mocker_ledger_parity_clean_audit():
+    """The capacity sim feeds the same ledger (hash-keyed): a mocker
+    serving shared-prefix streams reconciles exactly, with attribution
+    matching the sim's own used-block count."""
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    eng = MockEngine(MockEngineArgs(
+        model_name="m", block_size=4, num_blocks=64,
+        base_step_s=0.0001, prefill_s_per_token=0.0,
+        decode_s_per_seq=0.0))
+    assert eng.kv_ledger is not None
+
+    async def run(rid, toks):
+        req = PreprocessedRequest(
+            token_ids=toks, request_id=rid,
+            stop=StopConditions(max_tokens=6, ignore_eos=True))
+        async for _ in eng.generate(req):
+            pass
+
+    prefix = list(range(16))
+    await asyncio.gather(run("a", prefix + [1]), run("b", prefix + [2]))
+    report = eng.audit_kv()
+    assert report["clean"], report
+    att = eng.kv_ledger.attribution()["g1"]
+    assert att["prefix_cached"] > 0 and att["active"] == 0
+    # ledger tracked == sim used (cached blocks hold no partials now)
+    assert att["tracked"] == eng.cache.used_blocks
+    # the sim's finish cadence audited on its own too
+    assert eng.kv_ledger.last_audit is not None
+    await eng.close()
+
+
+async def test_mocker_auditor_catches_sim_corruption():
+    """Direct sim-book mutation (the DYN013 class, mocker side) is
+    classified: a dropped refcount is drift, a vanished entry an
+    orphan, an unledgered one a leak."""
+    from dynamo_tpu.mocker.kv_cache_sim import KvCacheSim
+
+    led = KvLedger()
+    sim = KvCacheSim(16, ledger=led)
+    sim.allocate("s1", [H(1), H(2)], 3)
+    # dynlint: disable=DYN013 deliberately corrupting the sim books to prove the auditor catches it
+    sim._ref[H(1)] += 1
+    viol = led.audit_sim(sim, live_seqs=["s1"])
+    assert "refcount-drift" in kinds_of(viol)
+    # dynlint: disable=DYN013 deliberately corrupting the sim books to prove the auditor catches it
+    sim._ref.pop(H(2))
+    viol = led.audit_sim(sim, live_seqs=["s1"])
+    assert "orphan" in kinds_of(viol)
+    # dynlint: disable=DYN013 deliberately corrupting the sim books to prove the auditor catches it
+    sim._ref[H(7)] = 1
+    viol = led.audit_sim(sim, live_seqs=["s1"])
+    assert "leak" in kinds_of(viol)
+
+
+# ---------------------- /debug/kv + fleet --------------------------------
+
+
+async def test_debug_kv_token_gated_and_fleet_folds():
+    """/debug/kv: 401 without the admin token, a schema'd dump with a
+    FRESH audit with it; the fleet snapshot attaches the per-worker
+    ledger view (strict instance match) and the summary carries the
+    per-tier attributed occupancy + violation rollup, with the
+    dynamo_fleet_kv_violations gauge exported."""
+    import aiohttp
+
+    from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+    from dynamo_tpu.obs import fleet as obs_fleet
+    from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+    from dynamo_tpu.runtime.metrics import MetricsHierarchy
+
+    token = "kv-test-token"
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem", event_plane="inproc",
+                             system_port=-1, admin_token=token),
+        cluster_id=uuid.uuid4().hex).start()
+    worker = await MockerWorker(
+        rt, MockEngineArgs(model_name="m", block_size=4,
+                           base_step_s=0.0001)).start()
+    req = PreprocessedRequest(
+        token_ids=list(range(12)), request_id="warm",
+        stop=StopConditions(max_tokens=4, ignore_eos=True))
+    async for _ in worker.engine.generate(req):
+        pass
+    url = f"http://{rt.system_address}/debug/kv"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(url) as r:
+                assert r.status == 401
+            async with s.get(
+                    url, headers={"X-Dyn-Admin-Token": token}) as r:
+                assert r.status == 200
+                state = json.loads(await r.read())
+        src = state["sources"][f"kv:{worker.served.instance_id}"]
+        assert src["schema"] == "dynamo.kv_ledger.v1"
+        assert src["audit"]["clean"] is True
+        assert src["attribution"]["g1"]["prefix_cached"] > 0
+        # fleet snapshot: per-worker kv_ledger view + summary rollup
+        snap = await obs_fleet.snapshot(rt.discovery, token=token)
+        view = next(w for w in snap.workers
+                    if w.worker_id == worker.served.instance_id)
+        assert view.kv_ledger is not None
+        assert view.kv_ledger["schema"] == "dynamo.kv_ledger.v1"
+        kvl = snap.summary["kv_ledger"]
+        assert kvl["violations_total"] == 0
+        assert kvl["occupancy"]["g1"]["prefix_cached"] > 0
+        m = MetricsHierarchy(namespace="t")
+        obs_fleet.export_fleet_gauges(m, snap)
+        rendered = m.render().decode()
+        assert "dynamo_fleet_kv_violations" in rendered \
+            and "} 0.0" in rendered.split(
+                "dynamo_fleet_kv_violations{", 1)[1].splitlines()[0]
+        # obs.report renders the KV-accounting section from the dump
+        from dynamo_tpu.obs.report import kv_accounting, kv_ledger_docs
+
+        docs = kv_ledger_docs(state)
+        assert docs, "report must find the ledger dump in /debug/kv"
+        acct = kv_accounting(docs)
+        assert acct["reconciled_clean"] is True
+        assert acct["violations_total"] == 0
+        assert acct["occupancy"]["g1"]["prefix_cached"] > 0
+    finally:
+        await worker.close()
+        await rt.shutdown()
+    assert not rt.kv_sources  # close() unregisters
+
+
+async def test_kv_ledger_violation_gauge_exported():
+    """A seeded violation reaches /metrics through the shared worker
+    gauge surface (export_engine_gauges) as
+    dynamo_kv_ledger_violations_total{kind,tier}."""
+    from dynamo_tpu.planner.metrics import FpmWindow, export_engine_gauges
+    from dynamo_tpu.runtime.metrics import MetricsHierarchy
+
+    led = KvLedger()
+    a = BlockAllocator(8, ledger=led)
+    plane = chaos.ChaosPlane(seed=5)
+    plane.rule("engine.kv_account", "drop", match="leak:", times=1)
+    with plane:
+        a.allocate("s", [], 2)
+        a.free("s")
+    led.finish_audit(led.audit_allocator(a, live_seqs=[]), where="test")
+    m = MetricsHierarchy(namespace="t")
+    export_engine_gauges(m, FpmWindow(), kv_ledger=led)
+    rendered = m.render().decode()
+    line = next(ln for ln in rendered.splitlines()
+                if ln.startswith("dynamo_kv_ledger_violations_total{"))
+    assert 'kind="leak"' in line and 'tier="g1"' in line
+    assert line.endswith(" 1.0")
+    assert "dynamo_kv_ledger_blocks{" in rendered
+
+
+# ---------------------- snapshot-on-subscribe ----------------------------
+
+
+async def test_publisher_snapshot_events():
+    """The publisher's resident mirror follows the netted stream, and a
+    snapshot replay carries the CURRENT resident set per tier stamped
+    with the latest event id."""
+    from dynamo_tpu.router.events import KvCacheEvent, KvEventPublisher
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc"),
+        cluster_id=uuid.uuid4().hex).start()
+    try:
+        pub = KvEventPublisher(rt, "ns", "w", worker_id=7)
+        pub.enqueue_batch(stored=[H(1), H(2)])
+        pub.enqueue_batch(stored=[H(3)], tier="g2")
+        pub.enqueue_batch(removed=[H(2)])
+        evs = [KvCacheEvent.from_wire(w) for w in pub.snapshot_events()]
+        by_tier = {e.tier: sorted(e.block_hashes) for e in evs}
+        assert by_tier == {"g1": [H(1)], "g2": [H(3)]}
+        assert all(e.event_id == pub._next_id - 1 for e in evs)
+        assert all(e.op == "stored" for e in evs)
+        # the replay endpoint answers snapshot requests with the same
+        got = []
+        async for w in pub.replay_handler({"snapshot": True}, None):
+            got.append(KvCacheEvent.from_wire(w))
+        assert {e.tier: sorted(e.block_hashes) for e in got} == by_tier
+        # cleared() empties the mirror
+        await pub.cleared()
+        assert pub.snapshot_events() == []
+    finally:
+        await rt.shutdown()
+
+
+async def test_router_snapshot_on_subscribe_sees_warm_cache():
+    """THE PR 13 staleness fix, e2e: a router started AFTER a worker
+    warmed its cache — with no further KV events ever firing — still
+    indexes the worker's resident blocks via the snapshot replay, so
+    its overlap predictions are nonzero against the warm fleet."""
+    from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+    from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
+    from dynamo_tpu.router.kv_router import KvRouter
+    from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+    from dynamo_tpu.tokens import compute_block_hashes_for_request
+
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc"),
+        cluster_id=uuid.uuid4().hex).start()
+    worker = await MockerWorker(
+        rt, MockEngineArgs(model_name="m", block_size=4,
+                           base_step_s=0.0001)).start()
+    prompt = list(range(24))  # 6 blocks, 5 full ones hashed
+    req = PreprocessedRequest(
+        token_ids=prompt, request_id="warm",
+        stop=StopConditions(max_tokens=2, ignore_eos=True))
+    async for _ in worker.engine.generate(req):
+        pass
+    await asyncio.sleep(0.1)  # the warm events drain to nobody
+    client = await (rt.namespace("dynamo").component("mocker")
+                    .endpoint("generate").client()).start()
+    await client.wait_for_instances()
+    # the LATE subscriber: no events will ever fire again (pure cache
+    # hits don't), so only the snapshot sync can warm its index
+    router = await KvRouter(rt, "dynamo", "mocker", client,
+                            block_size=4).start()
+    hashes = compute_block_hashes_for_request(prompt, 4)
+    try:
+        deadline = 100
+        overlap = {}
+        for _ in range(deadline):
+            overlap = router.indexer.find_matches(hashes)
+            if overlap:
+                break
+            await asyncio.sleep(0.05)
+        assert overlap, "late router never saw the warm resident set"
+        assert max(overlap.values()) >= len(hashes)
+    finally:
+        await router.close()
+        await client.close()
+        await worker.close()
+        await rt.shutdown()

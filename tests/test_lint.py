@@ -23,7 +23,7 @@ from dynamo_tpu.lint.core import canon_path
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-ALL_RULES = tuple(f"DYN{i:03d}" for i in range(1, 13))
+ALL_RULES = tuple(f"DYN{i:03d}" for i in range(1, 14))
 
 
 def run(src, path="dynamo_tpu/engine/snippet.py", rules=None):
@@ -460,6 +460,52 @@ def test_dyn012_applies_in_tests_and_suppresses():
     src = ('tr.hop("first_tokn")  '
            "# dynlint: disable=DYN012 the negative-test literal\n")
     assert lint.run_source(src, "tests/test_forensics.py") == []
+
+
+# ------------------- DYN013: allocator/pool book mutation ---------------
+
+def test_dyn013_flags_book_mutations_outside_defining_module():
+    bad = run("""
+        def steal(allocator, sim, pool, bid, h):
+            allocator._free.append(bid)          # free-list mutation
+            allocator._block_ref[bid] = 2        # subscript store
+            allocator._block_ref[bid] += 1       # augassign
+            del allocator._seq_blocks["s"]       # del
+            allocator._lru.pop(h, None)          # mutating method
+            sim._ref.update({h: 1})              # sim books
+            pool._order.clear()                  # pool manifest
+        """, path="dynamo_tpu/engine/core.py")
+    assert rule_ids(bad) == ["DYN013"]
+    assert len(bad) == 7
+
+
+def test_dyn013_reads_pass_and_defining_modules_exempt():
+    good = run("""
+        def audit(allocator):
+            free_list = list(allocator._free)    # read-only copy
+            rc = dict(allocator._block_ref)
+            n = len(allocator._seq_blocks)
+            return free_list, rc, n
+        """, path="dynamo_tpu/obs/kv_ledger.py")
+    assert good == []
+    # the defining modules mutate their own books by definition
+    owner = run("""
+        def free(self, bid):
+            self._block_ref.pop(bid, None)
+            self._free.append(bid)
+        """, path="dynamo_tpu/engine/block_allocator.py")
+    assert owner == []
+
+
+def test_dyn013_applies_in_tests_and_suppresses():
+    bad = run("""
+        def test_corrupt(a):
+            a._free.append(3)
+        """, path="tests/test_something.py")
+    assert rule_ids(bad) == ["DYN013"]
+    src = ("a._free.append(3)  "
+           "# dynlint: disable=DYN013 seeding the fault the auditor must catch\n")
+    assert lint.run_source(src, "tests/test_something.py") == []
 
 
 # --------------------------- suppressions -------------------------------
