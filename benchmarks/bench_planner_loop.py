@@ -1,0 +1,316 @@
+"""Closed-loop autoscaling benchmark: a diurnal load swing over a mocker
+fleet, planner in the loop (ROADMAP item 4's acceptance bench).
+
+CPU-only: the mocker's timing model simulates engine step latency, so
+this measures CONTROL quality — how well the planner's
+OBSERVE→PREDICT→PROPOSE→RECONCILE→EXECUTE loop provisions a swinging
+load — not kernel speed.  A synthesized diurnal trace (default 10×
+trough→peak→trough swing, loadgen.synthesize_diurnal) replays through
+the real frontend migration path against workers spawned/drained by a
+CallbackConnector, under two policies:
+
+  * closed — the planner scales [min, max] live: load-proposed
+    replicas, fast-burn forced scale-up (the frontend-analogue SloPlane
+    feeds slo_metrics exactly like a real frontend), drain-gated
+    scale-down (victims' streams finish or migrate via token replay).
+  * static — max_replicas workers for the whole run: the provisioning
+    a fleet without a planner must pay for the same peak.
+
+One JSON line per policy; `--policy ab` adds a summary line comparing
+them: the closed loop must hold the p90 TTFT/ITL targets (p90, not
+p95 — smoke-scale runs replay tens of requests, where a p95 gate is a
+single-sample coin flip) while spending FEWER worker-seconds than
+static max-provisioning (`"ok": true`).
+
+    python benchmarks/bench_planner_loop.py --duration-s 30 \
+        --rate-low 0.4 --rate-high 4.0 --max-replicas 4
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+import uuid
+from types import SimpleNamespace
+
+sys.path.insert(0, ".")
+
+from dynamo_tpu.frontend import ModelManager, ModelWatcher  # noqa: E402
+from dynamo_tpu.loadgen import replay, synthesize_diurnal  # noqa: E402
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker  # noqa: E402
+from dynamo_tpu.obs.slo import SloConfig, SloPlane  # noqa: E402
+from dynamo_tpu.planner import (  # noqa: E402
+    CallbackConnector,
+    Planner,
+    PlannerConfig,
+)
+from dynamo_tpu.protocols import PreprocessedRequest  # noqa: E402
+from dynamo_tpu.runtime import (  # noqa: E402
+    DistributedRuntime,
+    RuntimeConfig,
+)
+
+BLOCK = 16
+MODEL = "bench"
+
+
+def fresh_runtime():
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+def engine_args(args):
+    return MockEngineArgs(
+        model_name=MODEL, block_size=BLOCK, num_blocks=4096,
+        base_step_s=args.base_step_ms / 1e3,
+        prefill_s_per_token=args.prefill_us_per_token / 1e6,
+        decode_s_per_seq=args.decode_us_per_seq / 1e6,
+        max_num_seqs=args.max_num_seqs)
+
+
+async def sample_worker_seconds(conn, stop: asyncio.Event, out: dict):
+    """∫ replicas dt while the replay runs — the provisioning cost the
+    closed loop is judged on — plus the replica-count envelope."""
+    last = time.monotonic()
+    while not stop.is_set():
+        now = time.monotonic()
+        n = len(conn.handles)
+        out["worker_seconds"] = out.get("worker_seconds", 0.0) \
+            + n * (now - last)
+        out["replicas_min"] = min(out.get("replicas_min", n), n)
+        out["replicas_max"] = max(out.get("replicas_max", n), n)
+        last = now
+        try:
+            await asyncio.wait_for(stop.wait(), 0.05)
+        except asyncio.TimeoutError:
+            pass
+
+
+def planner_action_counts(planner) -> dict:
+    counts: dict = {}
+    for d in planner.decisions:
+        kind = ("scale_up" if d["applied"] > d["current"] else "scale_down")
+        counts[kind] = counts.get(kind, 0) + 1
+        if "burn_actuation" in d:
+            counts["burn_up"] = counts.get("burn_up", 0) + 1
+    return counts
+
+
+async def run_policy(policy: str, rows, args) -> dict:
+    rt = await fresh_runtime().start()
+    eargs = engine_args(args)
+    try:
+        conn = CallbackConnector(
+            spawn=lambda: MockerWorker(
+                rt, eargs, component="backend", migration_limit=4).start(),
+            stop=lambda w: w.close(),
+            drain=lambda w, deadline: w.drain(deadline_s=deadline),
+            drain_deadline_s=args.drain_deadline_s)
+        await conn.scale(args.max_replicas if policy == "static"
+                         else args.min_replicas)
+
+        manager = ModelManager()
+        watcher = await ModelWatcher(rt, manager).start()
+        for _ in range(400):
+            if manager.get(MODEL):
+                break
+            await asyncio.sleep(0.01)
+        pipeline = manager.get(MODEL)
+        assert pipeline is not None, "mocker fleet never registered"
+        await pipeline.client.wait_for_instances()
+
+        # frontend-analogue SLO plane: per-request outcomes feed rolling
+        # burn the exact way a real frontend does, published on
+        # slo_metrics.{ns} for the planner's burn actuation
+        slo_plane = SloPlane(
+            rt.metrics.scoped(component="frontend"),
+            SloConfig(ttft_ms=args.slo_ttft_ms, itl_ms=args.slo_itl_ms,
+                      windows_s=(5.0, 30.0, 120.0)))
+        shim = SimpleNamespace(model=MODEL)
+
+        async def publish_slo():
+            while True:
+                await asyncio.sleep(0.25)
+                await slo_plane.publish(rt, ["dynamo"])
+
+        pub_task = asyncio.create_task(publish_slo())
+
+        planner = None
+        if policy == "closed":
+            planner = Planner(
+                rt, "dynamo", "backend", conn,
+                config=PlannerConfig(
+                    interval_s=args.tick_s,
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas,
+                    target_active_per_replica=args.target_active,
+                    cooldown_s=args.cooldown_s,
+                    max_step=2, down_stable_ticks=4,
+                    burn_up_threshold=args.burn_up_threshold,
+                    predictor="ema"))
+            await planner.start()
+
+        async def client_fn(req_dict):
+            req = PreprocessedRequest.from_dict(req_dict)
+            t0 = time.perf_counter()
+            first_t = last_t = None
+            ntok = 0
+            outcome = None
+            try:
+                async for out in pipeline.migration.generate(req):
+                    now = time.perf_counter()
+                    n = len(out.token_ids or ())
+                    if n:
+                        if first_t is None:
+                            first_t = now
+                        last_t = now
+                        ntok += n
+                    yield out.to_dict()
+            except Exception:
+                # an errored request burns SLO budget like a real
+                # frontend's outcome=error — without this the burn
+                # actuation is blind to exactly the failure mode it
+                # should scale against
+                outcome = "error"
+                raise
+            finally:
+                end = time.perf_counter()
+                itl_ms = None
+                if ntok > 1 and first_t is not None and last_t > first_t:
+                    itl_ms = (last_t - first_t) / (ntok - 1) * 1e3
+                if outcome is None:
+                    outcome = ("ok" if first_t is not None
+                               else "no_first_token")
+                slo_plane.observe_finish(shim, {"request": {
+                    "outcome": outcome,
+                    "total_time_ms": (end - t0) * 1e3,
+                    "ttft_ms": ((first_t - t0) * 1e3
+                                if first_t is not None else None),
+                    "avg_itl_ms": itl_ms,
+                }})
+
+        stop, cost = asyncio.Event(), {}
+        sampler = asyncio.create_task(
+            sample_worker_seconds(conn, stop, cost))
+        try:
+            report = await replay(client_fn, rows, block_size=BLOCK,
+                                  speedup=args.speedup)
+        finally:
+            stop.set()
+            await sampler
+            pub_task.cancel()
+            await asyncio.gather(pub_task, return_exceptions=True)
+
+        summary = report.summary(
+            slo_ttft_s=args.slo_ttft_ms / 1e3 if args.slo_ttft_ms else None,
+            slo_itl_s=args.slo_itl_ms / 1e3 if args.slo_itl_ms else None)
+        line = {
+            "config": "planner_loop",
+            "policy": policy,
+            "swing": round(args.rate_high / max(args.rate_low, 1e-9), 2),
+            "requests": summary["requests"],
+            "completed": summary["completed"],
+            "errors": summary["errors"],
+            "wall_s": summary["wall_s"],
+            "ttft_s": summary["ttft_s"],
+            "itl_s": summary["itl_s"],
+            "worker_seconds": round(cost.get("worker_seconds", 0.0), 2),
+            "replicas": {"min": cost.get("replicas_min"),
+                         "max": cost.get("replicas_max")},
+            "slo": {"ttft_ms": args.slo_ttft_ms,
+                    "itl_ms": args.slo_itl_ms},
+        }
+        if planner is not None:
+            line["actions"] = planner_action_counts(planner)
+            line["drain_escalations"] = conn.drain_escalations
+            line["last_diag"] = {
+                k: v for k, v in planner.last_diag.items()
+                if k.startswith(("slo_", "spawn"))}
+            await planner.close()
+        await watcher.close()
+        await conn.close()
+        return line
+    finally:
+        await rt.shutdown()
+
+
+def verdict(closed: dict, static: dict, args) -> dict:
+    """The acceptance comparison: closed must hold the latency targets
+    AND spend fewer worker-seconds than static max-provisioning."""
+    ttft_ok = closed["ttft_s"]["p90"] <= args.slo_ttft_ms / 1e3
+    itl_ok = (args.slo_itl_ms is None
+              or closed["itl_s"]["p90"] <= args.slo_itl_ms / 1e3)
+    cheaper = closed["worker_seconds"] < static["worker_seconds"]
+    return {
+        "config": "planner_loop_ab",
+        "p90_ttft_ok": ttft_ok,
+        "p90_itl_ok": itl_ok,
+        "closed_worker_seconds": closed["worker_seconds"],
+        "static_worker_seconds": static["worker_seconds"],
+        "saving_frac": round(
+            1.0 - closed["worker_seconds"]
+            / max(static["worker_seconds"], 1e-9), 4),
+        "errors": closed["errors"] + static["errors"],
+        "ok": bool(ttft_ok and itl_ok and cheaper
+                   and closed["errors"] == 0),
+    }
+
+
+async def main():
+    p = argparse.ArgumentParser(
+        description="closed-loop planner benchmark over a diurnal swing")
+    p.add_argument("--policy", default="ab",
+                   choices=["closed", "static", "ab"])
+    p.add_argument("--duration-s", type=float, default=30.0,
+                   help="trace duration (one full diurnal cycle)")
+    p.add_argument("--rate-low", type=float, default=0.4,
+                   help="trough arrival rate, req/s")
+    p.add_argument("--rate-high", type=float, default=4.0,
+                   help="peak arrival rate, req/s (default = 10x trough)")
+    p.add_argument("--input-len", type=int, default=64)
+    p.add_argument("--output-len", type=int, default=128)
+    p.add_argument("--speedup", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    # fleet bounds + control knobs
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--target-active", type=float, default=2.0)
+    p.add_argument("--tick-s", type=float, default=0.25)
+    p.add_argument("--cooldown-s", type=float, default=0.5)
+    p.add_argument("--burn-up-threshold", type=float, default=2.0)
+    p.add_argument("--drain-deadline-s", type=float, default=2.0)
+    # SLO targets the loop must hold
+    p.add_argument("--slo-ttft-ms", type=float, default=1000.0)
+    p.add_argument("--slo-itl-ms", type=float, default=100.0)
+    # mocker timing model
+    p.add_argument("--base-step-ms", type=float, default=12.0)
+    p.add_argument("--prefill-us-per-token", type=float, default=20.0)
+    p.add_argument("--decode-us-per-seq", type=float, default=3000.0)
+    p.add_argument("--max-num-seqs", type=int, default=8)
+    args = p.parse_args()
+
+    rows = synthesize_diurnal(
+        args.duration_s, rate_low_rps=args.rate_low,
+        rate_high_rps=args.rate_high, input_len=args.input_len,
+        output_len=args.output_len, seed=args.seed)
+    print(json.dumps({"config": "trace", "requests": len(rows),
+                      "duration_s": args.duration_s,
+                      "swing": round(args.rate_high
+                                     / max(args.rate_low, 1e-9), 2)}),
+          flush=True)
+
+    results = {}
+    for policy in (("closed", "static") if args.policy == "ab"
+                   else (args.policy,)):
+        results[policy] = await run_policy(policy, rows, args)
+        print(json.dumps(results[policy]), flush=True)
+    if args.policy == "ab":
+        v = verdict(results["closed"], results["static"], args)
+        print(json.dumps(v), flush=True)
+        if not v["ok"]:
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -62,6 +62,17 @@ Seam registry (name — wired at — supported actions):
                            fault: key carries leak / double_free /
                            orphan / refcount_drift — the kv-ledger
                            auditor must catch each, obs/kv_ledger.py)
+  planner.scale            Planner tick EXECUTE, before the connector
+                           call (fail = actuation failure the loop must
+                           survive, delay = slow connector)
+  connector.spawn          SubprocessConnector / CallbackConnector, per
+                           replica spawn (fail = spawn failure — what
+                           the backoff/circuit-breaker governor must
+                           absorb instead of respawning every tick)
+  worker.drain             JaxEngineWorker.drain / MockerWorker.drain
+                           entry (wedge = a worker that IGNORES drain,
+                           forcing the connector's bounded-wait →
+                           stop escalation; fail = drain raising)
 """
 
 from __future__ import annotations
@@ -99,6 +110,9 @@ SEAMS = frozenset({
     "kvbm.remote_pull",
     "engine.step",
     "engine.kv_account",
+    "planner.scale",
+    "connector.spawn",
+    "worker.drain",
 })
 
 # how long a "wedge" blocks when no delay_s is given: effectively

@@ -764,8 +764,15 @@ class JaxEngineWorker:
         nothing to drain (the leader's drain stops the step stream)."""
         import time
 
+        from .. import chaos
+
         if not self.mh.is_leader or self.engine is None:
             return
+        # chaos: a worker that ignores drain (wedge) — the planner
+        # connector's bounded wait escalates to stop, and migration
+        # completes the in-flight streams on survivors
+        await chaos.ahit("worker.drain", key=str(
+            self.served.instance_id if self.served is not None else ""))
         self.engine.draining = True
         if self.served is not None:
             logger.warning("draining jax engine worker %d (deadline %.1fs)",

@@ -14,7 +14,8 @@ against a live cluster over the request plane and prints the report.
 """
 
 from .replay import Report, replay
-from .trace import TraceRow, load_trace, materialize_tokens, save_trace, synthesize
+from .trace import (TraceRow, load_trace, materialize_tokens, save_trace,
+                    synthesize, synthesize_diurnal)
 
 __all__ = [
     "Report",
@@ -24,4 +25,5 @@ __all__ = [
     "replay",
     "save_trace",
     "synthesize",
+    "synthesize_diurnal",
 ]

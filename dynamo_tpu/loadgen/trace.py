@@ -122,6 +122,21 @@ def materialize_tokens(row: TraceRow, block_size: int,
     return toks
 
 
+def _sample_row_shape(rng: random.Random, input_len: int, output_len: int,
+                      prefix_groups: int, prefix_blocks: int):
+    """One row's (hash_ids, input_length, output_length) draw — shared
+    by synthesize() and synthesize_diurnal() so prefix-group encoding
+    and length sampling cannot drift between the trace generators (A/B
+    runs across them must differ only in arrival process)."""
+    hash_ids = None
+    if prefix_groups > 0:
+        g = rng.randrange(prefix_groups)
+        hash_ids = [g * 1000 + j for j in range(prefix_blocks)]
+    isl = max(1, int(rng.gauss(input_len, input_len / 8)))
+    osl = max(1, int(rng.gauss(output_len, output_len / 8)))
+    return hash_ids, isl, osl
+
+
 def synthesize(
     n_requests: int,
     *,
@@ -143,12 +158,8 @@ def synthesize(
     t = 0.0
     for i in range(n_requests):
         t += rng.expovariate(rate_rps) * 1000.0
-        hash_ids = None
-        if prefix_groups > 0:
-            g = rng.randrange(prefix_groups)
-            hash_ids = [g * 1000 + j for j in range(prefix_blocks)]
-        isl = max(1, int(rng.gauss(input_len, input_len / 8)))
-        osl = max(1, int(rng.gauss(output_len, output_len / 8)))
+        hash_ids, isl, osl = _sample_row_shape(
+            rng, input_len, output_len, prefix_groups, prefix_blocks)
         rows.append(TraceRow(
             request_id=f"req-{i}", input_length=isl, output_length=osl,
             hash_ids=hash_ids, timestamp=round(t, 3),
@@ -160,4 +171,57 @@ def synthesize(
                 input_length=max(1, isl // 4), output_length=osl,
                 hash_ids=hash_ids, delay=rng.uniform(50.0, 200.0),
             ))
+    return rows
+
+
+def synthesize_diurnal(
+    duration_s: float,
+    *,
+    rate_low_rps: float = 0.5,
+    rate_high_rps: float = 5.0,
+    period_s: Optional[float] = None,
+    input_len: int = 256,
+    output_len: int = 32,
+    prefix_groups: int = 0,
+    prefix_blocks: int = 4,
+    seed: int = 0,
+) -> List[TraceRow]:
+    """Diurnal-swing trace: a non-homogeneous Poisson process whose
+    rate sweeps sinusoidally between ``rate_low_rps`` (the trough) and
+    ``rate_high_rps`` (the peak) over ``period_s`` (default: one full
+    cycle across the duration, starting AND ending at the trough so a
+    replay exercises scale-up into the peak and scale-down out of it).
+    ``rate_high_rps / rate_low_rps`` is the swing the autoscaling bench
+    provisions against (bench_planner_loop.py replays a 10× swing).
+
+    Arrivals come from Lewis–Shedler thinning against the peak rate, so
+    the instantaneous rate tracks the target curve exactly in
+    expectation."""
+    import math as _math
+
+    rng = random.Random(seed)
+    period = period_s or duration_s
+    peak = max(rate_high_rps, 1e-9)
+
+    def rate_at(t: float) -> float:
+        # trough at t=0 and t=period; peak at period/2
+        phase = (1.0 - _math.cos(2.0 * _math.pi * t / period)) / 2.0
+        return rate_low_rps + (rate_high_rps - rate_low_rps) * phase
+
+    rows: List[TraceRow] = []
+    t = 0.0
+    i = 0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            break
+        if rng.random() >= rate_at(t) / peak:
+            continue  # thinned: rate(t) below the envelope
+        hash_ids, isl, osl = _sample_row_shape(
+            rng, input_len, output_len, prefix_groups, prefix_blocks)
+        rows.append(TraceRow(
+            request_id=f"diurnal-{i}", input_length=isl, output_length=osl,
+            hash_ids=hash_ids, timestamp=round(t * 1000.0, 3),
+        ))
+        i += 1
     return rows

@@ -482,8 +482,15 @@ class MockEngine:
             return
         # chaos seam: crash ("fail") or wedge the scheduler on step N —
         # same seam name as JaxEngine._sched_step, so one chaos rule
-        # drives either engine
-        await chaos.ahit("engine.step", key=self.args.model_name)
+        # drives either engine.  The key carries the worker id when one
+        # is known so a rule can `match` a SINGLE worker of a fleet
+        # (straggler injection: delay one worker's steps, leave its
+        # siblings fast); substring matches on the model name keep
+        # working.
+        await chaos.ahit(
+            "engine.step",
+            key=(f"{self.args.model_name}:{self.publisher.worker_id}"
+                 if self.publisher is not None else self.args.model_name))
         # timeline spans: same kinds (and zero-cost-off None check) as
         # JaxEngine._sched_step, so obs.report decomposes a mocker run
         # with the same phase taxonomy.  Overlap sim: mid decode-only

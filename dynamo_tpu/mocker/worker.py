@@ -386,8 +386,14 @@ class MockerWorker:
         co-resident workers on the same runtime keep serving."""
         import time
 
+        from .. import chaos
         from ..protocols.model_card import deregister_model
 
+        # chaos: a worker that ignores drain (wedge) or whose drain
+        # raises (fail) — the connector's bounded wait must escalate to
+        # stop and the in-flight streams migrate via token replay
+        await chaos.ahit("worker.drain", key=str(
+            self.served.instance_id if self.served is not None else ""))
         for eng in getattr(self, "engines", []):
             eng.draining = True
         if self.served is not None:

@@ -39,7 +39,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..runtime.discovery import INSTANCE_PREFIX, Instance
+from ..runtime.discovery import INSTANCE_PREFIX, QUARANTINE_PREFIX, Instance
 from ..runtime.metrics import percentile
 from ..runtime.retry import RetryPolicy, call_with_retry
 
@@ -242,6 +242,26 @@ async def snapshot(discovery, namespace: Optional[str] = None,
     for inst in instances:
         primary.setdefault(inst.instance_id, inst)
 
+    # quarantine markers (runtime/discovery.py QUARANTINE_PREFIX): a
+    # held worker's routing keys are withdrawn, so without the marker it
+    # would silently vanish from this snapshot — the marker keeps it on
+    # the board as state="quarantined", and its system_addr keeps it
+    # scrapeable (the process is alive by design: lease-withdrawal mark,
+    # not a kill)
+    qsnap = await discovery.get_prefix(QUARANTINE_PREFIX + "/")
+    qrecs: List[dict] = []
+    for v in qsnap.values():
+        try:
+            iid = int(v["instance_id"])
+        except (KeyError, TypeError, ValueError):
+            continue  # corrupt marker must not kill the snapshot
+        if namespace and v.get("namespace") \
+                and v["namespace"] != namespace:
+            continue
+        if iid in primary:
+            continue  # readmission race: the restored live view wins
+        qrecs.append(v)
+
     by_addr: Dict[str, List[Instance]] = {}
     for inst in primary.values():
         addr = str(inst.metadata.get("system_addr", ""))
@@ -260,17 +280,26 @@ async def snapshot(discovery, namespace: Optional[str] = None,
                    and i.metadata.get("kind") != "frontend"
                    for i in insts)
 
+    # (addr -> (want_requests, want_kv)); quarantined workers scrape as
+    # worker-bearing addresses
+    plan: Dict[str, Tuple[bool, bool]] = {
+        addr: (_frontendish(insts), _workerish(insts))
+        for addr, insts in by_addr.items()}
+    for rec in qrecs:
+        addr = str(rec.get("system_addr", ""))
+        if addr and addr not in plan:
+            plan[addr] = (False, True)
+
     scraped: Dict[str, tuple] = {}
-    if by_addr:
+    if plan:
         import aiohttp
 
         async with aiohttp.ClientSession() as session:
             results = await asyncio.gather(
                 *(_scrape_addr(session, addr, token, timeout_s,
-                               want_requests=_frontendish(insts),
-                               want_kv=_workerish(insts))
-                  for addr, insts in by_addr.items()))
-        scraped = dict(zip(by_addr, results))
+                               want_requests=fr, want_kv=wk)
+                  for addr, (fr, wk) in plan.items()))
+        scraped = dict(zip(plan, results))
 
     workers: List[WorkerView] = []
     frontends: List[WorkerView] = []
@@ -329,6 +358,39 @@ async def snapshot(discovery, namespace: Optional[str] = None,
         else:
             workers.append(view)
 
+    for rec in qrecs:
+        iid = int(rec["instance_id"])
+        addr = str(rec.get("system_addr", ""))
+        view = WorkerView(
+            worker_id=iid, kind="unknown",
+            namespace=str(rec.get("namespace", "")),
+            component=str(rec.get("component", "")),
+            endpoint="", address="", system_addr=addr,
+            state="quarantined")
+        if not addr:
+            view.error = "no system_addr in quarantine marker"
+        else:
+            debug, metrics, _forensics, kv, err = scraped[addr]
+            view.error = err
+            view.metrics = metrics or {}
+            if kv is not None:
+                srcs = kv.get("sources") or {}
+                view.kv_ledger = next(
+                    (v for k, v in srcs.items()
+                     if k.endswith(f":{iid}")), None)
+            if debug is not None:
+                mine = next(
+                    (s for s in (debug.get("sources") or {}).values()
+                     if isinstance(s, dict)
+                     and s.get("instance_id") == iid), None)
+                view.debug = mine
+                if mine is not None:
+                    view.kind = str(mine.get("kind", "unknown"))
+        workers.append(view)
+
+    # quarantined workers are ON the board but OUT of the reductions:
+    # their ITL/load must not re-list them as stragglers (the planner's
+    # hold owns them) nor skew imbalance for the in-rotation fleet
     summary = summarize_states(
         [w.debug for w in workers if w.debug is not None
          and w.state == "live"],
@@ -340,6 +402,7 @@ async def snapshot(discovery, namespace: Optional[str] = None,
         unreachable=sum(w.state == "unreachable" for w in workers),
         kv_states=[w.kv_ledger for w in workers
                    if w.kv_ledger is not None],
+        quarantined=sum(w.state == "quarantined" for w in workers),
     )
     return FleetSnapshot(ts_unix=time.time(), workers=workers,
                          frontends=frontends, summary=summary)
@@ -392,7 +455,8 @@ def reduce_kv_ledgers(kv_states: List[dict]) -> Optional[dict]:
 def summarize_states(states: List[dict], frontend_states: List[dict] = (),
                      stale: int = 0, unreachable: int = 0,
                      stale_states: List[dict] = (),
-                     kv_states: List[dict] = ()) -> dict:
+                     kv_states: List[dict] = (),
+                     quarantined: int = 0) -> dict:
     """Reduce per-worker /debug/state dicts to the fleet headline:
     imbalance, stragglers, KV headroom, recompile hotspots, drain
     states, goodput spread.  Pure — no I/O — so benches and tests feed
@@ -402,7 +466,10 @@ def summarize_states(states: List[dict], frontend_states: List[dict] = (),
     dumps from partially-scraped workers — their load/KV/straggler data
     still folds into the reduction (real signal beats a blind spot) but
     they count under `stale`, not `live`, so worker counts stay disjoint
-    (live + stale + unreachable = workers)."""
+    (live + stale + unreachable + quarantined = workers).  `quarantined`
+    workers are counted but NEVER folded into the load/straggler/KV
+    reductions: they are out of rotation — the planner's hold owns
+    them, and their outlier ITL must not re-list them as stragglers."""
     live = len(states)
     states = list(states) + list(stale_states)
     toks = [int(s.get("tokens_in_flight", 0)) for s in states]
@@ -438,10 +505,14 @@ def summarize_states(states: List[dict], frontend_states: List[dict] = (),
     tails = [f["tail"] for f in frontend_states
              if isinstance(f.get("tail"), dict)]
     return {
-        "workers": live + stale + unreachable,
+        "workers": live + stale + unreachable + quarantined,
         "live": live,
         "stale": stale,
         "unreachable": unreachable,
+        # held out of rotation by the planner's straggler quarantine
+        # (discovery quarantine markers) — counted separately so the
+        # fleet does not appear to SHRINK while a worker is held
+        "quarantined": quarantined,
         "draining": sum(bool(s.get("draining")) for s in states),
         "active_seqs_total": sum(int(s.get("active_seqs", 0))
                                  for s in states),
@@ -534,7 +605,8 @@ def export_fleet_gauges(metrics, snap: FleetSnapshot,
         metrics.set("dynamo_fleet_draining",
                     1.0 if d.get("draining") else 0.0, worker=lbl)
     s = snap.summary
-    for state in ("live", "stale", "unreachable", "draining"):
+    for state in ("live", "stale", "unreachable", "draining",
+                  "quarantined"):
         metrics.set("dynamo_fleet_workers", float(s.get(state, 0)),
                     "worker count by scrape/drain state", state=state)
     metrics.set("dynamo_fleet_load_imbalance", float(s["imbalance"]))
@@ -673,7 +745,8 @@ def _human(snap: FleetSnapshot) -> str:
     lines = [
         f"fleet @ {time.strftime('%H:%M:%S', time.localtime(snap.ts_unix))}"
         f"  workers={s['workers']} (live={s['live']} stale={s['stale']} "
-        f"unreachable={s['unreachable']} draining={s['draining']})  "
+        f"unreachable={s['unreachable']} draining={s['draining']} "
+        f"quarantined={s.get('quarantined', 0)})  "
         f"frontends={s['frontends']}",
         f"  imbalance={s['imbalance']:.2f}  "
         f"stragglers={s['straggler_count']}  "

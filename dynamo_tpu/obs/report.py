@@ -468,31 +468,114 @@ def kv_accounting(dumps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+# ---------------------------------------------------------------------------
+# Planner actuation (planner/planner.py Planner.debug_state() dumps)
+# ---------------------------------------------------------------------------
+
+
+def planner_docs(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The planner control-plane states inside one JSON document: a raw
+    ``Planner.debug_state()`` dump, or a /debug/state response wrapping
+    a ``planner:{component}`` source."""
+    def _is_planner(v) -> bool:
+        return (isinstance(v, dict) and v.get("kind") == "planner"
+                and "decisions" in v)
+
+    out = [doc] if _is_planner(doc) else []
+    out.extend(v for v in (doc.get("sources") or {}).values()
+               if _is_planner(v))
+    return out
+
+
+def actuation_report(dumps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Reduce planner debug-state dumps to the actuation section: scale
+    decisions by direction, burn-forced scale-ups, quarantine
+    holds/strikes/event counts, spawn-governor failure and breaker
+    totals, and drain escalations — 'what did the control plane DO' as
+    one rollup next to the report's 'where did the time go'."""
+    planners = []
+    ups = downs = burn_ups = 0
+    q_events: Dict[str, int] = {}
+    held = 0
+    strikes = 0
+    spawn = {"failures_total": 0, "breaker_opens_total": 0,
+             "breaker_open": False}
+    drain_escalations = 0
+    for d in dumps:
+        decisions = [x for x in (d.get("decisions") or ())
+                     if isinstance(x, dict)]
+        for dec in decisions:
+            applied = dec.get("applied")
+            current = dec.get("current")
+            if applied is None or current is None:
+                continue
+            if applied > current:
+                ups += 1
+            elif applied < current:
+                downs += 1
+            if dec.get("burn_actuation"):
+                burn_ups += 1
+        q = d.get("quarantine") or {}
+        held += len(q.get("held") or {})
+        strikes += sum(int(n) for n in (q.get("strikes") or {}).values())
+        for ev in q.get("events") or ():
+            kind = str(ev.get("kind", "unknown"))
+            q_events[kind] = q_events.get(kind, 0) + 1
+        sp = d.get("spawn") or {}
+        spawn["failures_total"] += int(sp.get("failures_total", 0))
+        spawn["breaker_opens_total"] += \
+            int(sp.get("breaker_opens_total", 0))
+        spawn["breaker_open"] |= bool(sp.get("breaker_open"))
+        drain_escalations += int(d.get("drain_escalations", 0))
+        planners.append({
+            "component": d.get("component"),
+            "mode": d.get("mode"),
+            "phase": d.get("phase") or "any",
+            "decisions": len(decisions),
+        })
+    return {
+        "planners": planners,
+        "scale_ups": ups,
+        "scale_downs": downs,
+        "burn_actuations": burn_ups,
+        "quarantine": {"held": held, "strikes": strikes,
+                       "events": q_events},
+        "spawn": spawn,
+        "drain_escalations": drain_escalations,
+    }
+
+
 def report_paths(paths: Iterable[str], peak_tflops: float = 0.0,
                  peak_hbm_gbps: float = 0.0) -> Dict[str, Any]:
     """Reduce a mixed set of dumps: Chrome traces feed the gap/roofline
     sections, forensics dumps (/debug/requests or ForensicsPlane.dump
-    files) feed the tail-autopsy section, and kv-ledger dumps
-    (/debug/kv or fleet --json snapshots) feed the KV-accounting
-    section — pass any mix and the report carries what it finds."""
+    files) feed the tail-autopsy section, kv-ledger dumps (/debug/kv or
+    fleet --json snapshots) feed the KV-accounting section, and planner
+    debug-state dumps feed the actuation section — pass any mix and the
+    report carries what it finds."""
     events: List[Dict[str, Any]] = []
     tails: List[Dict[str, Any]] = []
     ledgers: List[Dict[str, Any]] = []
+    planners: List[Dict[str, Any]] = []
     for path in paths:
         with open(path) as f:
             doc = json.load(f)
         found = forensics_docs(doc)
         led = kv_ledger_docs(doc)
+        plans = planner_docs(doc)
         ledgers.extend(led)
+        planners.extend(plans)
         if found:
             tails.extend(found)
-        elif not led:
+        elif not led and not plans:
             events.extend(events_of_doc(doc))
     rep = report(events, peak_tflops, peak_hbm_gbps)
     if tails:
         rep["tail"] = tail_autopsy(tails)
     if ledgers:
         rep["kv"] = kv_accounting(ledgers)
+    if planners:
+        rep["actuation"] = actuation_report(planners)
     return rep
 
 
@@ -503,9 +586,10 @@ def main(argv=None) -> int:
                     "(DYN_TRACE_OUT / bench_serving.py --trace-out); "
                     "forensics dumps (/debug/requests JSON or "
                     "ForensicsPlane.dump files) additionally render "
-                    "the tail-autopsy section, and kv-ledger dumps "
+                    "the tail-autopsy section, kv-ledger dumps "
                     "(/debug/kv JSON or fleet --json snapshots) the "
-                    "KV-accounting section.")
+                    "KV-accounting section, and planner debug-state "
+                    "dumps the actuation section.")
     p.add_argument("paths", nargs="+",
                    help="Chrome trace JSON dump(s), dynamo.forensics.v1 "
                         "dumps, and/or dynamo.kv_ledger.v1 dumps")

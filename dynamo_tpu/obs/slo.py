@@ -131,9 +131,15 @@ class SloPlane:
         import secrets
 
         self.frontend_id = frontend_id or secrets.randbits(48)
-        # (finish_t, good) per finished request, pruned to the longest
-        # window; bounded hard so a breach storm can't grow unchecked
-        self._finished: Deque[Tuple[float, bool]] = deque(maxlen=65536)
+        # (finish_t, breach_reason-or-None) per finished request, pruned
+        # to the longest window; bounded hard so a breach storm can't
+        # grow unchecked.  Carrying the REASON (not just good/bad) is
+        # what lets burn attribute by phase: a TTFT burn means the
+        # prefill side is behind, an ITL burn the decode side — the
+        # planner's burn actuation scales the matching pool
+        # (planner/planner.py, the disagg P/D-ratio control input)
+        self._finished: Deque[Tuple[float, Optional[str]]] = \
+            deque(maxlen=65536)
         self._last_refresh_t = 0.0
         # one window scan serves refresh()+summary()+scrapes within its
         # TTL: the deque can hold 65536 entries and goodput()/
@@ -197,7 +203,7 @@ class SloPlane:
             self.m.inc("dynamo_frontend_slo_breach_total",
                        model=model, reason=reason)
         now = time.monotonic()
-        self._finished.append((now, good))
+        self._finished.append((now, reason))
         self._counts_cache = (0.0, None)  # new data: cached scan stale
         # gauge refresh walks the rolling deque (up to its 65536 cap):
         # throttle the per-finish path so a busy frontend doesn't pay an
@@ -209,11 +215,13 @@ class SloPlane:
     # -- rolling windows --------------------------------------------------
     _COUNTS_TTL_S = 0.2
 
-    def _window_counts(self, now: float) -> Dict[float, Tuple[int, int]]:
-        """{window_s: (total, good)} over the rolling deque — one full
-        scan, cached briefly so refresh/summary/scrape callers within
-        the same beat share it instead of each rescanning up to 65536
-        entries on the event loop."""
+    def _window_counts(
+            self, now: float) -> Dict[float, Tuple[int, int, Dict[str, int]]]:
+        """{window_s: (total, good, breaches-by-reason)} over the
+        rolling deque — one full scan, cached briefly so
+        refresh/summary/scrape callers within the same beat share it
+        instead of each rescanning up to 65536 entries on the event
+        loop."""
         cached_t, cached = self._counts_cache
         if cached is not None and 0.0 <= now - cached_t < self._COUNTS_TTL_S:
             return cached
@@ -221,14 +229,18 @@ class SloPlane:
         longest = max(c.windows_s)
         while self._finished and now - self._finished[0][0] > longest:
             self._finished.popleft()
-        out = {w: [0, 0] for w in c.windows_s}
-        for t, good in self._finished:
+        out = {w: [0, 0, {}] for w in c.windows_s}
+        for t, reason in self._finished:
             age = now - t
             for w in c.windows_s:
                 if age <= w:
                     out[w][0] += 1
-                    out[w][1] += int(good)
-        counts = {w: (tot, good) for w, (tot, good) in out.items()}
+                    if reason is None:
+                        out[w][1] += 1
+                    else:
+                        out[w][2][reason] = out[w][2].get(reason, 0) + 1
+        counts = {w: (tot, good, dict(reasons))
+                  for w, (tot, good, reasons) in out.items()}
         self._counts_cache = (now, counts)
         return counts
 
@@ -237,7 +249,7 @@ class SloPlane:
         if not self.config.targets_set:
             return None
         counts = self._window_counts(now or time.monotonic())
-        tot, good = counts[min(self.config.windows_s)]
+        tot, good, _ = counts[min(self.config.windows_s)]
         return good / tot if tot else None
 
     def burn_rates(self, now: Optional[float] = None) -> Dict[float, float]:
@@ -245,10 +257,30 @@ class SloPlane:
         c = self.config
         budget = max(1.0 - c.objective, 1e-6)
         out: Dict[float, float] = {}
-        for w, (tot, good) in self._window_counts(
+        for w, (tot, good, _) in self._window_counts(
                 now or time.monotonic()).items():
             if tot:
                 out[w] = ((tot - good) / tot) / budget
+        return out
+
+    def burn_by_phase(self, now: Optional[float] = None) -> Dict[str, float]:
+        """{breach reason: worst burn rate across windows} — the burn
+        split the planner's phase-attributed actuation consumes: a
+        ``ttft`` burn says the prefill pool is behind, an ``itl`` burn
+        the decode pool (``error``/``no_first_token`` count too — an
+        errored request burns budget regardless of phase).  Empty when
+        nothing breached in any window."""
+        c = self.config
+        budget = max(1.0 - c.objective, 1e-6)
+        out: Dict[str, float] = {}
+        for _w, (tot, _good, reasons) in self._window_counts(
+                now or time.monotonic()).items():
+            if not tot:
+                continue
+            for reason, n in reasons.items():
+                burn = (n / tot) / budget
+                if burn > out.get(reason, 0.0):
+                    out[reason] = burn
         return out
 
     def refresh(self) -> None:
@@ -275,13 +307,15 @@ class SloPlane:
     def summary(self) -> dict:
         now = time.monotonic()
         counts = self._window_counts(now)
-        tot, _good = counts[min(self.config.windows_s)]
+        tot, _good, _reasons = counts[min(self.config.windows_s)]
         g = self.goodput(now)
         return {
             "frontend_id": self.frontend_id,
             "goodput": 1.0 if g is None else g,
             "burn": {f"{int(w)}s": round(r, 4)
                      for w, r in self.burn_rates(now).items()},
+            "burn_by_phase": {k: round(v, 4)
+                              for k, v in self.burn_by_phase(now).items()},
             "requests": tot,
             "ttft_ms": self.config.ttft_ms,
             "itl_ms": self.config.itl_ms,

@@ -10,10 +10,11 @@ the way the reference's VirtualConnector/KubernetesConnector pair does
 tests, subprocess fleets for single-host deployments.
 """
 
-from .connectors import CallbackConnector, Connector, SubprocessConnector
+from .connectors import (CallbackConnector, Connector, SpawnGovernor,
+                         SubprocessConnector)
 from .metrics import LoadObserver
 from .perf_model import PerfModel
-from .planner import Planner, PlannerConfig
+from .planner import Planner, PlannerConfig, StragglerQuarantine
 from .predictor import make_predictor
 
 __all__ = [
@@ -23,6 +24,8 @@ __all__ = [
     "PerfModel",
     "Planner",
     "PlannerConfig",
+    "SpawnGovernor",
+    "StragglerQuarantine",
     "SubprocessConnector",
     "make_predictor",
 ]

@@ -52,6 +52,30 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--itl-target-ms", type=float, default=None)
     p.add_argument("--perf-model", default=None,
                    help="perf profile JSON (required for --mode sla)")
+    # burn-rate actuation (obs/slo.py burn_by_phase via the frontends'
+    # slo_metrics stream): fast burn forces scale-up ahead of the
+    # predictor; --phase scopes which breach reason actuates this
+    # planner instance (disagg P/D-ratio control: one planner per pool)
+    p.add_argument("--burn-up-threshold", type=float, default=2.0,
+                   help="SLO burn rate that forces +1 replica ahead of "
+                        "the load predictor (0 disables)")
+    p.add_argument("--phase", default="", choices=["", "prefill", "decode"],
+                   help="disagg pool this planner scales: TTFT burn "
+                        "actuates prefill, ITL burn decode, '' any")
+    # drain-gated scale-down + straggler quarantine
+    p.add_argument("--no-drain-scale-down", action="store_true",
+                   help="hard-stop victims instead of drain-gating "
+                        "scale-down")
+    p.add_argument("--no-quarantine", action="store_true",
+                   help="disable the straggler-quarantine actuation")
+    p.add_argument("--quarantine-hold-s", type=float, default=30.0,
+                   help="readmission delay for a quarantined straggler "
+                        "(doubles per flap)")
+    p.add_argument("--term-grace-s", type=float, default=15.0,
+                   help="subprocess scale-down: seconds between SIGTERM "
+                        "(triggers the worker's drain) and SIGKILL — "
+                        "size to the workers' --drain-deadline-s plus "
+                        "margin")
     # fleet introspection (obs/fleet.py): merged /metrics + /debug/state
     # scrapes folded into every tick's diag and exported as
     # dynamo_fleet_* gauges on this process's /metrics
@@ -81,7 +105,8 @@ async def main() -> None:
         if not args.worker_module:
             raise SystemExit("--connector subprocess needs "
                              "--worker-module")
-        connector = SubprocessConnector(args.worker_module, args.worker_arg)
+        connector = SubprocessConnector(args.worker_module, args.worker_arg,
+                                        term_grace_s=args.term_grace_s)
     fleet = None
     if args.fleet_scrape:
         from ..obs.fleet import FleetObserver
@@ -105,6 +130,11 @@ async def main() -> None:
             itl_target_s=(args.itl_target_ms / 1e3
                           if args.itl_target_ms else None),
             perf_model_path=args.perf_model,
+            burn_up_threshold=args.burn_up_threshold,
+            phase=args.phase,
+            drain_on_scale_down=not args.no_drain_scale_down,
+            quarantine=not args.no_quarantine,
+            quarantine_hold_s=args.quarantine_hold_s,
         ),
     )
     await connector.scale(args.min_replicas)

@@ -556,6 +556,10 @@ class FpmObserver(FpmWindow):
 class SloSample:
     goodput: float = 1.0
     max_burn: float = 0.0
+    # phase-attributed burn (obs/slo.py burn_by_phase): TTFT burn says
+    # the prefill side is behind, ITL burn the decode side — the
+    # planner's burn actuation scales the matching pool
+    burn_by_phase: dict = field(default_factory=dict)
     requests: int = 0
     seen_t: float = field(default_factory=time.monotonic)
 
@@ -601,10 +605,13 @@ class SloObserver:
                 if fid is None:
                     continue
                 burns = payload.get("burn") or {}
+                phases = payload.get("burn_by_phase") or {}
                 self.samples[fid] = SloSample(
                     goodput=float(payload.get("goodput", 1.0)),
                     max_burn=max((float(v) for v in burns.values()),
                                  default=0.0),
+                    burn_by_phase={str(k): float(v)
+                                   for k, v in phases.items()},
                     requests=int(payload.get("requests", 0)),
                 )
         except asyncio.CancelledError:
@@ -626,9 +633,15 @@ class SloObserver:
             goodput = sum(s.goodput * s.requests for s in live) / total
         else:
             goodput = min(s.goodput for s in live)
+        phases: Dict[str, float] = {}
+        for s in live:
+            for k, v in s.burn_by_phase.items():
+                if v > phases.get(k, 0.0):
+                    phases[k] = v
         return {
             "goodput": round(goodput, 4),
             "max_burn": round(max(s.max_burn for s in live), 4),
+            "burn_by_phase": {k: round(v, 4) for k, v in phases.items()},
             "requests": total,
             "frontends": len(live),
         }

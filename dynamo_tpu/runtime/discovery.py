@@ -33,6 +33,13 @@ logger = logging.getLogger(__name__)
 INSTANCE_PREFIX = "v1/instances"
 MDC_PREFIX = "v1/mdc"
 EVENT_ENDPOINT_PREFIX = "v1/events"
+# quarantine markers (planner straggler quarantine): one leased key per
+# held worker, `v1/quarantine/{instance_id}` — the breadcrumb that keeps
+# a withdrawn worker VISIBLE.  withdraw_instance deletes the worker's
+# routing keys, so without the marker the fleet aggregator (obs/fleet.py)
+# would silently shrink; with it the worker shows up as
+# state="quarantined" and stays scrapeable via the stashed system_addr.
+QUARANTINE_PREFIX = "v1/quarantine"
 
 
 def new_instance_id() -> int:
@@ -168,20 +175,127 @@ class DiscoveryBackend:
         """Re-register everything withdraw_lease stashed.  A put that
         fails partway (transient discovery outage) must keep the
         not-yet-restored keys stashed so the caller's retry (the next
-        canary probe's reconcile) can finish the job."""
+        canary probe's reconcile) can finish the job.
+
+        Keys whose instance is currently quarantine-marked
+        (QUARANTINE_PREFIX — the planner withdrew this worker's routing
+        identity while its process, and therefore its canary loop, kept
+        running) are DEFERRED, not restored: re-putting them would
+        resurrect the withdrawn identity mid-hold, silently routing
+        traffic back to a known straggler.  They stay stashed —
+        readmission restores the identity from the planner's own stash,
+        and this process re-owns the keys at its next recovery once the
+        marker is gone."""
         stash = getattr(self, "_withdrawn_values", {})
         self._withdrawn_values = {}
+        deferred: Dict[str, Dict[str, Any]] = {}
         try:
+            try:
+                marks = await self.get_prefix(QUARANTINE_PREFIX)
+            except Exception:
+                marks = {}  # marker read must not block recovery
+            held = {str(v.get("instance_id")) for v in marks.values()
+                    if isinstance(v, dict)}
             while stash:
                 key = next(iter(stash))
+                if key.rsplit("/", 1)[-1] in held:
+                    deferred[key] = stash.pop(key)
+                    logger.warning(
+                        "restore_lease: %s is quarantine-held; deferring "
+                        "its re-registration", key)
+                    continue
                 await self.put(key, stash[key])
                 stash.pop(key)
         finally:
-            if stash:
-                # failed partway: merge survivors back (a concurrent
-                # withdraw may have stashed new keys meanwhile)
-                for key, value in stash.items():
+            if stash or deferred:
+                # failed partway and/or deferred: merge survivors back (a
+                # concurrent withdraw may have stashed new keys meanwhile)
+                for key, value in (list(stash.items())
+                                   + list(deferred.items())):
                     self._withdrawn_values.setdefault(key, value)
+
+
+# ---------------------------------------------------------------------------
+# Third-party instance withdrawal (planner straggler quarantine)
+# ---------------------------------------------------------------------------
+
+
+async def withdraw_instance(discovery: "DiscoveryBackend",
+                            instance_id: int) -> Dict[str, Dict[str, Any]]:
+    """Withdraw ONE worker's routing identity from discovery ON ITS
+    BEHALF — the planner's straggler-quarantine actuation
+    (planner/planner.py): a lease-withdrawal MARK, not a process kill.
+    The quarantined worker keeps running (its load loop, canary and
+    debug surface stay up); routers just stop seeing it.
+
+    Deletes every key under the instance and MDC prefixes whose last
+    path segment is the instance id, and returns the stashed
+    key→value map :func:`restore_instance` re-registers on readmission.
+    Durable against the worker's own heartbeat because the heartbeat is
+    marker-gated: it refreshes existing keys, and re-registers a
+    missing owned key ONLY when no ``v1/quarantine/{id}`` marker covers
+    it (FileDiscovery._reclaim) — so the hold survives worker beats for
+    exactly as long as the holder's leased marker survives, and a
+    holder that dies without readmitting releases the worker instead of
+    orphaning it.  An empty stash means the instance was already gone
+    (raced a drain/crash) — nothing to hold."""
+    stash: Dict[str, Dict[str, Any]] = {}
+    suffix = f"/{int(instance_id)}"
+    for prefix in (INSTANCE_PREFIX, MDC_PREFIX):
+        snap = await discovery.get_prefix(prefix)
+        for k, v in snap.items():
+            if k.endswith(suffix):
+                stash[k] = v
+    for k in stash:
+        await discovery.delete(k)
+    return stash
+
+
+async def restore_instance(discovery: "DiscoveryBackend",
+                           stash: Dict[str, Dict[str, Any]]) -> None:
+    """Re-register a withdrawn instance's stashed keys (quarantine
+    readmission).  UNLEASED on the restorer's side: the worker still
+    owns the keys (its heartbeat kept them in `_owned` through the
+    hold), so it resumes refreshing the recreated paths immediately —
+    and the restorer's own clean exit must not revoke a healthy
+    worker's just-readmitted identity along with the restorer's lease."""
+    for k, v in stash.items():
+        await discovery.put(k, v, lease=False)
+
+
+async def mark_quarantined(discovery: "DiscoveryBackend", instance_id: int,
+                           stash: Dict[str, Dict[str, Any]],
+                           info: Optional[Dict[str, Any]] = None) -> None:
+    """Publish the quarantine breadcrumb for a withdrawn worker: a
+    leased ``v1/quarantine/{id}`` key carrying enough of the stashed
+    identity (namespace/component/system_addr) for the fleet aggregator
+    to keep the worker on the board — and keep SCRAPING it, since the
+    quarantined process is alive by design.  Leased under the holder's
+    lease ON PURPOSE: the marker IS the hold's liveness.  A clean
+    shutdown readmits via release_all; a holder that CRASHES mid-hold
+    lets the marker expire with its lease, and the worker's own
+    marker-gated heartbeat (FileDiscovery._reclaim) then restores the
+    withdrawn identity — a dead planner releases its holds instead of
+    orphaning workers."""
+    rec: Dict[str, Any] = {"instance_id": int(instance_id),
+                           "since_unix": time.time()}
+    for v in stash.values():
+        if not isinstance(v, dict):
+            continue
+        meta = v.get("metadata") or {}
+        if v.get("namespace") and "namespace" not in rec:
+            rec["namespace"] = v["namespace"]
+            rec["component"] = v.get("component", "")
+        if meta.get("system_addr") and "system_addr" not in rec:
+            rec["system_addr"] = meta["system_addr"]
+    rec.update(info or {})
+    await discovery.put(f"{QUARANTINE_PREFIX}/{int(instance_id)}", rec,
+                        lease=True)
+
+
+async def unmark_quarantined(discovery: "DiscoveryBackend",
+                             instance_id: int) -> None:
+    await discovery.delete(f"{QUARANTINE_PREFIX}/{int(instance_id)}")
 
 
 # ---------------------------------------------------------------------------
@@ -313,16 +427,63 @@ class FileDiscovery(DiscoveryBackend):
                 except asyncio.TimeoutError:
                     pass
                 continue
+            missing: List[str] = []
             for key in list(self._owned):
                 p = self._path(key)
                 try:
                     os.utime(p, None)
                 except FileNotFoundError:
-                    self._owned.discard(key)
+                    missing.append(key)
+            if missing:
+                await self._reclaim(missing)
             try:
                 await asyncio.wait_for(self._closed.wait(), timeout=self.ttl_s / 3)
             except asyncio.TimeoutError:
                 pass
+
+    async def _reclaim(self, missing: List[str]) -> None:
+        """Owned keys whose files were deleted EXTERNALLY (this
+        backend's own delete() pops ownership before unlinking).  Two
+        legitimate causes, told apart by the quarantine marker:
+
+          * a quarantine hold — the planner unlinked this worker's
+            routing identity and holds a leased ``v1/quarantine/{id}``
+            marker.  Leave the key down (but still owned, so the beat
+            keeps checking): the hold is exactly as alive as that
+            marker.
+          * lease expiry — the files were reaped while this process was
+            partitioned/suspended, or a holder died without readmitting
+            (its leased marker expired with it).  The process is
+            demonstrably back (it is heartbeating), so re-register.
+
+        The marker gate is what makes a planner CRASH self-healing: a
+        planner that dies mid-hold can never restore its in-memory
+        stash, but its marker expires with its lease and the worker
+        restores its own identity at the next beat instead of staying
+        unroutable forever."""
+        try:
+            marks = await self.get_prefix(QUARANTINE_PREFIX)
+        except Exception:
+            return  # cannot read markers this beat: change nothing
+        held = {str(v.get("instance_id")) for v in marks.values()
+                if isinstance(v, dict)}
+        for key in missing:
+            if key.rsplit("/", 1)[-1] in held:
+                continue  # quarantine hold: stays withdrawn, stays owned
+            value = self._owned_values.get(key)
+            if value is None:
+                self._owned.discard(key)
+                continue
+            try:
+                await self.put(key, value)
+                logger.warning(
+                    "file discovery: re-registered %s after external "
+                    "delete (lease expiry or a released/expired "
+                    "quarantine hold)", key)
+            except Exception:
+                logger.warning("file discovery: failed to re-register "
+                               "%s; retrying next beat", key,
+                               exc_info=True)
 
     async def put(self, key: str, value: Dict[str, Any], lease: bool = True) -> None:
         await chaos.ahit("discovery.op", key=f"put:{key}")

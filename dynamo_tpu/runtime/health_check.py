@@ -50,6 +50,51 @@ class HealthCheckConfig:
         )
 
 
+async def probe_endpoint(runtime, path: str, instance_id: Optional[int],
+                         payload: Dict[str, Any],
+                         timeout_s: float) -> Optional[bool]:
+    """One canary-style probe of a served endpoint through its OWN
+    handler: drains a tiny real request and judges success like the
+    canary loop does.  Returns True/False for a completed probe, or
+    None when the handler is not resolvable in this process (a
+    subprocess/remote worker) — callers with only a remote view (the
+    planner's quarantine re-probe) fall back to their delay rule.
+
+    Shared by SystemHealth's canary (which treats None as failure: its
+    own process MUST hold the handler) and the planner's quarantine
+    readmission probe."""
+    from .cancellation import CancellationToken
+    from .request_plane import RequestContext
+
+    handler = runtime.request_server._resolve_handler(path, instance_id)
+    if handler is None:
+        return None
+    payload = {**payload, "request_id": f"canary-{secrets.token_hex(6)}"}
+    token = CancellationToken()
+    ctx = RequestContext(payload["request_id"], token, {"canary": True})
+
+    async def drain() -> bool:
+        async for item in handler(payload, ctx):
+            if isinstance(item, dict) and (
+                    item.get("finish_reason") == "error"
+                    or "error" in item and item["error"]):
+                return False
+        return True
+
+    try:
+        return await asyncio.wait_for(drain(), timeout=timeout_s)
+    except asyncio.TimeoutError:
+        token.kill()  # free whatever the wedged probe holds
+        logger.warning("canary timed out on %s:%s", path, instance_id)
+        return False
+    except Exception:
+        logger.warning("canary failed on %s:%s", path, instance_id,
+                       exc_info=True)
+        return False
+    finally:
+        token.detach()
+
+
 @dataclass
 class _Target:
     path: str
@@ -157,38 +202,11 @@ class SystemHealth:
             # detected (ref health_check.rs keeps the task alive)
 
     async def _probe(self, t: _Target) -> bool:
-        from .cancellation import CancellationToken
-        from .request_plane import RequestContext
-
-        handler = self.runtime.request_server._resolve_handler(
-            t.path, t.instance_id)
-        if handler is None:
-            return False
-        payload = {**t.payload, "request_id": f"canary-{secrets.token_hex(6)}"}
-        token = CancellationToken()
-        ctx = RequestContext(payload["request_id"], token,
-                             {"canary": True})
-
-        async def drain() -> bool:
-            async for item in handler(payload, ctx):
-                if isinstance(item, dict) and (
-                        item.get("finish_reason") == "error"
-                        or "error" in item and item["error"]):
-                    return False
-            return True
-
-        try:
-            return await asyncio.wait_for(
-                drain(), timeout=self.config.request_timeout_s)
-        except asyncio.TimeoutError:
-            token.kill()  # free whatever the wedged canary holds
-            logger.warning("canary timed out on %s", t.subject)
-            return False
-        except Exception:
-            logger.warning("canary failed on %s", t.subject, exc_info=True)
-            return False
-        finally:
-            token.detach()
+        # None (handler deregistered from under us) counts as failure:
+        # this process MUST hold its own endpoint's handler
+        return await probe_endpoint(
+            self.runtime, t.path, t.instance_id, t.payload,
+            self.config.request_timeout_s) is True
 
     def _set_ready(self, t: _Target, ready: bool) -> None:
         t.ready = ready

@@ -169,3 +169,36 @@ def test_bench_serving_forensics_ab_streams_identical():
     assert rep["overhead_target_frac"] == 0.01
     assert rep["overhead_frac"] < 0.5, rep
     assert rep["tail"]["exemplars"] >= 1
+
+
+def test_bench_planner_loop_ab_closed_beats_static():
+    """bench_planner_loop --policy ab at smoke scale: the closed loop
+    must hold the latency targets with FEWER worker-seconds than static
+    max-provisioning and zero errors — the bench itself exits 1 when
+    the verdict fails, so the returncode is the acceptance gate.  The
+    swing is shortened (10s) but keeps the 10× trough→peak ratio; the
+    latency targets are generous because CI CPUs carry suite-parallel
+    contention."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_planner_loop.py"),
+         "--policy", "ab", "--duration-s", "10", "--rate-low", "0.4",
+         "--rate-high", "4.0", "--max-replicas", "3",
+         "--slo-ttft-ms", "2000", "--slo-itl-ms", "500"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    lines = [json.loads(line) for line in r.stdout.splitlines()
+             if line.startswith("{")]
+    by_cfg = {}
+    for rep in lines:
+        by_cfg.setdefault(rep["config"], []).append(rep)
+    (v,) = by_cfg["planner_loop_ab"]
+    assert v["ok"] is True, v
+    assert v["closed_worker_seconds"] < v["static_worker_seconds"]
+    closed = next(r for r in by_cfg["planner_loop"]
+                  if r["policy"] == "closed")
+    assert closed["errors"] == 0
+    # the loop actually moved: at least one scale action happened
+    assert sum(closed.get("actions", {}).values()) >= 1, closed

@@ -293,7 +293,8 @@ def test_fleet_gauges_scrape_contract():
     assert ups == {"1": 1.0, "2": 1.0, "3": 0.0}
     assert {s.labels["state"]: s.value
             for s in fleet_fams["dynamo_fleet_workers"].samples} == {
-        "live": 2.0, "stale": 0.0, "unreachable": 1.0, "draining": 0.0}
+        "live": 2.0, "stale": 0.0, "unreachable": 1.0, "draining": 0.0,
+        "quarantined": 0.0}
     # worker 3 leaves the fleet: its labels must not freeze in place
     snap2 = obs_fleet.FleetSnapshot(
         ts_unix=1.0, workers=[view(1), view(2)], frontends=[],

@@ -1,0 +1,896 @@
+"""Self-healing planner actuation (ISSUE 15): drain-gated scale-down
+proven token-identical under chaos, phase-attributed burn-rate scale-up,
+straggler quarantine with readmission, and the crashloop-proof EXECUTE
+(spawn backoff + circuit breaker).
+
+Every e2e scenario drives greedy requests through the real migration
+path (ModelPipeline.migration → Client → request plane → mocker worker)
+and asserts the actuated run's output is TOKEN-IDENTICAL to a fault-free
+run — the mocker's position-addressed token stream makes token-replay
+migration exact, same property greedy decoding has on the real engine."""
+
+import asyncio
+import time
+import uuid
+from collections import deque
+
+import pytest
+
+from dynamo_tpu import chaos
+from dynamo_tpu.frontend import ModelManager, ModelWatcher
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.planner import (
+    CallbackConnector,
+    Planner,
+    PlannerConfig,
+    SpawnGovernor,
+    StragglerQuarantine,
+    make_predictor,
+)
+from dynamo_tpu.protocols import (PreprocessedRequest, SamplingOptions,
+                                  StopConditions)
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+pytestmark = pytest.mark.chaos
+
+MODEL = "planner-model"
+
+
+def fresh_runtime() -> DistributedRuntime:
+    cfg = RuntimeConfig(discovery_backend="mem", event_plane="inproc")
+    return DistributedRuntime(config=cfg, cluster_id=uuid.uuid4().hex)
+
+
+def greedy_req(rid: str, max_tokens: int = 12,
+               seed: int = 1234) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        token_ids=[5, 6, 7, 8], request_id=rid,
+        sampling=SamplingOptions(temperature=0.0, seed=seed),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+
+
+async def collect(pipeline, req) -> list:
+    tokens = []
+    async for out in pipeline.migration.generate(req):
+        assert out.finish_reason != "error", out.error
+        tokens.extend(out.token_ids)
+    return tokens
+
+
+def make_connector(rt, args, drain_deadline_s=2.0, margin=0.3,
+                   component="mocker"):
+    """The bench/production shape: spawn/stop/drain of real mocker
+    workers, drain-gated scale-down with bounded escalation."""
+    return CallbackConnector(
+        spawn=lambda: MockerWorker(rt, args, component=component,
+                                   migration_limit=3).start(),
+        stop=lambda w: w.close(),
+        drain=lambda w, d: w.drain(deadline_s=d),
+        drain_deadline_s=drain_deadline_s,
+        drain_escalate_margin_s=margin)
+
+
+async def fleet_pipeline(rt, conn, n):
+    await conn.scale(n)
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager).start()
+    for _ in range(300):
+        if manager.get(MODEL):
+            break
+        await asyncio.sleep(0.01)
+    pipeline = manager.get(MODEL)
+    assert pipeline is not None
+    await pipeline.client.wait_for_instances()
+    for _ in range(300):
+        if len(pipeline.client.instances) == n:
+            break
+        await asyncio.sleep(0.01)
+    assert len(pipeline.client.instances) == n
+    return watcher, pipeline
+
+
+def engine_args(**kw):
+    base = dict(model_name=MODEL, block_size=4, base_step_s=0.0005,
+                prefill_s_per_token=0.0, decode_s_per_seq=0.0)
+    base.update(kw)
+    return MockEngineArgs(**base)
+
+
+def metric_value(rt, name, **labels):
+    """One sample's value off the runtime's own registry, matched by
+    sample name + label subset (the scrape-contract idiom)."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    for fam in text_string_to_metric_families(rt.metrics.render().decode()):
+        for s in fam.samples:
+            if s.name == name and all(s.labels.get(k) == v
+                                      for k, v in labels.items()):
+                return s.value
+    return None
+
+
+# --------------------------- spawn governor ------------------------------
+
+
+def test_spawn_governor_backoff_and_breaker():
+    g = SpawnGovernor(backoff_base_s=1.0, backoff_max_s=8.0,
+                      breaker_threshold=3, breaker_reset_s=10.0)
+    t = 100.0
+    assert g.allow(t)
+    assert g.record_failure(t) is False
+    # exponential backoff: blocked now, allowed after base
+    assert g.why_blocked(t) == "backoff"
+    assert g.allow(t + 1.1)
+    assert g.record_failure(t + 1.1) is False   # backoff now 2s
+    assert g.why_blocked(t + 2.0) == "backoff"
+    assert g.allow(t + 3.2)
+    # third consecutive failure trips the breaker — exactly one OPEN
+    # transition reported
+    assert g.record_failure(t + 3.2) is True
+    assert g.why_blocked(t + 4.0) == "breaker_open"
+    assert g.breaker_opens_total == 1
+    # still open through the cool-off, half-open after
+    assert g.why_blocked(t + 13.0) == "breaker_open"
+    assert g.allow(t + 13.3)
+    # a failed half-open probe re-opens (a new transition)
+    assert g.record_failure(t + 13.3) is True
+    assert g.breaker_opens_total == 2
+    # success closes everything
+    g.record_success()
+    assert g.allow(t + 13.4) and g.failures == 0
+    st = g.state()
+    assert st["failures_total"] == 4 and st["successes_total"] == 1
+    assert st["breaker_open"] is False
+
+
+# ------------------------ burn-rate actuation ----------------------------
+
+
+class _FakeConnector:
+    def __init__(self, replicas=1):
+        self.replicas = replicas
+        self.calls = []
+
+    async def current_replicas(self):
+        return self.replicas
+
+    async def scale(self, n):
+        self.calls.append(("scale", n))
+        self.replicas = n
+        return n
+
+    async def drain(self, n):
+        self.calls.append(("drain", n))
+        self.replicas = n
+        return n
+
+
+class _FakeObserver:
+    def __init__(self, load=None):
+        self.load = load
+
+    def aggregate(self):
+        return self.load
+
+
+class _FakeSlo:
+    def __init__(self, agg):
+        self.agg = agg
+
+    def aggregate(self):
+        return self.agg
+
+
+def _bare_planner(cfg, conn, slo=None):
+    p = Planner.__new__(Planner)
+    p.config = cfg
+    p.connector = conn
+    p.observer = _FakeObserver()
+    p.predictor = make_predictor("constant")
+    p._task = None
+    p._last_action_t = 0.0
+    p._low_ticks = 0
+    p.decisions = deque()
+    if slo is not None:
+        p.slo = slo
+    return p
+
+
+async def test_burn_actuation_scales_up_by_phase():
+    """A fast TTFT burn forces +1 on a prefill-phase (and whole-fleet)
+    planner ahead of the predictor; a decode-phase planner ignores it —
+    the split that controls the disagg P/D ratio."""
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    slo = _FakeSlo({"goodput": 0.4, "max_burn": 30.0,
+                    "burn_by_phase": {"ttft": 30.0}})
+    load = AggregateLoad(workers=1, active_seqs=2, mean_kv_usage=0.1)
+
+    def planner(phase):
+        cfg = PlannerConfig(min_replicas=1, max_replicas=4, cooldown_s=0.0,
+                            target_active_per_replica=4.0,
+                            burn_up_threshold=2.0, phase=phase)
+        p = _bare_planner(cfg, _FakeConnector(replicas=1), slo=slo)
+        p.observer.load = load
+        return p
+
+    # prefill pool: TTFT burn actuates — predictor alone proposed 1
+    p = planner("prefill")
+    assert await p.tick() == 2
+    assert p.last_diag["burn_actuation"]["phase"] == "prefill"
+    assert p.last_diag["slo_burn_by_phase"] == {"ttft": 30.0}
+    # decode pool: a TTFT burn is NOT its signal
+    p = planner("decode")
+    assert await p.tick() is None
+    assert "burn_actuation" not in p.last_diag
+    # whole-fleet pool: any burn (max_burn) actuates
+    p = planner("")
+    assert await p.tick() == 2
+    assert p.last_diag["burn_actuation"]["phase"] == "any"
+    # below the threshold: no forcing
+    quiet = _FakeSlo({"goodput": 0.995, "max_burn": 0.5,
+                      "burn_by_phase": {"ttft": 0.5}})
+    p = planner("prefill")
+    p.slo = quiet
+    assert await p.tick() is None
+
+
+async def test_burn_actuation_respects_max_replicas():
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    cfg = PlannerConfig(min_replicas=1, max_replicas=2, cooldown_s=0.0,
+                        burn_up_threshold=2.0)
+    p = _bare_planner(cfg, _FakeConnector(replicas=2),
+                      slo=_FakeSlo({"goodput": 0.0, "max_burn": 99.0,
+                                    "burn_by_phase": {"itl": 99.0}}))
+    p.observer.load = AggregateLoad(workers=2, active_seqs=2,
+                                    mean_kv_usage=0.1)
+    assert await p.tick() is None  # already at max: burn cannot exceed it
+
+
+def test_slo_plane_burn_by_phase_attribution():
+    """obs/slo.py: breach reasons carry through the rolling window into
+    per-phase burn — TTFT breaches attribute to 'ttft', ITL to 'itl',
+    and the published summary carries the split end to end."""
+    from dynamo_tpu.obs.slo import SloConfig, SloPlane
+    from dynamo_tpu.runtime.metrics import MetricsHierarchy
+
+    class _T:
+        model = "m"
+
+    plane = SloPlane(MetricsHierarchy().scoped(component="frontend"),
+                     SloConfig(ttft_ms=100.0, itl_ms=50.0,
+                               objective=0.9, windows_s=(60.0, 300.0)))
+    rec = lambda ttft, itl: {"request": {
+        "outcome": "ok", "total_time_ms": 500.0, "ttft_ms": ttft,
+        "avg_itl_ms": itl}}
+    for _ in range(6):
+        plane.observe_finish(_T(), rec(20.0, 10.0))    # good
+    for _ in range(3):
+        plane.observe_finish(_T(), rec(500.0, 10.0))   # ttft breach
+    plane.observe_finish(_T(), rec(20.0, 200.0))       # itl breach
+    phases = plane.burn_by_phase()
+    # 3/10 ttft-bad over a 0.1 budget = burn 3.0; 1/10 itl-bad = 1.0
+    assert phases["ttft"] == pytest.approx(3.0)
+    assert phases["itl"] == pytest.approx(1.0)
+    s = plane.summary()
+    assert s["burn_by_phase"]["ttft"] == pytest.approx(3.0)
+    # total burn covers both: 4/10 over 0.1 budget
+    assert max(s["burn"].values()) == pytest.approx(4.0)
+
+
+async def test_slo_observer_aggregates_burn_by_phase():
+    """SloObserver (planner side) folds each frontend's burn_by_phase
+    into the per-phase max the tick's actuation reads."""
+    from dynamo_tpu.planner.metrics import SloObserver
+
+    rt = await fresh_runtime().start()
+    try:
+        obs_ = await SloObserver(rt, "dynamo").start()
+        for _ in range(200):
+            # re-publish until the subscription has ingested both
+            # frontends (subscribe setup races the first publish)
+            for fid, phases in ((1, {"ttft": 5.0}),
+                                (2, {"ttft": 2.0, "itl": 7.0})):
+                await rt.event_plane.publish("slo_metrics.dynamo", {
+                    "frontend_id": fid, "goodput": 0.5,
+                    "burn": {"60s": max(phases.values())},
+                    "burn_by_phase": phases, "requests": 10})
+            await asyncio.sleep(0.01)
+            if len(obs_.samples) == 2:
+                break
+        agg = obs_.aggregate()
+        assert agg["burn_by_phase"] == {"ttft": 5.0, "itl": 7.0}
+        await obs_.close()
+    finally:
+        await rt.shutdown()
+
+
+# ---------------------- drain-gated scale-down ---------------------------
+
+
+async def test_drain_gated_scale_down_token_identical():
+    """Planner RECONCILE scales 2→1 during live traffic through
+    connector.drain(): the victim's routing identity is withdrawn, its
+    in-flight streams finish or migrate via token replay, and every
+    stream is TOKEN-IDENTICAL to the fault-free run.  The actuation
+    lands in dynamo_planner_actuations_total{kind=scale_down}."""
+    rt = await fresh_runtime().start()
+    try:
+        args = engine_args(decode_s_per_seq=0.01)  # slow: streams in flight
+        conn = make_connector(rt, args, drain_deadline_s=2.0)
+        watcher, pipeline = await fleet_pipeline(rt, conn, 2)
+        baseline = {}
+        for i in range(4):
+            baseline[i] = await collect(
+                pipeline, greedy_req(f"ff-{i}", 12, seed=300 + i))
+
+        planner = Planner(
+            rt, "dynamo", "mocker", conn,
+            config=PlannerConfig(min_replicas=1, max_replicas=2,
+                                 cooldown_s=0.0, down_stable_ticks=1,
+                                 target_active_per_replica=8.0,
+                                 predictor="constant"))
+        await planner.observer.start()  # manual ticks
+
+        tasks = [asyncio.create_task(collect(
+            pipeline, greedy_req(f"ch-{i}", 12, seed=300 + i)))
+            for i in range(4)]
+        for _ in range(300):
+            if any(e.num_active_seqs for w in conn.handles
+                   for e in w.engines):
+                break
+            await asyncio.sleep(0.01)
+        # wait until the load observer sees the fleet (otherwise the
+        # telemetry-loss guard holds)
+        for _ in range(300):
+            await asyncio.sleep(0.01)
+            if planner.observer.aggregate().workers == 2:
+                break
+        victim = conn.handles[-1]  # newest is drained first
+        victim_key = victim.served.instance.key()
+        applied = await planner.tick()
+        assert applied == 1, planner.last_diag
+        results = await asyncio.gather(*tasks)
+        for i, tokens in enumerate(results):
+            assert tokens == baseline[i], f"request {i} diverged"
+        # the victim's routing identity is gone; no escalation needed
+        assert victim_key not in await rt.discovery.get_prefix(
+            "v1/instances")
+        assert conn.drain_escalations == 0
+        assert len(conn.handles) == 1
+        assert metric_value(rt, "dynamo_planner_actuations_total",
+                            kind="scale_down") == 1.0
+
+        await planner.close()
+        await watcher.close()
+        await conn.close()
+    finally:
+        await rt.shutdown()
+
+
+async def test_scale_down_escalates_past_drain_ignoring_worker():
+    """Chaos worker.drain wedge: the victim IGNORES drain.  The
+    connector's bounded wait escalates to the hard stop, the orphaned
+    streams migrate via token replay, and the output stays
+    token-identical — scale-down can never hang on a sick worker."""
+    rt = await fresh_runtime().start()
+    try:
+        args = engine_args(decode_s_per_seq=0.01)
+        conn = make_connector(rt, args, drain_deadline_s=0.15, margin=0.2)
+        watcher, pipeline = await fleet_pipeline(rt, conn, 2)
+        baseline = {}
+        for i in range(3):
+            baseline[i] = await collect(
+                pipeline, greedy_req(f"ff2-{i}", 12, seed=400 + i))
+
+        plane = chaos.ChaosPlane(seed=7).rule("worker.drain", "wedge",
+                                              times=1)
+        with plane:
+            tasks = [asyncio.create_task(collect(
+                pipeline, greedy_req(f"ch2-{i}", 12, seed=400 + i)))
+                for i in range(3)]
+            for _ in range(300):
+                if any(e.num_active_seqs for w in conn.handles
+                       for e in w.engines):
+                    break
+                await asyncio.sleep(0.01)
+            applied = await conn.drain(1)
+            assert applied == 1
+            results = await asyncio.gather(*tasks)
+        assert plane.fired("worker.drain") == 1
+        assert conn.drain_escalations == 1
+        for i, tokens in enumerate(results):
+            assert tokens == baseline[i], f"request {i} diverged"
+
+        await watcher.close()
+        await conn.close()
+    finally:
+        await rt.shutdown()
+
+
+# ------------------------ straggler quarantine ---------------------------
+
+
+async def test_quarantine_withdraw_hold_probe_readmit():
+    """Unit-ish: a straggler's discovery keys are withdrawn (instance +
+    MDC), held for the delay rule, canary re-probed through the real
+    in-process handler, and restored; a re-quarantine doubles the hold
+    (flap hysteresis); a 1-worker fleet is never quarantined."""
+    rt = await fresh_runtime().start()
+    try:
+        w1 = await MockerWorker(rt, engine_args()).start()
+        w2 = await MockerWorker(rt, engine_args()).start()
+        iid = w1.served.instance_id
+        q = StragglerQuarantine(rt.discovery, namespace="dynamo",
+                                component="mocker", hold_s=0.3,
+                                flap_factor=2.0, probe=True, runtime=rt)
+        actions = await q.reconcile({"live": 2, "stragglers": [iid]})
+        assert [a["kind"] for a in actions] == ["quarantine"]
+        assert iid in q.held and len(q.held[iid].keys) >= 2  # inst + MDC
+        # routing identity gone, but the quarantine breadcrumb marks it
+        for prefix in ("v1/instances", "v1/mdc"):
+            snap = await rt.discovery.get_prefix(prefix)
+            assert not any(k.endswith(f"/{iid}") for k in snap)
+        marker = await rt.discovery.get_prefix("v1/quarantine")
+        assert [v["instance_id"] for v in marker.values()] == [iid]
+        # held: a second tick does nothing new before the hold expires
+        assert await q.reconcile({"live": 1, "stragglers": []}) == []
+        await asyncio.sleep(0.35)
+        # delay rule expired → canary re-probe (real generate handler)
+        # passes → readmitted, keys restored
+        actions = await q.reconcile({"live": 1, "stragglers": []})
+        assert [a["kind"] for a in actions] == ["readmit"]
+        snap = await rt.discovery.get_prefix("v1")
+        assert any(k.endswith(f"/{iid}") for k in snap)
+        assert not await rt.discovery.get_prefix("v1/quarantine")
+        # flap: the repeat offender's hold starts doubled
+        actions = await q.reconcile({"live": 2, "stragglers": [iid]})
+        assert actions[0]["kind"] == "quarantine"
+        assert actions[0]["hold_s"] == pytest.approx(0.6)
+        await q.release_all()  # cleanup restores the fleet
+        # cap: the last in-rotation worker is never quarantined
+        q2 = StragglerQuarantine(rt.discovery, namespace="dynamo",
+                                 component="mocker", hold_s=0.3,
+                                 runtime=rt)
+        assert await q2.reconcile(
+            {"live": 1, "stragglers": [w2.served.instance_id]}) == []
+        await w1.close()
+        await w2.close()
+    finally:
+        await rt.shutdown()
+
+
+async def test_chaos_delayed_straggler_quarantined_and_readmitted():
+    """Acceptance e2e: ONE worker of three gets chaos-delayed
+    engine.step ticks → its decode ITL p95 becomes a fleet outlier →
+    the planner tick quarantines it (lease-withdrawal mark: routers
+    drop it, the process keeps running) → after the delay rule expires
+    the canary re-probe passes and the planner readmits it — all
+    visible in dynamo_planner_* metrics."""
+    from dynamo_tpu.obs.fleet import summarize_states
+
+    rt = await fresh_runtime().start()
+    try:
+        args = engine_args(base_step_s=0.002)
+        conn = make_connector(rt, args)
+        watcher, pipeline = await fleet_pipeline(rt, conn, 3)
+        workers = list(conn.handles)
+        straggler = workers[0]
+        s_iid = straggler.served.instance_id
+
+        class _Fleet:
+            """The obs.fleet adapter: summarize the IN-ROTATION workers
+            (a quarantined worker's discovery keys are gone, so the
+            real aggregator would not see it either)."""
+
+            def summary(self):
+                held = (planner.quarantine.held
+                        if planner.quarantine else {})
+                states = [w.debug_state() for w in workers
+                          if w.served.instance_id not in held]
+                return summarize_states(states)
+
+        planner = Planner(
+            rt, "dynamo", "mocker", conn, fleet=_Fleet(),
+            config=PlannerConfig(min_replicas=3, max_replicas=3,
+                                 quarantine_hold_s=0.5,
+                                 predictor="constant"))
+        await planner.observer.start()
+
+        # chaos-delay ONLY the straggler's steps (key carries the
+        # worker id); times bounds it so the delay rule expires
+        plane = chaos.ChaosPlane(seed=3).rule(
+            "engine.step", "delay", delay_s=0.05, match=f":{s_iid}",
+            times=200)
+        with plane:
+            jobs = [asyncio.create_task(collect(
+                pipeline, greedy_req(f"load-{i}", 24, seed=500 + i)))
+                for i in range(9)]
+            # wait for decode FPM windows to show the outlier
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                await asyncio.sleep(0.1)
+                s = summarize_states([w.debug_state() for w in workers])
+                if s_iid in s["stragglers"]:
+                    break
+            assert s_iid in s["stragglers"], s
+            await planner.tick()
+            assert s_iid in planner.quarantine.held, planner.last_diag
+            assert planner.last_diag["quarantined"] == [s_iid]
+            # routers dropped it: only 2 instances remain visible
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                if len(pipeline.client.instances) == 2:
+                    break
+            assert len(pipeline.client.instances) == 2
+            await asyncio.gather(*jobs)  # in-flight work still completes
+        # the worker process is alive (mark, not kill)
+        assert not straggler.engines[0].dead
+        # delay rule expired + hold elapsed → readmission
+        await asyncio.sleep(0.55)
+        await planner.tick()
+        assert s_iid not in planner.quarantine.held, planner.last_diag
+        for _ in range(300):
+            await asyncio.sleep(0.01)
+            if len(pipeline.client.instances) == 3:
+                break
+        assert len(pipeline.client.instances) == 3
+        assert metric_value(rt, "dynamo_planner_actuations_total",
+                            kind="quarantine") == 1.0
+        assert metric_value(rt, "dynamo_planner_actuations_total",
+                            kind="readmit") == 1.0
+
+        await planner.close()
+        await watcher.close()
+        await conn.close()
+    finally:
+        await rt.shutdown()
+
+
+async def test_burn_up_counted_only_when_action_lands():
+    """The burn_up counter records landed actuations, not proposals: a
+    burn that persists under cooldown must not inflate the counter every
+    tick."""
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    slo = _FakeSlo({"goodput": 0.4, "max_burn": 30.0,
+                    "burn_by_phase": {"ttft": 30.0}})
+    cfg = PlannerConfig(min_replicas=1, max_replicas=4, cooldown_s=3600.0,
+                        burn_up_threshold=2.0)
+    p = _bare_planner(cfg, _FakeConnector(replicas=1), slo=slo)
+    p.observer.load = AggregateLoad(workers=1, active_seqs=2,
+                                    mean_kv_usage=0.1)
+    counted = []
+    p._count = counted.append
+    p._last_action_t = time.monotonic()  # cooldown holds the action
+    for _ in range(3):
+        assert await p.tick() is None
+        assert p.last_diag["burn_actuation"]  # still diagnosed per tick
+    assert counted == []  # nothing landed, nothing counted
+    p.config = PlannerConfig(min_replicas=1, max_replicas=4,
+                             cooldown_s=0.0, burn_up_threshold=2.0)
+    assert await p.tick() == 2
+    assert counted == ["scale_up", "burn_up"]
+
+
+async def test_governor_blocked_execute_is_not_an_actuation():
+    """EXECUTE that moves nothing (spawn governor blocking) must not
+    count an actuation, consume the cooldown, or record a decision —
+    the next tick retries the moment the governor allows."""
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    class _Blocked(_FakeConnector):
+        async def scale(self, n):
+            self.calls.append(("scale", n))
+            return self.replicas  # governor refused: nothing moved
+
+    cfg = PlannerConfig(min_replicas=1, max_replicas=4, cooldown_s=30.0,
+                        target_active_per_replica=2.0)
+    conn = _Blocked(replicas=1)
+    p = _bare_planner(cfg, conn)
+    p.observer.load = AggregateLoad(workers=1, active_seqs=8,
+                                    mean_kv_usage=0.1)
+    counted = []
+    p._count = counted.append
+    assert await p.tick() is None
+    assert counted == [] and list(p.decisions) == []
+    assert p._last_action_t == 0.0  # cooldown NOT consumed
+    # the moment the connector can move again, the same tick shape acts
+    conn.scale = _FakeConnector.scale.__get__(conn)
+    assert await p.tick() == 3
+    assert counted == ["scale_up"]
+
+
+async def test_scale_down_held_while_quarantine_holds_a_worker():
+    """A held worker keeps publishing near-idle load; acting on that dip
+    would drain a HEALTHY worker while the fleet is degraded — scale-down
+    waits for the quarantine to resolve."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    cfg = PlannerConfig(min_replicas=1, max_replicas=4, cooldown_s=0.0,
+                        down_stable_ticks=1)
+    conn = _FakeConnector(replicas=3)
+    p = _bare_planner(cfg, conn)
+    p.observer.load = AggregateLoad(workers=3, active_seqs=0,
+                                    mean_kv_usage=0.0)
+    p.quarantine = SimpleNamespace(held={7: object()})
+    assert await p.tick() is None
+    assert p.last_diag["scale_down_held_by_quarantine"] == 1
+    assert conn.calls == []
+    # hold resolved: the same dip now scales down normally
+    p.quarantine = None
+    assert await p.tick() == 1
+    assert conn.calls == [("drain", 1)]
+
+
+async def test_restore_lease_defers_quarantine_held_keys():
+    """A quarantined worker's own canary fail→recover cycle
+    (withdraw_lease/restore_lease on ITS backend instance) must not
+    resurrect the routing keys the planner withdrew mid-hold."""
+    from dynamo_tpu.runtime.discovery import make_discovery
+
+    cluster = uuid.uuid4().hex
+    worker_d = make_discovery("mem", cluster_id=cluster)
+    planner_d = make_discovery("mem", cluster_id=cluster)
+    await worker_d.start()
+    await planner_d.start()
+    key = "v1/instances/dynamo/mocker/generate/77"
+    await worker_d.put(key, {"namespace": "dynamo", "component": "mocker",
+                             "endpoint": "generate", "instance_id": 77,
+                             "address": "h:1", "metadata": {}})
+    # planner quarantines: keys withdrawn + marker published
+    q = StragglerQuarantine(planner_d, namespace="dynamo",
+                            component="mocker", hold_s=60.0, probe=False)
+    await q.reconcile({"live": 2, "stragglers": [77]})
+    assert key not in await planner_d.get_prefix("v1/instances")
+    # the worker's canary fails then recovers: restore must DEFER
+    await worker_d.withdraw_lease()
+    await worker_d.restore_lease()
+    assert key not in await worker_d.get_prefix("v1/instances")
+    assert key in worker_d._withdrawn_values  # stash kept, not lost
+    # readmission restores the identity; the worker's next recovery
+    # cycle re-owns the key now the marker is gone
+    await q.release_all()
+    assert key in await worker_d.get_prefix("v1/instances")
+    await worker_d.restore_lease()
+    assert key in await worker_d.get_prefix("v1/instances")
+    await worker_d.close()
+    await planner_d.close()
+
+
+async def test_file_heartbeat_reclaims_after_holder_crash(tmp_path):
+    """FileDiscovery: a quarantine hold is exactly as alive as the
+    holder's leased marker.  While the marker is fresh the worker's
+    heartbeat leaves its withdrawn identity down; a holder that CRASHES
+    without readmitting lets the marker expire, and the worker
+    re-registers itself at the next beat — and a readmitted identity is
+    unleased on the restorer's side, so the restorer's clean exit never
+    revokes it."""
+    from dynamo_tpu.runtime.discovery import (INSTANCE_PREFIX,
+                                              FileDiscovery,
+                                              mark_quarantined,
+                                              restore_instance,
+                                              withdraw_instance)
+
+    key = INSTANCE_PREFIX + "/dynamo/mocker/generate/99"
+    val = {"namespace": "dynamo", "component": "mocker",
+           "endpoint": "generate", "instance_id": 99, "address": "h:1",
+           "metadata": {}}
+    worker = FileDiscovery(str(tmp_path), ttl_s=0.6)
+    planner = FileDiscovery(str(tmp_path), ttl_s=0.6)
+    try:
+        await worker.put(key, val)
+        stash = await withdraw_instance(planner, 99)
+        await mark_quarantined(planner, 99, stash)
+        # marker fresh (holder heartbeating): the worker's beats must
+        # NOT resurrect the withdrawn identity
+        await asyncio.sleep(0.8)
+        assert key not in await worker.get_prefix(INSTANCE_PREFIX)
+        # holder crashes: heartbeat stops, no readmission ran.  The
+        # marker ages past TTL, the worker's reclaim reaps it and
+        # restores its own identity.
+        planner._closed.set()
+        for _ in range(40):
+            await asyncio.sleep(0.1)
+            if key in await worker.get_prefix(INSTANCE_PREFIX):
+                break
+        assert key in await worker.get_prefix(INSTANCE_PREFIX)
+        # clean-path lease ownership: readmission re-puts UNLEASED, so
+        # the restorer's close() cannot revoke the worker's identity
+        restorer = FileDiscovery(str(tmp_path), ttl_s=0.6)
+        stash = await withdraw_instance(restorer, 99)
+        await restore_instance(restorer, stash)
+        await restorer.close()
+        assert key in await worker.get_prefix(INSTANCE_PREFIX)
+    finally:
+        await worker.close()
+        await planner.close()
+
+
+async def test_quarantined_worker_stays_on_fleet_board():
+    """A held worker's routing keys are gone, but the quarantine marker
+    keeps it in obs.fleet snapshots as state='quarantined' — the fleet
+    must not appear to shrink while the planner holds a worker."""
+    from dynamo_tpu.obs import fleet as obs_fleet
+    from dynamo_tpu.runtime.metrics import MetricsHierarchy
+
+    rt = await fresh_runtime().start()
+    try:
+        w1 = await MockerWorker(rt, engine_args()).start()
+        w2 = await MockerWorker(rt, engine_args()).start()
+        iid = w1.served.instance_id
+        q = StragglerQuarantine(rt.discovery, namespace="dynamo",
+                                component="mocker", hold_s=30.0,
+                                probe=False, runtime=rt)
+        await q.reconcile({"live": 2, "stragglers": [iid]})
+        snap = await obs_fleet.snapshot(rt.discovery)
+        held = [w for w in snap.workers if w.state == "quarantined"]
+        assert [w.worker_id for w in held] == [iid]
+        assert snap.summary["quarantined"] == 1
+        # counts stay disjoint and the fleet size holds at 2
+        assert snap.summary["workers"] == 2
+        assert iid not in snap.summary["stragglers"]
+        # the state label exports on the worker-count gauge family
+        from prometheus_client.parser import \
+            text_string_to_metric_families
+
+        m = MetricsHierarchy().scoped(component="fleet")
+        obs_fleet.export_fleet_gauges(m, snap)
+        held_gauge = [
+            s.value for fam in
+            text_string_to_metric_families(m.render().decode())
+            for s in fam.samples
+            if s.name == "dynamo_fleet_workers"
+            and s.labels.get("state") == "quarantined"]
+        assert held_gauge == [1.0]
+        # readmission clears the marker: the board shows 2 in rotation
+        await q.release_all()
+        snap = await obs_fleet.snapshot(rt.discovery)
+        assert snap.summary["quarantined"] == 0
+        assert snap.summary["workers"] == 2
+        await w1.close()
+        await w2.close()
+    finally:
+        await rt.shutdown()
+
+
+def test_report_actuation_section(tmp_path):
+    """obs.report reduces a /debug/state dump carrying a planner source
+    into the actuation section: scale directions, burn actuations,
+    quarantine events, spawn/breaker totals, drain escalations."""
+    import json
+
+    from dynamo_tpu.obs.report import report_paths
+
+    doc = {"sources": {"planner:mocker": {
+        "kind": "planner", "namespace": "dynamo", "component": "mocker",
+        "mode": "load", "phase": "",
+        "last_diag": {},
+        "decisions": [
+            {"current": 1, "applied": 2,
+             "burn_actuation": {"burn": 5.0}},
+            {"current": 2, "applied": 3},
+            {"current": 3, "applied": 1},
+        ],
+        "quarantine": {
+            "held": {"42": {"hold_s": 30.0}},
+            "strikes": {"42": 2},
+            "events": [{"kind": "quarantine"}, {"kind": "requarantine"},
+                       {"kind": "readmit"}, {"kind": "quarantine"}],
+        },
+        "spawn": {"failures_total": 4, "breaker_opens_total": 1,
+                  "breaker_open": True},
+        "drain_escalations": 1,
+    }}}
+    path = tmp_path / "planner_state.json"
+    path.write_text(json.dumps(doc))
+    act = report_paths([str(path)])["actuation"]
+    assert act["scale_ups"] == 2 and act["scale_downs"] == 1
+    assert act["burn_actuations"] == 1
+    assert act["quarantine"] == {
+        "held": 1, "strikes": 2,
+        "events": {"quarantine": 2, "requarantine": 1, "readmit": 1}}
+    assert act["spawn"] == {"failures_total": 4, "breaker_opens_total": 1,
+                            "breaker_open": True}
+    assert act["drain_escalations"] == 1
+    assert act["planners"] == [{"component": "mocker", "mode": "load",
+                                "phase": "any", "decisions": 3}]
+
+
+# ----------------------- crashloop circuit breaker -----------------------
+
+
+async def test_boot_crash_trips_backoff_and_breaker():
+    """A spawn that always fails (chaos connector.spawn) must NOT be
+    retried every tick: the governor backs off exponentially, the
+    breaker opens after the streak, and both are visible in
+    dynamo_planner_* metrics + the tick diag."""
+    rt = await fresh_runtime().start()
+    try:
+        async def bad_spawn():
+            raise AssertionError("unreachable: chaos fails first")
+
+        async def stop(w):
+            pass
+
+        conn = CallbackConnector(
+            bad_spawn, stop,
+            governor=SpawnGovernor(backoff_base_s=0.05, backoff_max_s=0.2,
+                                   breaker_threshold=3,
+                                   breaker_reset_s=30.0))
+        planner = Planner(
+            rt, "dynamo", "mocker", conn,
+            config=PlannerConfig(min_replicas=2, max_replicas=4,
+                                 cooldown_s=0.0, quarantine=False))
+        await planner.observer.start()
+        plane = chaos.ChaosPlane(seed=1).rule("connector.spawn", "fail")
+        with plane:
+            for _ in range(12):
+                await planner.tick()
+                await asyncio.sleep(0.03)
+        # without the governor this would be ≥12 spawn attempts (one per
+        # tick, forever); the backoff + breaker cap the streak
+        assert plane.fired("connector.spawn") == 3, plane.injections
+        assert conn.governor.breaker_open
+        assert planner.last_diag["spawn"]["breaker_open"] is True
+        assert metric_value(rt, "dynamo_planner_actuations_total",
+                            kind="breaker_open") == 1.0
+        assert metric_value(rt,
+                            "dynamo_planner_spawn_breaker_open") == 1.0
+        # debug surface carries the control-plane state
+        dbg = planner.debug_state()
+        assert dbg["spawn"]["breaker_open"] is True
+        await planner.close()
+        await conn.close()
+    finally:
+        await rt.shutdown()
+
+
+async def test_planner_scale_seam_fault_is_survivable():
+    """chaos planner.scale fail: the tick raises (no actuation), the
+    next tick retries and succeeds — the loop never wedges on a failed
+    EXECUTE."""
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    cfg = PlannerConfig(min_replicas=1, max_replicas=4, cooldown_s=0.0,
+                        target_active_per_replica=2.0)
+    conn = _FakeConnector(replicas=1)
+    p = _bare_planner(cfg, conn)
+    p.observer.load = AggregateLoad(workers=1, active_seqs=8,
+                                    mean_kv_usage=0.1)
+    plane = chaos.ChaosPlane(seed=5).rule("planner.scale", "fail",
+                                          times=1)
+    with plane:
+        with pytest.raises(chaos.ChaosError):
+            await p.tick()
+        assert conn.calls == []          # EXECUTE never ran
+        assert await p.tick() == 3       # retried clean next tick
+    assert conn.calls == [("scale", 3)]
+
+
+async def test_drain_on_scale_down_disabled_uses_hard_stop():
+    """drain_on_scale_down=False restores the reference hard-stop path
+    (and the base Connector.drain default delegates to scale)."""
+    from dynamo_tpu.planner.metrics import AggregateLoad
+
+    cfg = PlannerConfig(min_replicas=1, max_replicas=4, cooldown_s=0.0,
+                        down_stable_ticks=1, drain_on_scale_down=False)
+    conn = _FakeConnector(replicas=3)
+    p = _bare_planner(cfg, conn)
+    p.observer.load = AggregateLoad(workers=3, active_seqs=0,
+                                    mean_kv_usage=0.0)
+    assert await p.tick() == 1
+    assert conn.calls == [("scale", 1)]
+    # with the default, the same scale-down goes through drain()
+    cfg2 = PlannerConfig(min_replicas=1, max_replicas=4, cooldown_s=0.0,
+                         down_stable_ticks=1)
+    conn2 = _FakeConnector(replicas=3)
+    p2 = _bare_planner(cfg2, conn2)
+    p2.observer.load = AggregateLoad(workers=3, active_seqs=0,
+                                     mean_kv_usage=0.0)
+    assert await p2.tick() == 1
+    assert conn2.calls == [("drain", 1)]
