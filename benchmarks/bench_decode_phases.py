@@ -16,6 +16,12 @@ of one fused decode burst separately on the real chip:
 
 and prints a table with achieved GB/s per phase vs the v5e 819 GB/s pin.
 
+`--epilogue on|off|ab` additionally serves greedy requests through a
+real JaxEngine with the fused sampling epilogue (ops/fused_sampling.py)
+on/off and reports decode MBU from the same dynamo_engine_mbu{phase}
+gauge the worker exports — the HBM-bound hypothesis is checked in the
+same run that measures the fix, against the gauge the fleet watches.
+
 Run on the chip:  python benchmarks/bench_decode_phases.py
 """
 
@@ -30,6 +36,9 @@ import numpy as np
 # phase selection: e.g. `python bench_decode_phases.py attn kv_write`
 # (populated from argv by the __main__ block; empty = all phases)
 _SEL = set()
+# fused-sampling A/B: None = skip; "on"/"off"/"ab" = which engine
+# epilogue modes to serve (populated from --epilogue by __main__)
+EPILOGUE = None
 
 
 def want(tag: str) -> bool:
@@ -68,6 +77,84 @@ def timeit(fn, n=8, warm=2):
         r = fn()
     _sync(r)
     return (time.perf_counter() - t0) / n
+
+
+def epilogue_report(modes):
+    """Engine-level fused-sampling A/B (--epilogue): serve B greedy
+    requests through a real JaxEngine per mode and report decode MBU
+    from the dynamo_engine_mbu{phase="decode"} gauge the worker itself
+    exports (planner/metrics.py export_engine_gauges), not a
+    bench-local byte model.  Greedy token streams must match between
+    modes — the epilogue's byte-identity contract, re-proven here on
+    the bench geometry."""
+    import asyncio
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+    from dynamo_tpu.planner.metrics import FpmWindow, export_engine_gauges
+    from dynamo_tpu.protocols import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    class _Gauges:
+        def __init__(self):
+            self.vals = {}
+
+        def set(self, name, value, doc="", **labels):
+            self.vals[(name, tuple(sorted(labels.items())))] = value
+
+    max_blocks = CTX // BLOCK + 2
+
+    async def run_mode(mode):
+        eng = JaxEngine(EngineConfig(
+            model=MODEL, block_size=BLOCK, num_blocks=B * max_blocks + 1,
+            max_blocks_per_seq=max_blocks, max_num_seqs=B,
+            kv_cache_dtype=KV_DTYPE, sampling_epilogue=mode,
+            peak_hbm_gbps=HBM_GBPS, seed=0))
+        eng.warmup_decode()
+        rng = np.random.default_rng(0)
+        prompt = [int(t) for t in rng.integers(3, 255, 64)]
+
+        async def one(i):
+            req = PreprocessedRequest(
+                token_ids=prompt, request_id=f"ep-{mode}-{i}",
+                sampling=SamplingOptions(temperature=0.0, seed=i),
+                stop=StopConditions(max_tokens=K, ignore_eos=True))
+            toks = []
+            async for out in eng.generate(req):
+                toks.extend(out.token_ids)
+            return toks
+
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(*(one(i) for i in range(B)))
+        dt = time.perf_counter() - t0
+        # post-hoc gauge replay: _phase_rates works from each record's
+        # own gap_s/xla_flops/xla_bytes fields, so draining eng.fpm
+        # into a wide-open window reproduces the worker's export
+        fw = FpmWindow(window_s=3600.0)
+        while eng.fpm:
+            fw.add(0, eng.fpm.popleft())
+        g = _Gauges()
+        export_engine_gauges(g, fw, peak_hbm_gbps=HBM_GBPS)
+        mbu = g.vals.get(("dynamo_engine_mbu", (("phase", "decode"),)), 0.0)
+        await eng.close()
+        return outs, sum(len(t) for t in outs) / dt, mbu
+
+    print(f"epilogue A/B: {MODEL}, B={B}, {K} tokens/req, kv {KV_DTYPE}")
+    results = {}
+    for mode in modes:
+        outs, tok_s, mbu = asyncio.run(run_mode(mode))
+        results[mode] = (outs, tok_s, mbu)
+        print(f"  epilogue[{mode:5s}] {tok_s:9.1f} tok/s   decode MBU "
+              f"{mbu:5.3f}  (dynamo_engine_mbu{{phase=decode}} vs "
+              f"{HBM_GBPS:.0f} GB/s pin)")
+    if "off" in results and "fused" in results:
+        assert results["off"][0] == results["fused"][0], \
+            "greedy token streams diverged between epilogue modes"
+        ratio = results["fused"][1] / max(results["off"][1], 1e-9)
+        print(f"  epilogue A/B: greedy streams identical; fused/off "
+              f"tok/s ratio {ratio:.2f}")
 
 
 def main():
@@ -307,7 +394,26 @@ if __name__ == "__main__":
                    help="KV storage dtype: int8 streams half the KV "
                         "bytes per decode step (quant/kv.py); the pallas "
                         "attn phases are skipped (no int8 kernel)")
+    p.add_argument("--epilogue", default="", choices=["", "on", "off", "ab"],
+                   help="fused sampling epilogue A/B through a real "
+                        "JaxEngine: on = fused only, off = reference "
+                        "only, ab = both + greedy byte-identity check; "
+                        "reports decode MBU from the worker's "
+                        "dynamo_engine_mbu{phase} gauge")
+    p.add_argument("--model", default=MODEL,
+                   help="model preset for all phases (default llama-3b; "
+                        "use tiny for a CPU smoke of --epilogue)")
     args = p.parse_args()
     _SEL = set(args.phases)
     KV_DTYPE = args.kv_dtype
-    main()
+    MODEL = args.model
+    # `epilogue` as a bare phase tag defaults to the full A/B; when the
+    # epilogue is the only selection, the classic phases are skipped
+    EPILOGUE = args.epilogue or ("ab" if "epilogue" in _SEL else None)
+    _SEL.discard("epilogue")
+    if not EPILOGUE or _SEL:
+        main()
+    if EPILOGUE:
+        epilogue_report(
+            {"on": ("fused",), "off": ("off",), "ab": ("off", "fused")}
+            [EPILOGUE])

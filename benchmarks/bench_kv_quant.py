@@ -33,6 +33,7 @@ CPU-runnable by default (tiny geometry); pass --model llama-3b
 import argparse
 import asyncio
 import dataclasses
+import json
 import time
 
 import jax
@@ -44,7 +45,7 @@ from dynamo_tpu.quant.kv import kv_cache_bytes_per_block
 
 
 def capacity_report(cfg, block_size: int, hbm_gb: float,
-                    min_ratio: float) -> None:
+                    min_ratio: float) -> float:
     budget = int(hbm_gb * 1e9)
     rows = {}
     for dt in ("bf16", "int8"):
@@ -60,6 +61,7 @@ def capacity_report(cfg, block_size: int, hbm_gb: float,
     assert ratio >= min_ratio, (
         f"int8 capacity ratio {ratio:.2f} < required {min_ratio}")
     assert rows["int8"][1] < rows["bf16"][1], "int8 must cut bytes/token"
+    return ratio
 
 
 async def _greedy(engine_cfg, prompts, n_out):
@@ -84,7 +86,7 @@ async def _greedy(engine_cfg, prompts, n_out):
     return outs
 
 
-def parity_report(args) -> None:
+def parity_report(args) -> float:
     from dynamo_tpu.engine import EngineConfig
 
     cfg = llama.LlamaConfig(
@@ -110,9 +112,10 @@ def parity_report(args) -> None:
           f"({frac * 100:.1f}%)")
     assert frac >= args.parity_min, (
         f"greedy parity {frac:.3f} < required {args.parity_min}")
+    return frac
 
 
-def decode_report(args) -> None:
+def decode_report(args) -> dict:
     cfg = llama.PRESETS[args.model]
     B, ctx, bs, K = args.batch, args.ctx, args.block, args.steps
     max_blocks = ctx // bs + 2
@@ -184,6 +187,10 @@ def decode_report(args) -> None:
     else:
         print("  (interpret-mode Pallas rows are a CPU smoke; the "
               "int8>=bf16 assert is TPU-gated)")
+    return {"on_tpu": on_tpu, "pallas_impl": pallas_impl,
+            "rows": [{"kv_dtype": dt, "attn_impl": impl,
+                      "tok_s": round(v, 1)}
+                     for (dt, impl), v in tok_s.items()]}
 
 
 def main() -> None:
@@ -211,21 +218,36 @@ def main() -> None:
                    help="capacity + parity only (fast CPU smoke)")
     args = p.parse_args()
 
-    capacity_report(llama.PRESETS[args.model], args.block, args.hbm_gb,
-                    args.min_ratio)
+    ratio = capacity_report(llama.PRESETS[args.model], args.block,
+                            args.hbm_gb, args.min_ratio)
     # the headline config too: the 2x-blocks claim is about serving
     # geometry (head_dim 128, block 128), not the CPU test shapes
     if args.model != "llama-3b":
         capacity_report(llama.PRESETS["llama-3b"], 128, args.hbm_gb,
                         args.min_ratio)
-    parity_report(args)
+    frac = parity_report(args)
+    decode = None
     if not args.skip_decode:
         print(f"decode tok/s @ {args.model} B={args.batch} "
               f"ctx={args.ctx} K={args.steps}  "
               f"(next TPU round targets: int8-Pallas >= bf16-Pallas "
               f"tok/s here, prefill MFU >= 0.4 in "
               f"bench_prefill_phases --impl ab)")
-        decode_report(args)
+        decode = decode_report(args)
+    # one BENCH-style JSON line (the run_round.py contract): the
+    # (dtype x impl) decode rows plus the pass/fail state of every
+    # assert that already fired above; mode labels interpret-mode rows
+    # as a smoke so a scoreboard never mistakes them for chip numbers
+    on_tpu = bool(decode and decode["on_tpu"])
+    print(json.dumps({
+        "bench": "kv_quant", "mode": "tpu" if on_tpu else "smoke",
+        "model": args.model, "block_size": args.block,
+        "capacity": {"int8_bf16_blocks_ratio": round(ratio, 3),
+                     "min_ratio": args.min_ratio},
+        "parity": {"match_frac": round(frac, 4),
+                   "min": args.parity_min},
+        **({"decode": decode} if decode else {}),
+    }))
 
 
 if __name__ == "__main__":

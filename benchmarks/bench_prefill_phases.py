@@ -216,7 +216,10 @@ def main():
             variants[impl] = row
             report(f"packed/{impl}", t, T, flops_per_tok * T)
         print(json.dumps({
-            "bench": "prefill_phases", "model": args.model, "seqs": S,
+            "bench": "prefill_phases",
+            "mode": ("tpu" if any(d.platform == "tpu"
+                                  for d in jax.devices()) else "smoke"),
+            "model": args.model, "seqs": S,
             "tokens": T, "ctx_blocks": MB, "block": BLOCK,
             "peak_tflops": PEAK_TFLOPS, "target_mfu": 0.4,
             "impls": variants,

@@ -255,7 +255,7 @@ async def collect_roofline(rt):
     from prometheus_client.parser import text_string_to_metric_families
 
     await asyncio.sleep(0.4)  # let the workers' 0.25s load loops tick
-    out = {"mfu": {}, "mbu": {}, "compiles": {}}
+    out = {"mfu": {}, "mbu": {}, "compiles": {}, "serving_compiles": {}}
     for fam in text_string_to_metric_families(
             rt.metrics.render().decode()):
         if fam.name == "dynamo_engine_mfu":
@@ -264,13 +264,19 @@ async def collect_roofline(rt):
         elif fam.name == "dynamo_engine_mbu":
             for s in fam.samples:
                 out["mbu"][s.labels.get("phase", "")] = round(s.value, 4)
-        elif fam.name == "dynamo_engine_compiles":
+        elif fam.name in ("dynamo_engine_compiles",
+                          "dynamo_engine_serving_compiles"):
+            # serving_compiles = compiles that landed with requests in
+            # flight (obs/compile_watch.py): each one is a serving
+            # stall, and the bench round's zero-mid-serving gate reads
+            # this block
+            key_out = ("compiles" if fam.name == "dynamo_engine_compiles"
+                       else "serving_compiles")
             for s in fam.samples:
                 if not s.name.endswith("_total"):
                     continue
                 key = s.labels.get("family", "")
-                out["compiles"][key] = \
-                    out["compiles"].get(key, 0) + int(s.value)
+                out[key_out][key] = out[key_out].get(key, 0) + int(s.value)
     return out
 
 
@@ -428,6 +434,27 @@ async def main():
                         "byte-identical token streams AND a clean "
                         "audit, and print a kv_ledger_ab line with the "
                         "measured throughput overhead (target <1%%)")
+    # kernel-impl bookkeeping for round scoreboards: the mocker's timing
+    # model dispatches no real kernels, so these flags only STAMP the
+    # settings a paired on-chip run used into every JSON line (the
+    # `impls` block), keeping r06 rows self-describing next to rows from
+    # the real engine.  Choices mirror ops/paged_attention.DECODE_IMPLS,
+    # ops/packed_prefill.PACKED_IMPLS and ops/fused_sampling
+    # .EPILOGUE_MODES as literals — importing those modules would pull
+    # jax into this deliberately jax-free bench (tests pin the parity).
+    p.add_argument("--attn-impl", default="auto",
+                   choices=["auto", "pallas", "pallas_interpret", "jnp",
+                            "jnp_bf16"],
+                   help="decode attention impl stamped into the JSON "
+                        "`impls` block")
+    p.add_argument("--packed-attn-impl", default="auto",
+                   choices=["auto", "xla", "pallas", "pallas_interpret"],
+                   help="packed-prefill impl stamped into the JSON "
+                        "`impls` block")
+    p.add_argument("--sampling-epilogue", default="off",
+                   choices=["off", "fused"],
+                   help="sampling epilogue mode stamped into the JSON "
+                        "`impls` block")
     args = p.parse_args()
 
     rows = synthesize(args.requests, rate_rps=args.rate,
@@ -458,6 +485,16 @@ async def main():
         total = summary.get("requests", 0)
         return json.dumps({
             "config": config, **summary,
+            # effective kernel/epilogue settings for this row (mocker =
+            # simulated step timing; the settings describe the paired
+            # on-chip configuration a round scoreboard lines this row
+            # up against)
+            "impls": {
+                "engine": "mocker",
+                "attn_impl": args.attn_impl,
+                "packed_attn_impl": args.packed_attn_impl,
+                "sampling_epilogue": args.sampling_epilogue,
+            },
             "slo": {
                 "ttft_s": slo_ttft_s, "itl_s": slo_itl_s,
                 "goodput": (round(gp.get("good_requests", 0) / total, 4)

@@ -69,6 +69,18 @@ def build_args() -> argparse.ArgumentParser:
                         "segment-aware tile-skip kernel (no S-fold "
                         "attention overhead), xla = masked reference; "
                         "default keeps the model family's choice")
+    from ..ops.fused_sampling import EPILOGUE_MODES
+
+    p.add_argument("--sampling-epilogue", default="off",
+                   choices=list(EPILOGUE_MODES),
+                   help="fused sampling/top-k epilogue "
+                        "(ops/fused_sampling.py): fused = stream the "
+                        "decode final projection in vocab tiles and "
+                        "emit only token ids (no [B, vocab] logits in "
+                        "HBM; byte-identical at greedy); off = the "
+                        "reference materialize-then-sample path; "
+                        "families without a hidden-state decode "
+                        "surface (MLA) fall back to off")
     p.add_argument("--no-packed-prefill", action="store_true",
                    help="disable packed chunked prefill (use the padded "
                         "per-row programs)")
@@ -157,6 +169,7 @@ async def main() -> None:
         prefill_packed=not args.no_packed_prefill,
         attn_impl=args.attn_impl,
         packed_attn_impl=args.packed_attn_impl,
+        sampling_epilogue=args.sampling_epilogue,
         peak_tflops=args.peak_tflops,
         peak_hbm_gbps=args.peak_hbm_gbps,
         host_cache_blocks=args.host_cache_blocks,

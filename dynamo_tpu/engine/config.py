@@ -144,6 +144,17 @@ class EngineConfig:
     # ops/pallas_packed_prefill.py).  Also selects the kernel for
     # spec_verify, which rides the same packed path.
     packed_attn_impl: str = ""
+    # fused sampling/top-k epilogue (ops/fused_sampling.py): "fused"
+    # streams the decode final projection in vocab tiles and emits only
+    # sampled token ids — the [B, vocab] fp32 logits tensor never
+    # round-trips HBM on the decode / fused-decode-ladder paths (byte-
+    # identical at greedy, distribution-identical seeded sampling).
+    # "off" keeps the reference path (materialized logits ->
+    # engine/sampler.py), which remains the fallback for families
+    # without a hidden-state decode surface (MLA) — those fall back
+    # with a warning, like the int8-KV precedent, and the worker MDC
+    # advertises the EFFECTIVE mode.
+    sampling_epilogue: str = "off"
     # accelerator peak (dense bf16) TFLOP/s, for prefill-phase MFU in the
     # FPM stream (v5e: 197).  0 = unknown; MFU omitted from records.
     peak_tflops: float = 0.0

@@ -51,6 +51,7 @@ from ..runtime.retry import PULL_POLICY, call_with_retry
 from ..tokens import TokenBlockSequence, request_salt
 from .block_allocator import BlockAllocator
 from .config import EngineConfig
+from ..ops.fused_sampling import fused_greedy_tokens, fused_sample_tokens
 from .sampler import greedy_tokens, sample_tokens
 
 logger = logging.getLogger(__name__)
@@ -213,6 +214,28 @@ class JaxEngine:
         if impl_over:
             self.model_cfg = dataclasses.replace(self.model_cfg,
                                                  **impl_over)
+        # fused sampling/top-k epilogue (ops/fused_sampling.py): resolve
+        # the EFFECTIVE mode like the attn impls and kv dtype — families
+        # without the hidden-state decode surface (MLA) fall back to
+        # "off" with a warning instead of failing the worker, and the
+        # MDC advertises the effective mode so a worker never claims an
+        # epilogue it does not run
+        from ..ops.fused_sampling import EPILOGUE_MODES
+        if config.sampling_epilogue not in EPILOGUE_MODES:
+            raise ValueError(
+                f"sampling_epilogue must be "
+                f"{' | '.join(EPILOGUE_MODES)}, "
+                f"got {config.sampling_epilogue!r}")
+        self.sampling_epilogue = config.sampling_epilogue
+        if self.sampling_epilogue == "fused" and not (
+                hasattr(self.family, "decode_hidden")
+                and hasattr(self.family, "unembed_weight")
+                and hasattr(self.family, "decode_multi_hidden")):
+            logger.warning(
+                "model family %r has no hidden-state decode surface; "
+                "sampling_epilogue falls back to off",
+                type(self.model_cfg).__name__)
+            self.sampling_epilogue = "off"
         self.mesh = mesh if mesh is not None else make_mesh(
             MeshConfig(dp=config.dp, tp=config.tp, sp=config.sp)
         )
@@ -410,10 +433,17 @@ class JaxEngine:
         # large vocabs even top-k-capped)
         # donate kv + the advancing descriptor arrays (positions/ctx/steps
         # are returned advanced for the next burst's continuation)
+        # the sampling epilogue is a static, init-time property of the
+        # decode programs (identical on every host — followers replay
+        # the leader's step stream through the same partials), NOT a
+        # per-dispatch key: the (greedy, k) program families and their
+        # pinned out_shardings are unchanged, so the zero-recompile
+        # steady state carries over
+        _ep = self.sampling_epilogue == "fused"
         self._jit_decode = {
             g: w.wrap(jax.jit(
                 partial(self._decode_impl, self.family, self.model_cfg,
-                        self.mesh, g),
+                        self.mesh, g, _ep),
                 donate_argnums=(1, 5, 7, 9),
                 out_shardings=_decode_out,
             ), "decode")
@@ -536,7 +566,7 @@ class JaxEngine:
             self._jit_decode_multi = {
                 (g, k): w.wrap(jax.jit(
                     partial(self._decode_multi_impl, self.family,
-                            self.model_cfg, self.mesh, g, k),
+                            self.model_cfg, self.mesh, g, k, _ep),
                     donate_argnums=(1, 5, 7, 9),
                     out_shardings=_decode_out,
                 ), "decode_multi")
@@ -655,15 +685,20 @@ class JaxEngine:
 
     # -- jitted programs --------------------------------------------------
     @staticmethod
-    def _decode_impl(family, model_cfg, mesh, greedy, params, kv, chain,
-                     use_chain, tokens, positions, block_tables, ctx_lens,
-                     seeds, steps, temps, top_ks, top_ps, valid, advance,
-                     lora_bank=None, lidx=None):
+    def _decode_impl(family, model_cfg, mesh, greedy, epilogue, params,
+                     kv, chain, use_chain, tokens, positions, block_tables,
+                     ctx_lens, seeds, steps, temps, top_ks, top_ps, valid,
+                     advance, lora_bank=None, lidx=None):
         """chain/use_chain: device-resident token chaining — lanes whose
         previous burst is still unread take their input token from the
         prior burst's on-device output instead of a host round-trip.
         `greedy` is a static specialization: an all-greedy batch skips the
-        sampling machinery (sampler.py greedy_tokens).
+        sampling machinery (sampler.py greedy_tokens).  `epilogue` is the
+        static fused-sampling choice (ops/fused_sampling.py): the decode
+        trunk stops at the final-norm hidden and the projection streams
+        tile-by-tile into the sampler statistics, so [B, vocab] logits
+        never materialize — byte-identical at greedy to the reference
+        path below, which stays as the off-mode fallback.
 
         `advance` (traced scalar) is the continuation clock: steady-state
         bursts re-dispatch the PREVIOUS device descriptor with advance=k
@@ -678,32 +713,65 @@ class JaxEngine:
         tokens = jnp.where(use_chain, chain, tokens)
         lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
                    if lora_bank is not None else {})
-        logits, kv = family.decode(
-            params, model_cfg, kv, tokens, positions, block_tables,
-            ctx_lens, valid=valid, mesh=mesh, **lora_kw,
-        )
-        if greedy:
-            next_tokens = greedy_tokens(logits)
+        if epilogue:
+            h, kv = family.decode_hidden(
+                params, model_cfg, kv, tokens, positions, block_tables,
+                ctx_lens, valid=valid, mesh=mesh, **lora_kw,
+            )
+            uw = family.unembed_weight(params, model_cfg)
+            if greedy:
+                next_tokens = fused_greedy_tokens(h, uw)
+            else:
+                next_tokens = fused_sample_tokens(h, uw, seeds, steps,
+                                                  temps, top_ks, top_ps)
         else:
-            next_tokens = sample_tokens(logits, seeds, steps, temps,
-                                        top_ks, top_ps)
+            logits, kv = family.decode(
+                params, model_cfg, kv, tokens, positions, block_tables,
+                ctx_lens, valid=valid, mesh=mesh, **lora_kw,
+            )
+            if greedy:
+                next_tokens = greedy_tokens(logits)
+            else:
+                next_tokens = sample_tokens(logits, seeds, steps, temps,
+                                            top_ks, top_ps)
         # [1, B]: burst-shaped like multi
         return next_tokens[None], kv, positions, ctx_lens, steps
 
     @staticmethod
     def _decode_multi_impl(family, model_cfg, mesh, greedy, num_steps,
-                           params, kv, chain, use_chain, tokens, positions,
-                           block_tables, ctx_lens, seeds, steps, temps,
-                           top_ks, top_ps, valid, advance, lora_bank=None,
-                           lidx=None):
+                           epilogue, params, kv, chain, use_chain, tokens,
+                           positions, block_tables, ctx_lens, seeds, steps,
+                           temps, top_ks, top_ps, valid, advance,
+                           lora_bank=None, lidx=None):
         """num_steps fused decode steps (family decode_multi); sampling
         streams stay per-token identical to the single-step path (seed
-        folded with the running step counter).  `advance`: see
-        _decode_impl."""
+        folded with the running step counter).  `epilogue`/`advance`: see
+        _decode_impl — with the epilogue the scan body samples from the
+        final-norm hidden (family decode_multi_hidden), so no step of the
+        burst materializes logits."""
         positions = positions + advance
         ctx_lens = ctx_lens + advance
         steps = steps + advance
         tokens = jnp.where(use_chain, chain, tokens)
+        lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
+                   if lora_bank is not None else {})
+        if epilogue:
+            uw = family.unembed_weight(params, model_cfg)
+            if greedy:
+                def sample_fn(h, step_idx):
+                    return fused_greedy_tokens(h, uw)
+            else:
+                def sample_fn(h, step_idx):
+                    return fused_sample_tokens(h, uw, seeds,
+                                               steps + step_idx, temps,
+                                               top_ks, top_ps)
+
+            burst, kv = family.decode_multi_hidden(
+                params, model_cfg, kv, tokens, positions, block_tables,
+                ctx_lens, num_steps, sample_fn, valid=valid, mesh=mesh,
+                **lora_kw,
+            )
+            return burst, kv, positions, ctx_lens, steps
         if greedy:
             sample_fn = None  # decode_multi defaults to argmax
         else:
@@ -711,8 +779,6 @@ class JaxEngine:
                 return sample_tokens(logits, seeds, steps + step_idx,
                                      temps, top_ks, top_ps)
 
-        lora_kw = ({"lora_bank": lora_bank, "adapter_idx": lidx}
-                   if lora_bank is not None else {})
         burst, kv = family.decode_multi(
             params, model_cfg, kv, tokens, positions, block_tables,
             ctx_lens, num_steps, sample_fn, valid=valid, mesh=mesh,
@@ -1000,7 +1066,18 @@ class JaxEngine:
         combined).  Runs on the caller's thread; call before serving
         traffic (worker startup / bench warm phase).  Prefill buckets
         are NOT warmed here (one per bucket is admission-driven and the
-        first request pays exactly one)."""
+        first request pays exactly one).
+
+        Holds _step_lock for the whole dispatch+restore section: the
+        worker serves its generate endpoint (and arms the health-check
+        canary) before warmup runs, so a canary probe landing while
+        warmup is still compiling starts the scheduler loop — an
+        unlocked _sched_step then reads self.kv between two warmup
+        dispatches that have already donated it (observed as "Array has
+        been deleted" in _prefill_packed and a permanently dead loop
+        when decode compiles outlast the canary's 30s wait, e.g. the
+        interpret impls on CPU).  Under the lock that step simply waits
+        out warmup and sees a consistent engine."""
         B = self.config.max_num_seqs
         zero = {
             "tokens": np.zeros(B, np.int32),
@@ -1020,19 +1097,20 @@ class JaxEngine:
         # every fusion-ladder rung (adaptive bursts ramp through all of
         # them) — a rung missing here is a mid-serving compile later
         ks = self._fuse_ladder()
-        chain0, desc0, last0 = (self._chain_tokens, self._dev_desc,
-                                self._last_desc)
-        for greedy in (True, False):
-            a = dict(zero, temps=np.full(
-                B, 0.0 if greedy else 0.7, np.float32))
-            for k in ks:
-                self._dispatch_decode(k, a)
-                self._dispatch_decode_cont(k, k, greedy)
-        jax.block_until_ready(self.kv)
-        # warmup bursts wrote nothing (valid all-false) but did advance
-        # the chain/descriptor state machinery: reset it
-        self._chain_tokens, self._dev_desc, self._last_desc = (
-            chain0, desc0, last0)
+        with self._step_lock:
+            chain0, desc0, last0 = (self._chain_tokens, self._dev_desc,
+                                    self._last_desc)
+            for greedy in (True, False):
+                a = dict(zero, temps=np.full(
+                    B, 0.0 if greedy else 0.7, np.float32))
+                for k in ks:
+                    self._dispatch_decode(k, a)
+                    self._dispatch_decode_cont(k, k, greedy)
+            jax.block_until_ready(self.kv)
+            # warmup bursts wrote nothing (valid all-false) but did
+            # advance the chain/descriptor state machinery: reset it
+            self._chain_tokens, self._dev_desc, self._last_desc = (
+                chain0, desc0, last0)
 
     # -- request entry ----------------------------------------------------
     def start(self) -> None:

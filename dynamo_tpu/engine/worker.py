@@ -137,6 +137,12 @@ class JaxEngineWorker:
                             "auto")
                     if self.engine is not None
                     else (self.config.packed_attn_impl or "auto")),
+                # EFFECTIVE fused-sampling epilogue mode (engine-level
+                # resolution: MLA families fall back to "off"), same
+                # fleet-visibility contract as the attn impls
+                "sampling_epilogue": (self.engine.sampling_epilogue
+                                      if self.engine is not None
+                                      else self.config.sampling_epilogue),
                 # overlapped scheduler (engine/core.py): whether this
                 # worker pipelines host scheduling behind device
                 # execution — sync-mode workers show distinctly worse

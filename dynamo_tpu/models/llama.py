@@ -742,6 +742,21 @@ def decode(
 ):
     """One decode step for B slots.  Writes each token's K/V, attends over
     the paged context, returns (logits [B, vocab], updated kv_cache)."""
+    x, kv_cache = _decode_trunk(params, cfg, kv_cache, token_ids,
+                                positions, block_tables, ctx_lens,
+                                valid=valid, mesh=mesh,
+                                lora_bank=lora_bank,
+                                adapter_idx=adapter_idx)
+    logits = _logits(params, cfg, x)  # [B, vocab]
+    return logits, kv_cache
+
+
+def _decode_trunk(params, cfg, kv_cache, token_ids, positions,
+                  block_tables, ctx_lens, valid=None, mesh=None,
+                  lora_bank=None, adapter_idx=None):
+    """The decode layer stack shared by decode (-> _logits) and
+    decode_hidden (-> final norm only, for the fused sampling epilogue).
+    Returns (pre-final-norm hidden [B, d], updated kv_cache)."""
     x = params["embedding"][token_ids].astype(cfg.dtype)  # [B, d]
     pos1 = positions[:, None]  # [B, 1] for rope
     for li, layer in enumerate(params["layers"]):
@@ -759,8 +774,43 @@ def decode(
                           lora=lctx)
         h = rms_norm(x, layer["mlp_norm"]["norm"], cfg.rms_eps)
         x = x + _ffn(layer, cfg, h, valid=valid)
-    logits = _logits(params, cfg, x)  # [B, vocab]
-    return logits, kv_cache
+    return x, kv_cache
+
+
+def decode_hidden(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    kv_cache: Tuple[jax.Array, jax.Array],
+    token_ids: jax.Array,      # [B] int32
+    positions: jax.Array,      # [B] int32
+    block_tables: jax.Array,   # [B, max_blocks] int32
+    ctx_lens: jax.Array,       # [B] int32
+    valid: Optional[jax.Array] = None,
+    mesh=None,
+    lora_bank=None,
+    adapter_idx=None,
+):
+    """decode minus the final projection: returns (final-norm hidden
+    [B, d] in cfg.dtype, updated kv_cache).  The fused sampling
+    epilogue (ops/fused_sampling.py) contracts the hidden against
+    unembed_weight tile-by-tile, so [B, vocab] logits never
+    materialize in HBM; `_logits` is exactly
+    `(this_hidden @ unembed_weight).astype(fp32)`, which is what the
+    epilogue's byte-identity contract rides on."""
+    x, kv_cache = _decode_trunk(params, cfg, kv_cache, token_ids,
+                                positions, block_tables, ctx_lens,
+                                valid=valid, mesh=mesh,
+                                lora_bank=lora_bank,
+                                adapter_idx=adapter_idx)
+    return rms_norm(x, params["final_norm"]["norm"], cfg.rms_eps), kv_cache
+
+
+def unembed_weight(params, cfg: LlamaConfig) -> jax.Array:
+    """[d, vocab] final-projection matrix — the operand _logits
+    contracts the final-norm hidden with (embedding.T when tied)."""
+    if cfg.tie_embeddings:
+        return params["embedding"].T
+    return params["lm_head"]
 
 
 def decode_multi(
@@ -798,6 +848,46 @@ def decode_multi(
                             valid=valid, mesh=mesh, lora_bank=lora_bank,
                             adapter_idx=adapter_idx)
         nt = sample_fn(logits, step_idx).astype(jnp.int32)
+        return (nt, kv, pos + 1, cls + 1), nt
+
+    (_, kv_cache, _, _), toks = jax.lax.scan(
+        body, (token_ids, kv_cache, positions, ctx_lens),
+        jnp.arange(num_steps), length=num_steps,
+    )
+    return toks, kv_cache
+
+
+def decode_multi_hidden(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    kv_cache: Tuple[jax.Array, jax.Array],
+    token_ids: jax.Array,      # [B] int32
+    positions: jax.Array,      # [B] int32
+    block_tables: jax.Array,   # [B, max_blocks] int32
+    ctx_lens: jax.Array,       # [B] int32
+    num_steps: int,
+    sample_fn,                 # (hidden [B,d], step_idx) -> tokens [B]
+    valid: Optional[jax.Array] = None,
+    mesh=None,
+    lora_bank=None,
+    adapter_idx=None,
+):
+    """decode_multi with the fused sampling epilogue: the scan body
+    hands `sample_fn` the final-norm HIDDEN state instead of logits, so
+    no [B, vocab] tensor exists anywhere in the fused burst — the
+    epilogue reduces each step's projection tile-by-tile
+    (ops/fused_sampling.py).  Same chaining/position bookkeeping as
+    decode_multi; callers pre-allocate blocks for [ctx, ctx+num_steps).
+
+    Returns (tokens [num_steps, B], updated kv_cache)."""
+
+    def body(carry, step_idx):
+        tokens, kv, pos, cls = carry
+        h, kv = decode_hidden(params, cfg, kv, tokens, pos, block_tables,
+                              cls, valid=valid, mesh=mesh,
+                              lora_bank=lora_bank,
+                              adapter_idx=adapter_idx)
+        nt = sample_fn(h, step_idx).astype(jnp.int32)
         return (nt, kv, pos + 1, cls + 1), nt
 
     (_, kv_cache, _, _), toks = jax.lax.scan(

@@ -35,14 +35,16 @@ into VMEM and fuses the dequantizing multiply into the chunk consume
 softmax/accumulation), so quantization's halved HBM traffic lands
 inside the fast path instead of routing around it.
 
-Known limitation vs the decode kernel it borrows layout from: the DMA
-chain does not yet cross tile or segment boundaries — each (tile,
-segment) pair primes its own chunk 0 right before consuming it, so one
-un-overlapped chunk latency is exposed per active pair (the decode
-kernel prefetches the next sequence's chunk 0 during the current one's
-last chunk).  Chaining here needs a global slot phase over the
-nchunks prefetch plane; it is the first follow-up once the kernel is
-measured on TPU, and costs nothing to the parity contract below.
+The chunk DMA chain CROSSES tile and segment boundaries (the decode
+kernel's never-drain scheme, generalized): the wrapper derives two more
+scalar-prefetch planes from `nchunks` — a global slot PHASE (exclusive
+tile-major cumulative sum: how many chunks all earlier (tile, segment)
+pairs consume) and each pair's successor row (the next active pair in
+tile-major order, -1 at the end) — and every pair's last chunk
+prefetches its successor's chunk 0 into the opposite double-buffer
+slot (pallas_paged_attention.make_chunk_chain, one definition site
+with the decode kernel).  Only the launch's globally first fetch is
+un-overlapped; no per-(tile, segment) chunk-0 latency is exposed.
 
 Numerics: fp32 online softmax and accumulation, operands in the query
 dtype.  One shared running (m, l, acc) per token row accumulates across
@@ -64,7 +66,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_paged_attention import make_chunk_dma, tpu_compiler_params
+from .pallas_paged_attention import (
+    make_chunk_chain,
+    make_chunk_dma,
+    tpu_compiler_params,
+)
 
 NEG_INF = -1e30
 
@@ -80,6 +86,8 @@ def _packed_kernel(
     # scalar prefetch
     tables_ref,    # [S, n_chunks * bpc] int32 physical block ids
     nchunks_ref,   # [n_tiles, S] int32 context chunks per (tile, segment)
+    base_ref,      # [n_tiles, S] int32 global slot phase per pair
+    nseg_ref,      # [n_tiles, S] int32 successor segment row (-1 = none)
     # inputs
     seg_ref,       # [1, TB] int32 segment row per token (-1 = padded)
     pos_ref,       # [1, TB] int32 absolute position per token
@@ -110,6 +118,7 @@ def _packed_kernel(
     start_chunk, wait_chunk = make_chunk_dma(
         tables_ref, k_hbm, v_hbm, k_buf, v_buf, sem, bpc=bpc, bs=bs,
         ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf)
+    prime, chain_step = make_chunk_chain(start_chunk, wait_chunk)
 
     carry = (
         jnp.full((nkv, TB, g), NEG_INF, jnp.float32),
@@ -122,24 +131,20 @@ def _packed_kernel(
     # pairs entirely — the tile-skip that removes the S-fold overhead
     for s in range(S):
         nch = nchunks_ref[t, s]
+        base = base_ref[t, s]
+        nseg = nseg_ref[t, s]
 
-        @pl.when(nch > 0)
-        def _():
-            start_chunk(s, 0, 0)
+        # only the launch's globally first active pair primes chunk 0;
+        # every other pair's chunk 0 was prefetched by its predecessor's
+        # last chunk (cross-tile/segment never-drain chain)
+        prime(s, nch, base)
 
         owned = seg == s  # [TB]
 
-        def body(c, carry, s=s, owned=owned):
+        def body(c, carry, s=s, owned=owned, nch=nch, base=base,
+                 nseg=nseg):
             m, l, acc = carry
-            slot = jax.lax.rem(c, 2)
-            nxt = jax.lax.rem(c + 1, 2)
-
-            # prefetch the next chunk before waiting on this one
-            @pl.when(c + 1 < nch)
-            def _():
-                start_chunk(s, c + 1, nxt)
-
-            wait_chunk(s, c, slot)
+            slot = chain_step(s, c, nch, base, nseg)
             k = k_buf[slot]  # [nkv, hd, C]
             v = v_buf[slot]
             if quantized:
@@ -248,6 +253,24 @@ def packed_prefill_attention_pallas(
     nch = jnp.where(maxpos >= 0, maxpos // C + 1, 0)
     nchunks = jnp.minimum(nch, n_chunks).astype(jnp.int32).T  # [n_tiles, S]
 
+    # cross-tile/segment DMA chain planes (make_chunk_chain): the global
+    # slot PHASE of each (tile, segment) pair — exclusive tile-major
+    # cumulative sum of nchunks, so slot(chunk c of pair) = (base+c)%2 —
+    # and each pair's successor row: the segment index of the next
+    # active pair in tile-major order (suffix-min over flat indices,
+    # -1 past the last), whose chunk 0 the pair's last chunk prefetches
+    flat = nchunks.reshape(-1)                    # tile-major [n_tiles*S]
+    chunk_base = (jnp.cumsum(flat) - flat).astype(jnp.int32) \
+        .reshape(n_tiles, S)
+    npairs = flat.shape[0]
+    fidx = jnp.arange(npairs, dtype=jnp.int32)
+    cand = jnp.where(flat > 0, fidx, npairs)      # inactive -> sentinel
+    suf = jax.lax.cummin(cand[::-1])[::-1]        # min over cand[i:]
+    suf_excl = jnp.concatenate(
+        [suf[1:], jnp.full((1,), npairs, jnp.int32)])
+    next_seg = jnp.where(suf_excl < npairs, suf_excl % S, -1) \
+        .astype(jnp.int32).reshape(n_tiles, S)
+
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
     qg = (q.astype(jnp.float32) * scale).astype(q.dtype)
     qg = qg.reshape(Tp, nkv, group, hd).transpose(1, 0, 2, 3)
@@ -280,7 +303,7 @@ def packed_prefill_attention_pallas(
         functools.partial(_packed_kernel, S=S, bpc=bpc, bs=bs,
                           quantized=quantized),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=4,
             grid=(n_tiles,),
             in_specs=in_specs,
             out_specs=pl.BlockSpec((nkv, TB, group, hd),
@@ -301,6 +324,6 @@ def packed_prefill_attention_pallas(
             transcendentals=Tp * nh * n_chunks * C,
         ),
         interpret=interpret,
-    )(block_tables, nchunks, *inputs)
+    )(block_tables, nchunks, chunk_base, next_seg, *inputs)
     out = out.transpose(1, 0, 2, 3).reshape(Tp, nh, hd)
     return out[:T].astype(q.dtype)

@@ -130,6 +130,53 @@ def make_chunk_dma(tables_ref, k_hbm, v_hbm, k_buf, v_buf, sem, *,
     return start, wait
 
 
+def make_chunk_chain(start_chunk, wait_chunk):
+    """Global never-drain slot phase over a make_chunk_dma pair — the
+    scheme both kernels share: every chunk fetched anywhere in the
+    launch occupies one position `base + c` in a single global phase
+    sequence, its VMEM slot is `(base + c) % 2`, and each chunk's
+    consume loop prefetches the NEXT phase's chunk (this row's next
+    chunk, or chunk 0 of `next_row` — the next active row, possibly in
+    a later grid step) into the opposite slot before waiting on its
+    own.  Only the launch's globally first fetch (`base == 0`) is ever
+    un-overlapped; the DMA engines never drain across sequence, tile,
+    or segment boundaries.
+
+    `prime(row, nch, base)` issues that first fetch; `step(row, c, nch,
+    base, next_row)` runs inside the chunk loop and returns the slot
+    holding chunk `c` (next_row < 0 = nothing left to prefetch).  The
+    caller supplies `base` (chunks consumed by all earlier rows — the
+    decode kernel recomputes it from kv_lens, the packed kernel rides a
+    precomputed scalar-prefetch plane) and `next_row`; the double-buffer
+    safety argument is program order: phase p+1's slot was last read by
+    phase p-1's consume, which completes before p's loop iteration
+    issues p+1."""
+
+    def prime(row, nch, base):
+        @pl.when((nch > 0) & (base == 0))
+        def _():
+            start_chunk(row, 0, 0)
+
+    def step(row, c, nch, base, next_row):
+        slot = jax.lax.rem(base + c, 2)
+        nxt = jax.lax.rem(base + c + 1, 2)
+
+        # prefetch BEFORE waiting: next chunk of this row, or chunk 0
+        # of the next active row (the cross-boundary chain)
+        @pl.when(c + 1 < nch)
+        def _():
+            start_chunk(row, c + 1, nxt)
+
+        @pl.when((c + 1 == nch) & (next_row >= 0))
+        def _():
+            start_chunk(next_row, 0, nxt)
+
+        wait_chunk(row, c, slot)
+        return slot
+
+    return prime, step
+
+
 def _decode_kernel(
     # scalar prefetch
     tables_ref,   # [B, n_chunks * bpc] int32 physical block ids
@@ -166,13 +213,7 @@ def _decode_kernel(
     start_chunk, wait_chunk = make_chunk_dma(
         tables_ref, k_hbm, v_hbm, k_buf, v_buf, sem, bpc=bpc, bs=bs,
         ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf)
-
-    # the very first grid step primes the pipeline; afterwards chunk 0 of
-    # sequence b was prefetched by sequence b-1's last chunk, so the DMA
-    # chain never drains between sequences
-    @pl.when(b == 0)
-    def _():
-        start_chunk(0, 0, 0)
+    prime, chain_step = make_chunk_chain(start_chunk, wait_chunk)
 
     # slot phase = chunks consumed by earlier sequences (recomputed from
     # kv_lens — stateless, so the kernel needs nothing persisted across
@@ -183,6 +224,12 @@ def _decode_kernel(
         lambda j, acc: acc + pl.cdiv(jnp.maximum(kv_lens_ref[j], 1), S),
         jnp.int32(0),
     )
+    # the very first grid step primes the pipeline (every sequence has
+    # >= 1 chunk, so base == 0 is exactly b == 0); afterwards chunk 0 of
+    # sequence b was prefetched by sequence b-1's last chunk and the DMA
+    # chain never drains between sequences
+    prime(b, n_chunks, base)
+    next_row = jnp.where(b + 1 < B, b + 1, -1)
     q = q_ref[0]     # [nkv, g, hd] bf16, pre-scaled
     g = q.shape[1]
 
@@ -198,20 +245,7 @@ def _decode_kernel(
             def _():
                 wait_chunk(0, 0, slot)
         else:
-            slot = jax.lax.rem(base + c, 2)
-            nxt = jax.lax.rem(base + c + 1, 2)
-
-            # prefetch BEFORE waiting: next chunk of this sequence, or
-            # chunk 0 of the next sequence (cross-grid-step chain)
-            @pl.when(c + 1 < n_chunks)
-            def _():
-                start_chunk(b, c + 1, nxt)
-
-            @pl.when((c + 1 == n_chunks) & (b + 1 < B))
-            def _():
-                start_chunk(b + 1, 0, nxt)
-
-            wait_chunk(b, c, slot)
+            slot = chain_step(b, c, n_chunks, base, next_row)
         if debug_mode == "dma_only":
             acc = acc + jnp.max(k_buf[slot].astype(jnp.float32)) \
                 + jnp.max(v_buf[slot].astype(jnp.float32))

@@ -171,6 +171,86 @@ def test_bench_serving_forensics_ab_streams_identical():
     assert rep["tail"]["exemplars"] >= 1
 
 
+def test_run_round_help_exits_zero():
+    """benchmarks/run_round.py is not matched by the bench_*.py glob
+    above, so it gets its own drift gate: --help must import the driver
+    and exit 0, with the round's mode/subset knobs wired."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "run_round.py"), "--help"],
+        capture_output=True, text=True, env=env, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-2000:])
+    assert "--mode" in r.stdout and "--only" in r.stdout
+
+
+@pytest.mark.slow
+def test_run_round_smoke_emits_gated_json_per_bench():
+    """The round driver end to end at smoke scale: one JSON line per
+    bench, every line labeled mode=smoke, and every TPU acceptance gate
+    PRESENT but skipped (interpret/mocker numbers must never satisfy a
+    chip bar).  This is the r06 cash-in path minus the chip."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "run_round.py"), "--mode", "smoke"],
+        capture_output=True, text=True, env=env, timeout=900, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    lines = [json.loads(line) for line in r.stdout.splitlines()
+             if line.startswith("{")]
+    by_bench = {rep["bench"]: rep for rep in lines}
+    assert set(by_bench) == {"prefill", "kv_quant", "serving"}
+    gate_names = set()
+    for rep in by_bench.values():
+        assert rep["round"] == "r06"
+        assert rep["mode"] == "smoke"
+        assert rep["gates"], rep
+        for g in rep["gates"]:
+            assert g["status"] == "skipped_smoke", g
+            gate_names.add(g["name"])
+        assert "result" in rep
+    assert gate_names == {"prefill_pallas_mfu", "int8_pallas_ge_bf16",
+                          "zero_mid_serving_compiles"}
+    # the per-bench results carry the round's measurement surfaces
+    assert "pallas_interpret" in by_bench["prefill"]["result"]["impls"]
+    rows = by_bench["kv_quant"]["result"]["decode"]["rows"]
+    assert {(r_["kv_dtype"], r_["attn_impl"]) for r_ in rows} >= {
+        ("bf16", "pallas_interpret"), ("int8", "pallas_interpret")}
+    assert by_bench["serving"]["result"]["impls"]["engine"] == "mocker"
+
+
+def test_run_round_only_subset_and_impl_flag_vocab():
+    """--only serving keeps the driver to one bench, and the serving
+    bench's impl-stamp flag vocabulary (kept as literals so the mocker
+    bench stays jax-free) must still cover the canonical impl tuples —
+    the parity the bench's comment promises."""
+    from dynamo_tpu.ops.fused_sampling import EPILOGUE_MODES
+    from dynamo_tpu.ops.packed_prefill import PACKED_IMPLS
+    from dynamo_tpu.ops.paged_attention import DECODE_IMPLS
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_serving.py"), "--help"],
+        capture_output=True, text=True, env=env, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0
+    for impl in (*PACKED_IMPLS, *DECODE_IMPLS, *EPILOGUE_MODES):
+        assert impl in r.stdout, f"--help missing impl choice {impl!r}"
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "run_round.py"), "--mode", "smoke",
+         "--only", "serving"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert r2.returncode == 0, (r2.stdout[-2000:], r2.stderr[-2000:])
+    lines = [json.loads(line) for line in r2.stdout.splitlines()
+             if line.startswith("{")]
+    assert [rep["bench"] for rep in lines] == ["serving"]
+
+
 def test_bench_planner_loop_ab_closed_beats_static():
     """bench_planner_loop --policy ab at smoke scale: the closed loop
     must hold the latency targets with FEWER worker-seconds than static

@@ -1,0 +1,196 @@
+"""Engine-level fused sampling epilogue contracts (PR 17).
+
+EngineConfig.sampling_epilogue="fused" swaps the decode/decode_multi
+programs onto the hidden-state surface (models/llama.py decode_hidden /
+decode_multi_hidden) + the streaming epilogue (ops/fused_sampling.py).
+The contracts pinned here:
+
+  * greedy streams are byte-identical epilogue on vs off, with overlap
+    scheduling ON and an int8 KV cache (the serving composition);
+  * seeded sampled streams are draw-identical (same keys, same window);
+  * the epilogue rides the SAME program families as the reference path
+    (it is a static init-time choice baked into the partials, not a
+    dispatch key): warmup + first request compile each shape once and
+    steady-state serving recompiles nothing;
+  * config validation fails fast on junk values, MLA families (no
+    hidden-state decode surface) fall back to "off", and the worker
+    CLI parses the flag.
+"""
+
+import asyncio
+
+import pytest
+
+# real-JAX-engine tests: XLA compiles and device work run inside the
+# async test bodies (see test_engine.py's rationale)
+pytestmark = pytest.mark.allow_slow_callbacks
+
+from test_engine import collect, greedy_req
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.protocols import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+def _cfg(**kw):
+    from test_engine import FP32
+
+    defaults = dict(model_config=FP32, block_size=4, num_blocks=128,
+                    max_blocks_per_seq=16, max_num_seqs=2,
+                    prefill_buckets=(8, 16), seed=7)
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+async def _run(cfg, req):
+    eng = JaxEngine(cfg)
+    toks = await collect(eng, req)
+    await eng.close()
+    return toks
+
+
+def _sampled_req(tokens, n, rid, *, temperature, top_k=0, top_p=1.0,
+                 seed=123):
+    return PreprocessedRequest(
+        token_ids=tokens, request_id=rid,
+        sampling=SamplingOptions(temperature=temperature, top_k=top_k,
+                                 top_p=top_p, seed=seed),
+        stop=StopConditions(max_tokens=n, ignore_eos=True))
+
+
+PROMPT = [5, 9, 13, 2, 7, 11, 3, 1, 8, 20]
+
+
+async def test_greedy_byte_identity_overlap_int8():
+    """The acceptance gate: epilogue ON vs OFF greedy streams are
+    byte-identical with overlap scheduling ON and kv_cache_dtype=int8 —
+    the fused path composes with the whole fast stack."""
+    ref = await _run(
+        _cfg(kv_cache_dtype="int8", overlap_scheduling=True,
+             sampling_epilogue="off"),
+        greedy_req(list(PROMPT), 10, "ep-off"))
+    fused = await _run(
+        _cfg(kv_cache_dtype="int8", overlap_scheduling=True,
+             sampling_epilogue="fused"),
+        greedy_req(list(PROMPT), 10, "ep-on"))
+    assert len(ref) == 10  # a crashed engine's empty stream is vacuous
+    assert fused == ref
+
+
+async def test_sampled_draw_identity():
+    """Seeded temperature/top-k/top-p request: the streamed window must
+    make every per-step categorical draw the token the reference path
+    draws (distribution-identity realized as draw-identity at a fixed
+    key stream)."""
+    req = _sampled_req(list(PROMPT), 12, "ep-s", temperature=0.8,
+                       top_k=20, top_p=0.9)
+    ref = await _run(_cfg(sampling_epilogue="off"), req)
+    req2 = _sampled_req(list(PROMPT), 12, "ep-s2", temperature=0.8,
+                        top_k=20, top_p=0.9)
+    fused = await _run(_cfg(sampling_epilogue="fused"), req2)
+    assert len(ref) == 12
+    assert fused == ref
+
+
+async def test_zero_recompiles_with_epilogue():
+    """The epilogue is baked into the decode partials (no new program
+    family, no new dispatch key): after warmup + the first request,
+    same-shape serving compiles NOTHING — the pinned out_shardings
+    zero-recompile invariant covers the fused programs too."""
+    eng = JaxEngine(_cfg(sampling_epilogue="fused",
+                         kv_cache_dtype="int8", decode_fused_steps=2))
+    try:
+        await asyncio.to_thread(eng.warmup_decode)
+        await collect(eng, greedy_req(list(PROMPT), 12, "ep-r0"))
+        counts = dict(eng.compile_watch.counts)
+        assert counts.get("prefill_packed", 0) == 1
+        assert counts.get("decode", 0) >= 1
+        await collect(eng, greedy_req(
+            [6, 10, 14, 3, 8, 12, 4, 2, 9, 21], 12, "ep-r1"))
+        await collect(eng, _sampled_req(
+            [9, 13, 17, 6, 11, 15, 7, 5, 12, 24], 12, "ep-r2",
+            temperature=0.7, top_k=8))
+        assert dict(eng.compile_watch.counts) == counts, \
+            "steady-state serving recompiled an epilogue program"
+    finally:
+        await eng.close()
+
+
+async def test_warmup_serializes_with_steps():
+    """warmup_decode holds _step_lock for its dispatch+restore section.
+
+    The worker serves its generate endpoint (and arms the health-check
+    canary) before warmup runs, so a canary probe can start the
+    scheduler loop while warmup is still compiling; an unlocked
+    _sched_step then reads self.kv between two warmup dispatches that
+    already donated it ("Array has been deleted" in _prefill_packed, a
+    permanently dead engine loop).  Pin the serialization contract: a
+    held step lock blocks warmup, and serving after a contended warmup
+    still streams."""
+    import threading
+    import time
+
+    eng = JaxEngine(_cfg(decode_fused_steps=1))
+    try:
+        # first warmup pays the compiles so the contended one below
+        # measures lock behavior, not XLA
+        await asyncio.to_thread(eng.warmup_decode)
+        eng._step_lock.acquire()
+        t = threading.Thread(target=eng.warmup_decode, daemon=True)
+        t.start()
+        t.join(timeout=0.5)
+        try:
+            assert t.is_alive(), \
+                "warmup_decode ran without taking the step lock"
+        finally:
+            eng._step_lock.release()
+        deadline = time.monotonic() + 30.0
+        while t.is_alive() and time.monotonic() < deadline:
+            t.join(timeout=0.2)
+        assert not t.is_alive()
+        toks = await collect(eng, greedy_req(list(PROMPT), 10, "ep-w"))
+        assert len(toks) == 10
+    finally:
+        await eng.close()
+
+
+def test_config_validation_and_mode():
+    eng = JaxEngine(_cfg(sampling_epilogue="fused"))
+    assert eng.sampling_epilogue == "fused"
+    eng2 = JaxEngine(_cfg())
+    assert eng2.sampling_epilogue == "off"
+    with pytest.raises(ValueError, match="sampling_epilogue"):
+        JaxEngine(_cfg(sampling_epilogue="pallas"))
+
+
+def test_cli_parses_sampling_epilogue():
+    from dynamo_tpu.engine.__main__ import build_args
+
+    a = build_args().parse_args(["--sampling-epilogue", "fused"])
+    assert a.sampling_epilogue == "fused"
+    assert build_args().parse_args([]).sampling_epilogue == "off"
+    with pytest.raises(SystemExit):
+        build_args().parse_args(["--sampling-epilogue", "pallas"])
+
+
+def test_worker_mdc_advertises_epilogue():
+    """The MDC runtime_config must carry the EFFECTIVE epilogue mode so
+    routers/planners can tell fused workers from reference ones."""
+    from dynamo_tpu.engine.worker import JaxEngineWorker
+
+    w = JaxEngineWorker(None, _cfg(sampling_epilogue="fused"))
+    assert w.card.runtime_config["sampling_epilogue"] == "fused"
+    # MLA's absorbed-latent decode has no hidden-state surface
+    # (decode_hidden/unembed_weight): the engine degrades fused -> off
+    # (same precedent as kv_cache_dtype), and the card must carry the
+    # engine's RESOLVED mode, not the requested one
+    mla_cfg = EngineConfig(model="tiny-mla", block_size=4, num_blocks=32,
+                           max_blocks_per_seq=8,
+                           sampling_epilogue="fused")
+    w2 = JaxEngineWorker(None, mla_cfg)
+    w2.engine = JaxEngine(mla_cfg)
+    assert w2.engine.sampling_epilogue == "off"
+    assert w2.card.runtime_config["sampling_epilogue"] == "off"

@@ -1,0 +1,147 @@
+"""Fused sampling/top-k epilogue unit parity (ops/fused_sampling.py).
+
+The epilogue streams the final projection in vocab tiles and reduces on
+the fly; its contract against engine/sampler.py is byte-identity at
+greedy and draw-identity at seeded sampled settings (same key, same
+candidate window, same nucleus mask -> the categorical picks the same
+index).  Every test here compares against the materialize-then-sample
+reference on the SAME (hidden, unembedding) operands, across tile
+widths that exercise the clamped-overlap last tile, single-tile, and
+tile-larger-than-vocab plans.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import sampler
+from dynamo_tpu.ops import fused_sampling as fs
+
+# tile widths: non-divisor (overlapped last tile), divisor, single
+# tile, tile > vocab (clamped to V)
+TILES = (64, 100, 256, 1000, 4096)
+B, D, V = 5, 32, 1000
+
+
+def _case(seed=0, dtype=jnp.float32, vocab=V):
+    rng = np.random.default_rng(seed)
+    h = jnp.asarray(rng.standard_normal((B, D)), dtype)
+    w = jnp.asarray(rng.standard_normal((D, vocab)), dtype)
+    logits = (h @ w).astype(jnp.float32)  # the reference _logits matmul
+    return h, w, logits
+
+
+def _sampling_batch():
+    """Mixed per-slot settings: greedy slot, plain temperature, top-k,
+    top-p, and all three — the heterogeneous batch one compiled
+    program serves."""
+    seeds = jnp.asarray([7, 11, 13, 17, 23], jnp.int32)
+    steps = jnp.asarray([0, 3, 9, 1, 42], jnp.int32)
+    temps = jnp.asarray([0.0, 0.7, 1.3, 0.9, 0.8], jnp.float32)
+    top_ks = jnp.asarray([0, 0, 20, 0, 5], jnp.int32)
+    top_ps = jnp.asarray([1.0, 1.0, 1.0, 0.9, 0.85], jnp.float32)
+    return seeds, steps, temps, top_ks, top_ps
+
+
+def test_cap_matches_sampler():
+    """The window replay is only valid if both sides cap at the same
+    candidate count."""
+    assert fs.CAP == sampler.CAP
+
+
+@pytest.mark.parametrize("tile", TILES)
+def test_fused_greedy_byte_identity(tile):
+    h, w, logits = _case(0)
+    ref = sampler.greedy_tokens(logits)
+    out = fs.fused_greedy_tokens(h, w, tile=tile)
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("tile", TILES)
+def test_fused_sample_draw_identity(tile):
+    """Same seeds/steps/settings -> the streamed window must make the
+    categorical draw the exact token the full-vocab reference draws."""
+    h, w, logits = _case(1)
+    batch = _sampling_batch()
+    ref = sampler.sample_tokens(logits, *batch)
+    out = fs.fused_sample_tokens(h, w, *batch, tile=tile)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_fused_greedy_tie_break_first_max():
+    """jnp.argmax returns the FIRST maximum; the streaming strict-`>`
+    update must too, including when the duplicate maxima land in
+    different tiles."""
+    h = jnp.ones((1, 2), jnp.float32)
+    # columns 3 and 257 get identical (maximal) logits, tiles of 128
+    # put them in tile 0 and tile 2
+    w = np.zeros((2, 512), np.float32)
+    w[:, 3] = 2.0
+    w[:, 257] = 2.0
+    w = jnp.asarray(w)
+    logits = (h @ w).astype(jnp.float32)
+    assert int(jnp.argmax(logits[0])) == 3
+    out = fs.fused_greedy_tokens(h, w, tile=128)
+    assert int(out[0]) == 3
+
+
+def test_fused_sample_tie_break_matches_reference():
+    """Duplicate logit values across tiles: the merge order (running
+    window before tile candidates) must reproduce lax.top_k's stable
+    lower-index preference, so the masked categorical sees the same
+    (vals, idx) the reference sees."""
+    h = jnp.ones((1, 2), jnp.float32)
+    w = np.zeros((2, 300), np.float32)
+    w[:, 10] = 1.5
+    w[:, 190] = 1.5  # same value, later tile at tile=128
+    w[:, 20] = 1.0
+    w = jnp.asarray(w)
+    logits = (h @ w).astype(jnp.float32)
+    batch = tuple(jnp.asarray(a) for a in (
+        [3], [5], [1.0], [2], [1.0]))
+    batch = (batch[0].astype(jnp.int32), batch[1].astype(jnp.int32),
+             batch[2].astype(jnp.float32), batch[3].astype(jnp.int32),
+             batch[4].astype(jnp.float32))
+    ref = sampler.sample_tokens(logits, *batch)
+    out = fs.fused_sample_tokens(h, w, *batch, tile=128)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_fused_bf16_operands_match_reference():
+    """bf16 hidden/unembedding (the serving dtype): per-tile matmul
+    columns are the same dots as the full matmul's columns, so greedy
+    stays byte-identical and sampled draws stay identical."""
+    h, w, logits = _case(2, dtype=jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(fs.fused_greedy_tokens(h, w, tile=192)),
+        np.asarray(sampler.greedy_tokens(logits)))
+    batch = _sampling_batch()
+    np.testing.assert_array_equal(
+        np.asarray(fs.fused_sample_tokens(h, w, *batch, tile=192)),
+        np.asarray(sampler.sample_tokens(logits, *batch)))
+
+
+def test_fused_small_vocab_tile_plan():
+    """vocab barely above CAP: the plan clamps tile to V and the whole
+    stream is one tile — the degenerate path must still match."""
+    h, w, logits = _case(3, vocab=sampler.CAP + 7)
+    batch = _sampling_batch()
+    np.testing.assert_array_equal(
+        np.asarray(fs.fused_sample_tokens(h, w, *batch, tile=4096)),
+        np.asarray(sampler.sample_tokens(logits, *batch)))
+    np.testing.assert_array_equal(
+        np.asarray(fs.fused_greedy_tokens(h, w, tile=7)),
+        np.asarray(sampler.greedy_tokens(logits)))
+
+
+def test_fused_inside_jit_under_vmapped_settings():
+    """The epilogue runs inside the jitted decode program; jit must not
+    change the draws (pure functions of the same key/window)."""
+    h, w, logits = _case(4)
+    batch = _sampling_batch()
+    ref = sampler.sample_tokens(logits, *batch)
+    out = jax.jit(
+        lambda *a: fs.fused_sample_tokens(*a, tile=256))(h, w, *batch)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
