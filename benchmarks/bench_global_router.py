@@ -1,0 +1,356 @@
+"""Mega-fleet closed-loop bench: global router over pool namespaces.
+
+Builds the whole PR-18 request plane in one process — P pools (one agg,
+one disagg with a mocker prefill tier) × F replica-sync'd frontends per
+pool × mocker workers — then drives T tenants of shared-prefix streams
+through `GlobalRouterService` and re-drives the SAME trace through a
+single frontend directly, asserting the token streams are
+byte-identical (MockEngine streams are position-addressed by request
+seed, so ANY placement must produce the same bytes — the proxy layer
+may add zero token-level noise).
+
+Reported per r06 JSON line:
+
+  * p99 route latency (receive -> forward-started inside the grouter)
+  * per-replica `dynamo_router_overlap_staleness_ratio` and its spread
+    within each pool (the replica-sync convergence signal)
+  * per-frontend routed-decision counts + goodput spread (how evenly
+    the replica tier shares the load)
+  * per-pool routed counts by classification reason (both classes must
+    see traffic: the short-prompt tenants land agg, the long-prompt
+    tenants clear the conditional-disagg thresholds)
+
+Smoke scale (tier-1, seconds on CPU): 2 pools x 3 frontends x ~3
+workers, ~60 streams at concurrency ~20.  TPU/full scale: 1k+
+concurrent streams across dozens of workers; gates enforced.
+"""
+
+import argparse
+import asyncio
+import json
+import random
+import time
+import uuid
+import zlib
+
+import aiohttp
+
+from dynamo_tpu.disagg.prefill_router import ConditionalDisaggConfig
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.global_router import GlobalRouterConfig, GlobalRouterService
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.router.kv_router import make_kv_route_factory
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig
+
+MODEL = "bench-model"
+BLOCK = 16
+# classification geometry, scaled down from the reference thresholds so
+# the smoke run stays in CPU-seconds: the grouter estimates ~4 chars per
+# token, the byte tokenizer counts 1 per char, so the frontend-side
+# threshold is 4x the grouter-side one for the same prompt
+GROUTER_MIN_ISL = 256
+FRONTEND_MIN_ISL = 1024
+LONG_PROMPT_CHARS = 1600
+SHORT_PROMPT_CHARS = 180
+SHARED_PREFIX_FRAC = 0.6
+
+SCALES = {
+    "smoke": dict(pools=2, frontends=3, decode_workers=2,
+                  prefill_workers=1, streams=60, concurrency=20,
+                  tenants=4, max_tokens=16),
+    "tpu": dict(pools=2, frontends=3, decode_workers=12,
+                prefill_workers=6, streams=1500, concurrency=1024,
+                tenants=16, max_tokens=32),
+}
+
+
+def build_trace(scale: dict) -> list:
+    """Multi-tenant shared-prefix request trace: half the tenants speak
+    short prompts (agg class), half long ones (disagg class); within a
+    tenant every stream shares a prefix and diverges in the suffix."""
+    rng = random.Random(42)
+    alphabet = "abcdefghijklmnopqrstuvwxyz "
+    reqs = []
+    for t in range(scale["tenants"]):
+        long_class = t % 2 == 1
+        chars = LONG_PROMPT_CHARS if long_class else SHORT_PROMPT_CHARS
+        prefix = "".join(rng.choice(alphabet)
+                         for _ in range(int(chars * SHARED_PREFIX_FRAC)))
+        for s in range(scale["streams"] // scale["tenants"]):
+            suffix = "".join(rng.choice(alphabet)
+                             for _ in range(chars - len(prefix)))
+            key = f"t{t}s{s}"
+            reqs.append({
+                "key": key, "tenant": t, "long": long_class,
+                "body": {
+                    "model": MODEL,
+                    "prompt": prefix + suffix,
+                    "max_tokens": scale["max_tokens"],
+                    "stream": True,
+                    "seed": zlib.crc32(key.encode()) & 0x7FFFFFFF,
+                },
+            })
+    return reqs
+
+
+async def start_pool(cluster: str, ns: str, disagg: bool, scale: dict):
+    """One pool namespace: worker runtime + per-frontend runtimes (a
+    runtime per replica gives each its own metrics registry, so the
+    per-replica staleness gauges are genuine)."""
+    wrt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc", namespace=ns),
+        cluster_id=cluster).start()
+    common = dict(model_name=MODEL, block_size=BLOCK,
+                  base_step_s=0.0005, prefill_s_per_token=0.0,
+                  decode_s_per_seq=0.0)
+    workers = []
+    for _ in range(scale["decode_workers"]):
+        workers.append(await MockerWorker(
+            wrt, MockEngineArgs(**common), namespace=ns).start())
+    if disagg:
+        for _ in range(scale["prefill_workers"]):
+            workers.append(await MockerWorker(
+                wrt, MockEngineArgs(role="prefill", **common),
+                namespace=ns, component="prefill").start())
+    frontends = []
+    for _ in range(scale["frontends"]):
+        rt = await DistributedRuntime(
+            config=RuntimeConfig(discovery_backend="mem",
+                                 event_plane="inproc", namespace=ns),
+            cluster_id=cluster).start()
+        manager = ModelManager()
+        watcher = await ModelWatcher(
+            rt, manager, router_mode=RouterMode.KV,
+            make_route=make_kv_route_factory(
+                rt, overlap_score_weight=1.0, temperature=0.0),
+            disagg_config=ConditionalDisaggConfig(
+                min_effective_isl=FRONTEND_MIN_ISL,
+                min_effective_ratio=0.7),
+            namespaces={ns}).start()
+        svc = await HttpService(rt, manager, host="127.0.0.1", port=0,
+                                advertise=True).start()
+        frontends.append({"rt": rt, "manager": manager,
+                          "watcher": watcher, "svc": svc,
+                          "port": svc._runner.addresses[0][1]})
+    return {"ns": ns, "wrt": wrt, "workers": workers,
+            "frontends": frontends}
+
+
+async def stop_pool(pool: dict) -> None:
+    for fe in pool["frontends"]:
+        await fe["svc"].close()
+        await fe["watcher"].close()
+        await fe["rt"].shutdown()
+    for w in pool["workers"]:
+        await w.close()
+    await pool["wrt"].shutdown()
+
+
+async def wait_ready(pools: list) -> None:
+    for pool in pools:
+        for fe in pool["frontends"]:
+            for _ in range(200):
+                if fe["manager"].get(MODEL):
+                    break
+                await asyncio.sleep(0.02)
+            assert fe["manager"].get(MODEL), (
+                f"frontend in {pool['ns']} never saw {MODEL}")
+
+
+async def drive(url: str, reqs: list, concurrency: int) -> dict:
+    """Fire the trace at `url` and collect per-request concatenated
+    delta text + client-side latencies."""
+    sem = asyncio.Semaphore(concurrency)
+    out = {}
+
+    async def one(session, req):
+        async with sem:
+            t0 = time.monotonic()
+            ttft = None
+            text = []
+            async with session.post(f"{url}/v1/completions",
+                                    json=req["body"]) as r:
+                assert r.status == 200, (r.status, await r.text())
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:"):
+                        continue
+                    data = line[5:].strip()
+                    if data == "[DONE]":
+                        break
+                    if ttft is None:
+                        ttft = time.monotonic() - t0
+                    obj = json.loads(data)
+                    for ch in obj.get("choices", ()):
+                        if ch.get("text"):
+                            text.append(ch["text"])
+            out[req["key"]] = {
+                "text": "".join(text),
+                "ttft_s": ttft,
+                "total_s": time.monotonic() - t0,
+                "long": req["long"],
+            }
+
+    conn = aiohttp.TCPConnector(limit=concurrency + 8)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        await asyncio.gather(*(one(session, r) for r in reqs))
+    return out
+
+
+def quantile(vals, p):
+    vals = sorted(vals)
+    if not vals:
+        return None
+    return vals[min(int(p * len(vals)), len(vals) - 1)]
+
+
+def staleness_rollup(pools: list) -> dict:
+    """Per-replica staleness straight from each frontend's KvRouter
+    (the same numbers the grouter scrapes over /metrics)."""
+    per_pool = {}
+    for pool in pools:
+        replicas = {}
+        for i, fe in enumerate(pool["frontends"]):
+            router = (fe["svc"].debug_state().get("router") or {}).get(
+                MODEL, {})
+            replicas[f"fe{i}"] = {
+                "staleness_ratio": router.get("staleness_ratio"),
+                "decisions": router.get("decisions", 0),
+            }
+        vals = [r["staleness_ratio"] for r in replicas.values()
+                if r["staleness_ratio"] is not None]
+        decs = [r["decisions"] for r in replicas.values()]
+        mean_d = sum(decs) / max(len(decs), 1)
+        per_pool[pool["ns"]] = {
+            "replicas": replicas,
+            "staleness_spread": (round(max(vals) - min(vals), 4)
+                                 if len(vals) > 1 else None),
+            # goodput spread: how evenly the replica tier shared the
+            # pool's load (0 = perfectly even)
+            "goodput_spread": (round((max(decs) - min(decs))
+                                     / max(mean_d, 1e-9), 4)
+                               if decs else None),
+        }
+    return per_pool
+
+
+async def run(mode: str) -> dict:
+    scale = SCALES[mode]
+    cluster = uuid.uuid4().hex
+    pools = []
+    for p in range(scale["pools"]):
+        pools.append(await start_pool(cluster, f"pool{p}",
+                                      disagg=(p % 2 == 1), scale=scale))
+    grt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc", namespace="global"),
+        cluster_id=cluster).start()
+    grouter = await GlobalRouterService(
+        grt, host="127.0.0.1", port=0,
+        config=GlobalRouterConfig(disagg_min_isl=GROUTER_MIN_ISL,
+                                  disagg_ratio=0.7),
+        staleness_scrape_s=0.5).start()
+    try:
+        await wait_ready(pools)
+        # pool discovery: both pools with all frontends
+        for _ in range(200):
+            ps = grouter.directory.pools_for_model(MODEL)
+            if (len(ps) >= scale["pools"]
+                    and all(len(p.frontends) >= scale["frontends"]
+                            for p in ps)):
+                break
+            await asyncio.sleep(0.02)
+        reqs = build_trace(scale)
+        t0 = time.monotonic()
+        routed = await drive(f"http://127.0.0.1:{grouter.port}", reqs,
+                             scale["concurrency"])
+        routed_dt = time.monotonic() - t0
+        await asyncio.sleep(0.6)  # let the staleness scrape fire once
+        grouter_state = grouter.debug_state()
+        staleness = staleness_rollup(pools)
+
+        # single-frontend baseline: same trace, straight at one pool-0
+        # replica (token streams are position-addressed by seed, so the
+        # bytes must match no matter who served them)
+        base_url = f"http://127.0.0.1:{pools[0]['frontends'][0]['port']}"
+        baseline = await drive(base_url, reqs, scale["concurrency"])
+        mismatches = [k for k in routed
+                      if routed[k]["text"] != baseline[k]["text"]]
+        empty = [k for k, v in routed.items() if not v["text"]]
+
+        ttfts = [v["ttft_s"] for v in routed.values()
+                 if v["ttft_s"] is not None]
+        pools_hit = {k.split("/", 1)[0]
+                     for k in grouter_state["routed"]}
+        return {
+            "mode": mode, "scale": scale,
+            "streams": len(reqs),
+            "wall_s": round(routed_dt, 3),
+            "streams_per_s": round(len(reqs) / routed_dt, 1),
+            "byte_identical": not mismatches,
+            "mismatches": len(mismatches),
+            "empty_streams": len(empty),
+            "route_latency": grouter_state["route_latency"],
+            "routed": grouter_state["routed"],
+            "pools_hit": sorted(pools_hit),
+            "client_ttft_ms": {
+                "p50": round((quantile(ttfts, 0.5) or 0) * 1e3, 2),
+                "p99": round((quantile(ttfts, 0.99) or 0) * 1e3, 2),
+            },
+            "staleness": staleness,
+            "grouter_staleness_scrape": grouter_state["staleness"],
+        }
+    finally:
+        await grouter.close()
+        await grt.shutdown()
+        for pool in pools:
+            await stop_pool(pool)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description="mega-fleet global-router closed loop "
+                    "(see module docstring)")
+    p.add_argument("--mode", default="smoke", choices=["smoke", "tpu"])
+    args = p.parse_args()
+    enforced = args.mode == "tpu"
+    result = asyncio.run(run(args.mode))
+
+    def g(name, target, value, ok, always=False):
+        status = (("pass" if ok else "fail")
+                  if (enforced or always) else "skipped_smoke")
+        if value is None:
+            status = "fail_missing" if (enforced or always) else \
+                "skipped_smoke"
+        return {"name": name, "target": target, "value": value,
+                "status": status}
+
+    p99 = result["route_latency"].get("p99_ms")
+    spreads = [s["staleness_spread"]
+               for s in result["staleness"].values()
+               if s["staleness_spread"] is not None]
+    max_spread = max(spreads) if spreads else None
+    gates = [
+        # correctness gates hold in every mode: the proxy layer must
+        # add zero token-level noise and both classes must route
+        g("grouter_byte_identity", "routed == single-frontend bytes",
+          result["byte_identical"], result["byte_identical"],
+          always=True),
+        g("grouter_pools_routed", ">= 2 pools",
+          len(result["pools_hit"]), len(result["pools_hit"]) >= 2,
+          always=True),
+        g("grouter_route_p99_ms", "< 5.0", p99,
+          p99 is not None and p99 < 5.0),
+        g("grouter_staleness_spread", "< 0.25", max_spread,
+          max_spread is not None and max_spread < 0.25),
+    ]
+    print(json.dumps({
+        "bench": "global_router", "round": "r06", "mode": args.mode,
+        "gates": gates, "result": result,
+    }), flush=True)
+    return 1 if any(x["status"] == "fail" for x in gates) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

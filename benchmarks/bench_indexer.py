@@ -1,18 +1,69 @@
-"""KV indexer microbenchmark.
+"""KV indexer microbenchmark: python vs native, with parity assert.
 
 Reference claim to compare against: >10M events+requests/s, p99 <10µs
-(lib/kv-router/src/indexer/README.md:5, on its CPU).  Prints events/s,
-matches/s and p99 latency for the Python and C++ indexers.
+(lib/kv-router/src/indexer/README.md:5, on its CPU).  Benches every
+built indexer implementation (PyKvIndexer always; NativeKvIndexer when
+`make -C native` has produced the shared library), asserts the two
+agree on a randomized store/remove/query trace first — a fast wrong
+indexer routes every request to the wrong worker — and emits one
+r06-convention gated JSON summary line:
+
+    {"bench": "indexer", "round": "r06", "mode": ..., "gates": [...],
+     "result": {"impls": {...}, "parity": ...}}
+
+The events/s + p99 gate is enforced in tpu mode (the round's quoted
+numbers come from the serving host's CPU) and reported skipped_smoke
+elsewhere, matching benchmarks/run_round.py which wires this in.
 """
 
+import argparse
+import json
 import random
 import statistics
-import sys
 import time
 
-sys.path.insert(0, ".")
+from dynamo_tpu.router.indexer import PyKvIndexer, make_indexer
 
-from dynamo_tpu.router.indexer import PyKvIndexer  # noqa: E402
+TARGET_EVENTS_PER_S = 10e6
+TARGET_P99_US = 10.0
+
+
+def parity_check(n_ops: int = 2000, seed: int = 11) -> dict:
+    """Randomized Py-vs-native equivalence on one interleaved trace of
+    stores, removals, worker drops and queries.  Returns the rollup;
+    raises AssertionError on the first divergence."""
+    try:
+        from dynamo_tpu.router.native_indexer import NativeKvIndexer
+    except (ImportError, OSError):
+        return {"checked": False, "reason": "native indexer not built"}
+    rng = random.Random(seed)
+    py, cc = PyKvIndexer(), NativeKvIndexer()
+    universe = [(i << 70) | (i * 2654435761 + 17) for i in range(4096)]
+    queries = 0
+    for _ in range(n_ops):
+        op = rng.random()
+        w = rng.randrange(8)
+        start = rng.randrange(len(universe) - 64)
+        chunk = universe[start:start + rng.randrange(1, 64)]
+        if op < 0.55:
+            py.apply_stored(w, chunk)
+            cc.apply_stored(w, chunk)
+        elif op < 0.75:
+            py.apply_removed(w, chunk)
+            cc.apply_removed(w, chunk)
+        elif op < 0.80:
+            py.remove_worker(w)
+            cc.remove_worker(w)
+        else:
+            qp, qc = py.find_matches(chunk), cc.find_matches(chunk)
+            assert qp == qc, (
+                f"indexer parity divergence on query {chunk[:4]}...: "
+                f"py={qp} native={qc}")
+            queries += 1
+    assert py.num_blocks == cc.num_blocks, (
+        f"block-count divergence: py={py.num_blocks} "
+        f"native={cc.num_blocks}")
+    return {"checked": True, "ops": n_ops, "queries": queries}
 
 
 def bench(ix, n_workers=16, n_events=20000, blocks_per_event=16,
@@ -45,27 +96,62 @@ def bench(ix, n_workers=16, n_events=20000, blocks_per_event=16,
     queries_per_s = n_queries / q_dt
     p50 = statistics.median(lat) * 1e6
     p99 = statistics.quantiles(lat, n=100)[98] * 1e6
-    return events_per_s, queries_per_s, p50, p99
+    return {"events_per_s": round(events_per_s, 1),
+            "queries_per_s": round(queries_per_s, 1),
+            "p50_us": round(p50, 2), "p99_us": round(p99, 2)}
 
 
-def main():
-    import argparse
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description="KV indexer microbenchmark (python vs native, with "
+                    "parity assert; see module docstring)")
+    p.add_argument("--mode", default="smoke", choices=["smoke", "tpu"],
+                   help="tpu enforces the reference's 10M events/s @ "
+                        "p99 <10µs claim; smoke reports skipped_smoke")
+    p.add_argument("--events", type=int, default=20000)
+    p.add_argument("--queries", type=int, default=20000)
+    p.add_argument("--parity-ops", type=int, default=2000)
+    args = p.parse_args()
+    enforced = args.mode == "tpu"
 
-    argparse.ArgumentParser(
-        description="KV indexer microbenchmark (no options; compares the "
-                    "python and native indexers)").parse_args()
-    rows = [("python", PyKvIndexer())]
+    parity = parity_check(args.parity_ops)
+    impls = {"py": make_indexer("py")}
     try:
-        from dynamo_tpu.router.native_indexer import NativeKvIndexer
-
-        rows.append(("c++", NativeKvIndexer()))
-    except ImportError:
-        print("(native indexer not built: make -C native)")
-    for name, ix in rows:
-        ev, q, p50, p99 = bench(ix)
-        print(f"{name:7s} events: {ev/1e6:7.2f}M blocks/s   "
-              f"queries: {q/1e3:7.1f}k/s   p50 {p50:6.1f}µs  p99 {p99:6.1f}µs")
+        impls["native"] = make_indexer("native")
+    except (ImportError, OSError):
+        pass
+    results = {name: bench(ix, n_events=args.events,
+                           n_queries=args.queries)
+               for name, ix in impls.items()}
+    # the claim row is scored on the promoted default (native when
+    # built), because that is what serves production routing
+    head = results.get("native") or results["py"]
+    ev, p99 = head["events_per_s"], head["p99_us"]
+    gates = [
+        {"name": "indexer_events_per_s",
+         "target": f">= {TARGET_EVENTS_PER_S:.0f}", "value": ev,
+         "status": ("pass" if ev >= TARGET_EVENTS_PER_S else "fail")
+         if enforced else "skipped_smoke"},
+        {"name": "indexer_query_p99_us",
+         "target": f"< {TARGET_P99_US}", "value": p99,
+         "status": ("pass" if p99 < TARGET_P99_US else "fail")
+         if enforced else "skipped_smoke"},
+        {"name": "indexer_parity",
+         "target": "py == native",
+         "value": parity.get("checked"),
+         # parity is enforced in EVERY mode a native lib exists: it is
+         # a correctness bar, not a perf bar
+         "status": "pass" if parity.get("checked") else "skipped_smoke"},
+    ]
+    print(json.dumps({
+        "bench": "indexer", "round": "r06", "mode": args.mode,
+        "gates": gates,
+        "result": {"impls": results, "parity": parity,
+                   "default_impl": ("native" if "native" in impls
+                                    else "py")},
+    }), flush=True)
+    return 1 if any(g["status"] == "fail" for g in gates) else 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -66,6 +66,17 @@ BENCH_ARGS = {
                   "--speedup", "4"],
         "tpu": ["--overlap", "ab"],
     },
+    "indexer": {
+        "script": "bench_indexer.py",
+        "smoke": ["--mode", "smoke", "--events", "4000",
+                  "--queries", "4000", "--parity-ops", "500"],
+        "tpu": ["--mode", "tpu"],
+    },
+    "global_router": {
+        "script": "bench_global_router.py",
+        "smoke": ["--mode", "smoke"],
+        "tpu": ["--mode", "tpu"],
+    },
 }
 
 
@@ -151,8 +162,24 @@ def eval_serving(lines, enforced):
     return gates, head or (rows[-1] if rows else None)
 
 
+def eval_gated_line(bench_name):
+    """Benches that emit their own r06 gated line (indexer,
+    global_router): adopt their gates verbatim — enforcement already
+    followed the --mode flag the driver passed down."""
+    def _eval(lines, enforced):
+        row = next((l for l in lines if l.get("bench") == bench_name),
+                   None)
+        if row is None:
+            return [gate(f"{bench_name}_summary_line", "present", None,
+                         False, True)], None
+        return row.get("gates", []), row.get("result")
+    return _eval
+
+
 EVALS = {"prefill": eval_prefill, "kv_quant": eval_kv_quant,
-         "serving": eval_serving}
+         "serving": eval_serving,
+         "indexer": eval_gated_line("indexer"),
+         "global_router": eval_gated_line("global_router")}
 
 
 def main() -> int:

@@ -73,6 +73,15 @@ Seam registry (name — wired at — supported actions):
                            entry (wedge = a worker that IGNORES drain,
                            forcing the connector's bounded-wait →
                            stop escalation; fail = drain raising)
+  grouter.classify         GlobalRouterService pool classification,
+                           per request (fail = classifier fault — the
+                           global router must degrade to round-robin
+                           over pools, never drop the request; delay)
+  router_sync.snapshot     RouterReplicaSync snapshot-on-subscribe
+                           answer, per joining peer (fail = snapshot
+                           build fault — the recv loop must drop the
+                           frame and stay alive, the joiner's retry
+                           re-requests it; delay)
 """
 
 from __future__ import annotations
@@ -113,6 +122,8 @@ SEAMS = frozenset({
     "planner.scale",
     "connector.spawn",
     "worker.drain",
+    "grouter.classify",
+    "router_sync.snapshot",
 })
 
 # how long a "wedge" blocks when no delay_s is given: effectively

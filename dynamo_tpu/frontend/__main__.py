@@ -46,6 +46,15 @@ def build_args() -> argparse.ArgumentParser:
     p.add_argument("--slo-objective", type=float, default=0.99,
                    help="SLO objective (good-request fraction) the "
                         "burn-rate error budget derives from")
+    # pool membership (global_router/): a pool frontend serves only its
+    # own namespace and registers itself so the global router finds it
+    p.add_argument("--pool-scoped", action="store_true",
+                   help="serve only models in this process's namespace "
+                        "(DYN_NAMESPACE) — the pool-frontend contract")
+    p.add_argument("--advertise", action="store_true",
+                   help="register this frontend in discovery even "
+                        "without a system-status port, so the global "
+                        "router can route to it")
     return p
 
 
@@ -84,6 +93,7 @@ async def main() -> None:
         rt, manager, router_mode=mode, make_route=make_route,
         disagg_config=disagg_config,
         session_affinity_ttl=affinity_ttl,
+        namespaces={rt.config.namespace} if args.pool_scoped else None,
     ).start()
     from ..obs.slo import SloConfig
 
@@ -92,6 +102,7 @@ async def main() -> None:
         busy_threshold=args.busy_threshold,
         slo=SloConfig(ttft_ms=args.slo_ttft_ms, itl_ms=args.slo_itl_ms,
                       objective=args.slo_objective),
+        advertise=True if args.advertise else None,
     ).start()
     grpc_service = None
     if args.grpc_port:

@@ -36,6 +36,9 @@ logger = logging.getLogger(__name__)
 SCHEMA = "dynamo.request.trace.v1"
 X_REQUEST_ID_HEADER = "x-request-id"
 TRACEPARENT_HEADER = "traceparent"
+# stamped by the global router (global_router/service.py) on forward, so
+# tail autopsies and request_end records name the pool that served it
+X_POOL_HEADER = "x-dyn-pool"
 
 
 # --------------------------- config / sinks ---------------------------------
@@ -152,6 +155,9 @@ class RequestTracker:
     session_id: Optional[str] = None
     endpoint: str = "chat"
     input_tokens: int = 0
+    # pool namespace the global router picked (X_POOL_HEADER); None when
+    # the request hit this frontend directly
+    pool: Optional[str] = None
 
     span_id: str = field(default_factory=lambda: secrets.token_hex(8))
     received_unix_ms: int = field(
@@ -213,7 +219,8 @@ class RequestTracker:
         return RequestTracker(
             request_id=request_id, model=model, sink=sink,
             x_request_id=headers.get(X_REQUEST_ID_HEADER) or request_id,
-            trace_id=trace_id, parent_span_id=parent, **kw)
+            trace_id=trace_id, parent_span_id=parent,
+            pool=headers.get(X_POOL_HEADER), **kw)
 
     # -- hooks along the pipeline ----------------------------------------
     def on_dispatch(self, instance_id: Optional[int]) -> None:
@@ -244,6 +251,8 @@ class RequestTracker:
         attrs: Dict[str, Any] = {"attempt": self._dispatches + 1}
         if instance_id is not None:
             attrs["worker"] = instance_id
+        if self.pool is not None:
+            attrs["pool"] = self.pool
         if decision:
             attrs.update(decision)
         self.hop("routed", **attrs)
@@ -383,6 +392,8 @@ class RequestTracker:
             "total_time_ms": round(total_ms, 3),
             "outcome": outcome,
         }
+        if self.pool is not None:
+            request["pool"] = self.pool
         if ttft_ms is not None:
             request["ttft_ms"] = round(ttft_ms, 3)
         if self._dispatch_t is not None:

@@ -54,7 +54,8 @@ class ModelWatcher:
     def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
                  router_mode: RouterMode = RouterMode.ROUND_ROBIN,
                  make_route=None, disagg_config=None,
-                 session_affinity_ttl: Optional[float] = None):
+                 session_affinity_ttl: Optional[float] = None,
+                 namespaces: Optional[set] = None):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
@@ -63,6 +64,10 @@ class ModelWatcher:
         self.disagg_config = disagg_config
         # sticky agent-session routing (ref session_affinity/): None = off
         self.session_affinity_ttl = session_affinity_ttl
+        # pool scoping (global_router/): a pool frontend serves ONLY its
+        # own namespace's models; None = watch every namespace (the
+        # single-frontend deployments that predate pools)
+        self.namespaces = namespaces
         self._cancel = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._clients: Dict[str, Any] = {}        # model name -> client
@@ -86,9 +91,11 @@ class ModelWatcher:
             ):
                 try:
                     if ev.type == "put" and ev.value:
-                        await self._add(
-                            ev.key, ModelDeploymentCard.from_dict(ev.value)
-                        )
+                        mdc = ModelDeploymentCard.from_dict(ev.value)
+                        if (self.namespaces is not None
+                                and mdc.namespace not in self.namespaces):
+                            continue
+                        await self._add(ev.key, mdc)
                     elif ev.type == "delete":
                         await self._remove_by_key(ev.key)
                 except Exception:
@@ -330,12 +337,18 @@ class HttpService:
     def __init__(self, runtime: DistributedRuntime, manager: ModelManager,
                  host: str = "0.0.0.0", port: int = 8000,
                  busy_threshold: Optional[int] = None,
-                 slo=None):
+                 slo=None, advertise: Optional[bool] = None):
         self.runtime = runtime
         self.manager = manager
         self.host = host
         self.port = port
         self.busy_threshold = busy_threshold
+        # discovery advertisement: None (default) registers the frontend
+        # instance only when a system-status server is up (the pre-pool
+        # behavior — obs/fleet.py needs system_addr to scrape it); True
+        # forces registration so the global router can discover this
+        # frontend as a pool member even without DYN_SYSTEM_PORT
+        self.advertise = advertise
         self.inflight = 0
         self._runner: Optional[web.AppRunner] = None
         self._slo_task: Optional[asyncio.Task] = None
@@ -934,6 +947,7 @@ class HttpService:
         state = {
             "kind": "frontend",
             "instance_id": self._fleet_instance_id,
+            "pool": self.runtime.config.namespace,
             "models": sorted(self.manager.models),
             "inflight": self.inflight,
             "busy_threshold": self.busy_threshold,
@@ -982,14 +996,20 @@ class HttpService:
             rt.register_forensics_source(
                 f"frontend:{self._fleet_instance_id}", self.forensics.dump)
         self._fleet_instance = None
-        if rt.system_address:
+        advertise = (self.advertise if self.advertise is not None
+                     else bool(rt.system_address))
+        if advertise:
             port = self._runner.addresses[0][1]
+            http_addr = f"{rt.config.tcp_host}:{port}"
+            metadata = {"kind": "frontend", "http_addr": http_addr,
+                        "pool": rt.config.namespace}
+            if rt.system_address:
+                metadata["system_addr"] = rt.system_address
             self._fleet_instance = Instance(
                 namespace=rt.config.namespace, component="frontend",
                 endpoint="http", instance_id=self._fleet_instance_id,
-                address=f"{rt.config.tcp_host}:{port}",
-                metadata={"kind": "frontend",
-                          "system_addr": rt.system_address},
+                address=http_addr,
+                metadata=metadata,
             )
             await rt.discovery.put(self._fleet_instance.key(),
                                    self._fleet_instance.to_dict())

@@ -1,0 +1,21 @@
+"""Global router: the pool-level request plane above the frontends.
+
+Ref: the reference's hierarchical `global_router` across pool namespaces
+(SURVEY.md:111).  A pool is one namespace running its own workers +
+frontend tier (agg or disagg); the global router discovers pools from
+the same discovery plane everything else uses, classifies each request
+by (ISL, predicted TTFT) / (context length, ITL load) with the
+conditional-disagg thresholds, and forwards to the chosen pool's
+frontend tier.  See pools.py (discovery), policy.py (classification),
+service.py (the HTTP proxy process).
+"""
+
+from .policy import Decision, GlobalRouterConfig, PoolClassifier
+from .pools import FrontendView, PoolDirectory, PoolView
+from .service import GlobalRouterService
+
+__all__ = [
+    "Decision", "GlobalRouterConfig", "PoolClassifier",
+    "FrontendView", "PoolDirectory", "PoolView",
+    "GlobalRouterService",
+]

@@ -7,6 +7,7 @@ for frontend/router/planner testing.
 import argparse
 import asyncio
 import logging
+import os
 
 from .. import obs
 from ..runtime import DistributedRuntime
@@ -18,7 +19,11 @@ from .worker import MockerWorker
 def build_args() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("dynamo_tpu.mocker")
     p.add_argument("--model-name", default="mock-model")
-    p.add_argument("--namespace", default="dynamo")
+    # DYN_NAMESPACE is the pool-membership contract (deploy/README.md
+    # "Pools"): a worker manifest labeled for a pool must land in it
+    # without also repeating the label as a flag
+    p.add_argument("--namespace",
+                   default=os.environ.get("DYN_NAMESPACE", "dynamo"))
     p.add_argument("--component", default="mocker")
     p.add_argument("--block-size", type=int, default=64)
     p.add_argument("--num-blocks", type=int, default=4096)

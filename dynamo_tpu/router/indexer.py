@@ -17,6 +17,7 @@ from the worker's local ring buffer (KvEventPublisher.replay_handler).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
 logger = logging.getLogger(__name__)
@@ -100,11 +101,28 @@ class PyKvIndexer:
         return list(self._worker_blocks.keys())
 
 
-def make_indexer():
-    """C++ indexer when available, Python fallback otherwise."""
+def indexer_impl(ix) -> str:
+    """Implementation tag for debug/metrics surfaces ("py" | "native")."""
+    return "py" if isinstance(ix, PyKvIndexer) else "native"
+
+
+def make_indexer(impl: Optional[str] = None):
+    """C++ indexer when built (the default), Python fallback otherwise.
+
+    `impl` (or env DYN_INDEXER) pins the choice: "native" raises if the
+    shared library is absent instead of silently degrading, "py" forces
+    the reference implementation (parity tests, perf A/B), "auto" is the
+    prefer-native default."""
+    impl = impl or os.environ.get("DYN_INDEXER", "auto")
+    if impl not in ("auto", "py", "native"):
+        raise ValueError(f"DYN_INDEXER={impl!r}: expected auto|py|native")
+    if impl == "py":
+        return PyKvIndexer()
     try:
         from .native_indexer import NativeKvIndexer
 
         return NativeKvIndexer()
     except (ImportError, OSError):
+        if impl == "native":
+            raise
         return PyKvIndexer()

@@ -24,7 +24,7 @@ from ..protocols import ModelDeploymentCard, PreprocessedRequest
 from ..runtime import Client, DistributedRuntime
 from ..tokens import compute_block_hashes_for_request
 from .events import KvCacheEvent, kv_event_subject
-from .indexer import make_indexer
+from .indexer import indexer_impl, make_indexer
 from .replica_sync import RouterReplicaSync
 from .selector import DefaultWorkerSelector, KvRouterConfig, WorkerState
 from .sequences import ActiveSequences
@@ -497,6 +497,9 @@ class KvRouter:
                                 if preds else None),
             "realized_minus_predicted_mean": (round((reals - preds) / n, 3)
                                               if n else None),
+            "indexer_impl": indexer_impl(self.indexer),
+            **({"replica_sync": self.sync.stats()}
+               if self.sync is not None else {}),
         }
 
     def charge(self, request: PreprocessedRequest, worker_id: int) -> None:

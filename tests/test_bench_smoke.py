@@ -171,6 +171,37 @@ def test_bench_serving_forensics_ab_streams_identical():
     assert rep["tail"]["exemplars"] >= 1
 
 
+def test_bench_global_router_smoke_closed_loop():
+    """The PR 18 mega-fleet closed loop at smoke scale runs IN tier-1
+    (seconds on CPU): 2 pools x 3 replica-sync'd frontends x mocker
+    workers, with the correctness gates — byte-identity vs the
+    single-frontend baseline and both pool classes routed — enforced
+    even in smoke mode (the bench exits 1 on failure), and the
+    latency/staleness measurement surfaces present per JSON line."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_global_router.py"),
+         "--mode", "smoke"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    (rep,) = [json.loads(line) for line in r.stdout.splitlines()
+              if line.startswith("{")]
+    status = {g["name"]: g["status"] for g in rep["gates"]}
+    assert status["grouter_byte_identity"] == "pass"
+    assert status["grouter_pools_routed"] == "pass"
+    res = rep["result"]
+    assert res["byte_identical"] is True and res["empty_streams"] == 0
+    assert res["route_latency"]["count"] == res["streams"]
+    # per-replica staleness + decision counts reported for every pool's
+    # frontend tier (the replica-sync health surfaces)
+    for pool in res["staleness"].values():
+        assert len(pool["replicas"]) >= 3
+        assert sum(r_["decisions"]
+                   for r_ in pool["replicas"].values()) > 0
+
+
 def test_run_round_help_exits_zero():
     """benchmarks/run_round.py is not matched by the bench_*.py glob
     above, so it gets its own drift gate: --help must import the driver
@@ -201,18 +232,31 @@ def test_run_round_smoke_emits_gated_json_per_bench():
     lines = [json.loads(line) for line in r.stdout.splitlines()
              if line.startswith("{")]
     by_bench = {rep["bench"]: rep for rep in lines}
-    assert set(by_bench) == {"prefill", "kv_quant", "serving"}
+    assert set(by_bench) == {"prefill", "kv_quant", "serving",
+                             "indexer", "global_router"}
     gate_names = set()
     for rep in by_bench.values():
         assert rep["round"] == "r06"
         assert rep["mode"] == "smoke"
         assert rep["gates"], rep
         for g in rep["gates"]:
-            assert g["status"] == "skipped_smoke", g
+            # chip bars are skipped at smoke scale; correctness bars
+            # (indexer parity, grouter byte-identity/pool coverage)
+            # are enforced in EVERY mode and must pass
+            assert g["status"] in ("skipped_smoke", "pass"), g
             gate_names.add(g["name"])
         assert "result" in rep
-    assert gate_names == {"prefill_pallas_mfu", "int8_pallas_ge_bf16",
-                          "zero_mid_serving_compiles"}
+    assert gate_names >= {"prefill_pallas_mfu", "int8_pallas_ge_bf16",
+                          "zero_mid_serving_compiles",
+                          "indexer_events_per_s", "indexer_query_p99_us",
+                          "grouter_byte_identity",
+                          "grouter_pools_routed",
+                          "grouter_route_p99_ms",
+                          "grouter_staleness_spread"}
+    # the correctness bars really ran
+    assert {g["name"]: g["status"]
+            for g in by_bench["global_router"]["gates"]
+            }["grouter_byte_identity"] == "pass"
     # the per-bench results carry the round's measurement surfaces
     assert "pallas_interpret" in by_bench["prefill"]["result"]["impls"]
     rows = by_bench["kv_quant"]["result"]["decode"]["rows"]

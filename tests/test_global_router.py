@@ -1,0 +1,466 @@
+"""Global router (PR 18): pool classification policy, pool discovery,
+closed-loop proxying over real pools, chaos degrade, and the scale-out
+snapshot-on-subscribe e2e (a late-started frontend replica inherits the
+in-flight slot picture).
+"""
+
+import asyncio
+import json
+import uuid
+
+import aiohttp
+
+from dynamo_tpu import chaos
+from dynamo_tpu.disagg.prefill_router import ConditionalDisaggConfig
+from dynamo_tpu.frontend import HttpService, ModelManager, ModelWatcher
+from dynamo_tpu.frontend.pipeline import _route_attr
+from dynamo_tpu.frontend.request_trace import RequestTracker, X_POOL_HEADER
+from dynamo_tpu.global_router import (FrontendView, GlobalRouterConfig,
+                                      GlobalRouterService, PoolClassifier,
+                                      PoolDirectory, PoolView)
+from dynamo_tpu.global_router.policy import estimate_isl
+from dynamo_tpu.mocker import MockEngineArgs, MockerWorker
+from dynamo_tpu.protocols.model_card import ModelDeploymentCard
+from dynamo_tpu.router.kv_router import make_kv_route_factory
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode, RuntimeConfig
+from dynamo_tpu.runtime.discovery import Instance
+
+MODEL = "gr-model"
+
+
+# --------------------------- classifier units --------------------------------
+
+
+def mk_pool(ns, disagg=False, n_fe=1, per_tok=None, flat=None, inflight=0):
+    p = PoolView(ns)
+    for i in range(n_fe):
+        p.frontends[i] = FrontendView(i, f"127.0.0.1:{9000 + i}", ns)
+    p.models[MODEL] = {"both", "prefill"} if disagg else {"both"}
+    p.ttft_per_token_ewma_s = per_tok
+    p.ttft_ewma_s = flat
+    p.inflight = inflight
+    return p
+
+
+def test_classifier_prefill_bound_routes_to_disagg_pool():
+    c = PoolClassifier(GlobalRouterConfig())
+    pools = [mk_pool("agg"), mk_pool("dis", disagg=True)]
+    # long prompt + short completion: clears BOTH thresholds
+    # (isl >= 2048, ratio 4096/(4096+64) >= 0.7)
+    d = c.classify(pools, isl=4096, max_tokens=64)
+    assert d.pool == "dis"
+    assert d.reason == "disagg"
+    assert d.prefill_ratio > 0.9
+    # long prompt but LONG completion too: decode-bound, agg wins
+    d = c.classify(pools, isl=4096, max_tokens=8192)
+    assert d.pool == "agg"
+    assert d.reason == "agg"
+
+
+def test_classifier_decode_bound_routes_to_agg_pool():
+    c = PoolClassifier(GlobalRouterConfig())
+    pools = [mk_pool("agg"), mk_pool("dis", disagg=True)]
+    d = c.classify(pools, isl=100, max_tokens=256)
+    assert d.pool == "agg"
+    assert d.reason == "agg"
+    # both candidate classes are scored, the winner's score present
+    assert "agg" in d.scores
+
+
+def test_classifier_falls_back_across_classes():
+    """A preferred class with no live pool must degrade to the other
+    class (reason tagged _fallback) rather than 503."""
+    c = PoolClassifier(GlobalRouterConfig())
+    aggs = [mk_pool("a0"), mk_pool("a1")]
+    d = c.classify(aggs, isl=4096, max_tokens=64)  # wants disagg
+    assert d.pool in ("a0", "a1")
+    assert d.reason == "disagg_fallback"
+    diss = [mk_pool("d0", disagg=True), mk_pool("d1", disagg=True)]
+    d = c.classify(diss, isl=100, max_tokens=256)  # wants agg
+    assert d.reason == "agg_fallback"
+
+
+def test_classifier_single_pool_and_empty():
+    c = PoolClassifier()
+    d = c.classify([mk_pool("solo")], isl=4096, max_tokens=64)
+    assert d.pool == "solo"
+    assert d.reason == "only_pool"
+    try:
+        c.classify([], isl=10)
+        assert False, "empty pool list must raise"
+    except ValueError:
+        pass
+
+
+def test_classifier_ttft_then_load_tiebreak():
+    cfg = GlobalRouterConfig(load_penalty_s=0.010)
+    c = PoolClassifier(cfg)
+    fast = mk_pool("fast", per_tok=1e-5)
+    slow = mk_pool("slow", per_tok=5e-5)
+    assert c.classify([fast, slow], isl=1000, max_tokens=512).pool == "fast"
+    # pile enough in-flight load on the fast pool and the ITL-headroom
+    # penalty must flip the decision: 10ms/req beats a 40ms TTFT edge
+    # at >= 5 queued requests per frontend
+    fast.inflight = 8
+    assert c.classify([fast, slow], isl=1000, max_tokens=512).pool == "slow"
+
+
+def test_estimate_isl_shapes():
+    assert estimate_isl({"prompt": [1, 2, 3, 4, 5]}) == 5  # exact tokens
+    assert estimate_isl({"prompt": "x" * 400}) == 100      # ~4 chars/tok
+    assert estimate_isl({"messages": [{"role": "user",
+                                       "content": "y" * 80}]}) == 20
+    assert estimate_isl({}) == 1  # never zero
+
+
+def test_request_tracker_pool_attribution():
+    """The x-dyn-pool header stamped by the grouter must flow into the
+    routed hop and the request_end record."""
+    t = RequestTracker.from_headers({X_POOL_HEADER: "pool7"},
+                                    request_id="r1", model=MODEL,
+                                    sink=None)
+    assert t.pool == "pool7"
+    t.on_routed(instance_id=3)
+    routed = [h for h in t.hops if h["hop"] == "routed"]
+    assert routed and routed[0]["pool"] == "pool7"
+    rec = t.finish(finish_reason="stop")
+    assert rec["request"]["pool"] == "pool7"
+    # a direct (un-proxied) request carries no pool at all
+    t2 = RequestTracker.from_headers({}, request_id="r2", model=MODEL,
+                                     sink=None)
+    assert t2.pool is None
+    assert "pool" not in t2.finish()["request"]
+
+
+# --------------------------- pool directory ----------------------------------
+
+
+async def test_pool_directory_tracks_frontends_and_models():
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc"),
+        cluster_id=uuid.uuid4().hex).start()
+    d = await PoolDirectory(rt).start()
+    try:
+        fe = Instance(namespace="pa", component="frontend",
+                      endpoint="http", instance_id=11,
+                      address="127.0.0.1:8101",
+                      metadata={"http_addr": "127.0.0.1:8101",
+                                "pool": "pa"})
+        await rt.discovery.put(fe.key(), fe.to_dict())
+        mdc = ModelDeploymentCard(name=MODEL, namespace="pa",
+                                  runtime_config={"role": "both"})
+        await rt.discovery.put(mdc.key(instance_id=1), mdc.to_dict())
+
+        async def poll(cond):
+            for _ in range(150):
+                if cond():
+                    return True
+                await asyncio.sleep(0.02)
+            return cond()
+
+        assert await poll(lambda: d.pools_for_model(MODEL))
+        pool = d.pools()["pa"]
+        assert pool.frontends[11].http_addr == "127.0.0.1:8101"
+        assert not pool.is_disagg
+        # a prefill card from a second worker flips the pool's class
+        pmdc = ModelDeploymentCard(name=MODEL, namespace="pa",
+                                   component="prefill",
+                                   runtime_config={"role": "prefill"})
+        await rt.discovery.put(pmdc.key(instance_id=2), pmdc.to_dict())
+        assert await poll(lambda: d.pools()["pa"].is_disagg)
+        # non-frontend instances are ignored
+        w = Instance(namespace="pa", component="backend",
+                     endpoint="generate", instance_id=12,
+                     address="127.0.0.1:9999")
+        await rt.discovery.put(w.key(), w.to_dict())
+        await asyncio.sleep(0.05)
+        assert set(d.pools()["pa"].frontends) == {11}
+        # dropping the prefill card reverts the class (the "both" card
+        # still claims the model); dropping the frontend empties the
+        # pool out of pools_for_model, then GC removes it entirely
+        await rt.discovery.delete(pmdc.key(instance_id=2))
+        assert await poll(lambda: not d.pools()["pa"].is_disagg)
+        assert d.pools_for_model(MODEL)
+        await rt.discovery.delete(fe.key())
+        assert await poll(lambda: not d.pools_for_model(MODEL))
+        await rt.discovery.delete(mdc.key(instance_id=1))
+        assert await poll(lambda: "pa" not in d.pools())
+    finally:
+        await d.close()
+        await rt.shutdown()
+
+
+# --------------------------- closed loop -------------------------------------
+
+# grouter estimates ~4 chars/token; the byte tokenizer counts 1/char.
+# Scaled-down thresholds keep the smoke geometry in CPU-milliseconds.
+GROUTER_MIN_ISL = 64
+FRONTEND_MIN_ISL = 256
+LONG_CHARS = 400
+SHORT_CHARS = 60
+
+
+async def start_pool(cluster, ns, *, disagg, frontends=1, engine_kw=None):
+    wrt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc", namespace=ns),
+        cluster_id=cluster).start()
+    common = dict(model_name=MODEL, block_size=16, base_step_s=0.0005,
+                  prefill_s_per_token=0.0, decode_s_per_seq=0.0)
+    common.update(engine_kw or {})
+    workers = [await MockerWorker(wrt, MockEngineArgs(**common),
+                                  namespace=ns).start()]
+    if disagg:
+        workers.append(await MockerWorker(
+            wrt, MockEngineArgs(role="prefill", **common),
+            namespace=ns, component="prefill").start())
+    fes = []
+    for _ in range(frontends):
+        rt = await DistributedRuntime(
+            config=RuntimeConfig(discovery_backend="mem",
+                                 event_plane="inproc", namespace=ns),
+            cluster_id=cluster).start()
+        manager = ModelManager()
+        watcher = await ModelWatcher(
+            rt, manager, router_mode=RouterMode.KV,
+            make_route=make_kv_route_factory(rt),
+            disagg_config=ConditionalDisaggConfig(
+                min_effective_isl=FRONTEND_MIN_ISL,
+                min_effective_ratio=0.7),
+            namespaces={ns}).start()
+        svc = await HttpService(rt, manager, host="127.0.0.1", port=0,
+                                advertise=True).start()
+        fes.append({"rt": rt, "manager": manager, "watcher": watcher,
+                    "svc": svc,
+                    "port": svc._runner.addresses[0][1]})
+    return {"ns": ns, "wrt": wrt, "workers": workers, "frontends": fes}
+
+
+async def stop_pool(pool):
+    for fe in pool["frontends"]:
+        await fe["svc"].close()
+        await fe["watcher"].close()
+        await fe["rt"].shutdown()
+    for w in pool["workers"]:
+        await w.close()
+    await pool["wrt"].shutdown()
+
+
+async def wait_ready(pools, grouter, n_pools):
+    for pool in pools:
+        for fe in pool["frontends"]:
+            for _ in range(200):
+                if fe["manager"].get(MODEL):
+                    break
+                await asyncio.sleep(0.02)
+            assert fe["manager"].get(MODEL)
+    for _ in range(200):
+        if len(grouter.directory.pools_for_model(MODEL)) >= n_pools:
+            break
+        await asyncio.sleep(0.02)
+    assert len(grouter.directory.pools_for_model(MODEL)) >= n_pools
+
+
+async def sse_text(session, url, body):
+    out = []
+    async with session.post(f"{url}/v1/completions", json=body) as r:
+        assert r.status == 200, (r.status, await r.text())
+        async for raw in r.content:
+            line = raw.decode().strip()
+            if not line.startswith("data:"):
+                continue
+            data = line[5:].strip()
+            if data == "[DONE]":
+                break
+            for ch in json.loads(data).get("choices", ()):
+                if ch.get("text"):
+                    out.append(ch["text"])
+    return "".join(out)
+
+
+def trace(n_per_class, max_tokens=8):
+    reqs = []
+    for i in range(n_per_class):
+        reqs.append({"model": MODEL, "prompt": "s" * SHORT_CHARS + str(i),
+                     "max_tokens": max_tokens, "stream": True,
+                     "seed": 100 + i})
+        reqs.append({"model": MODEL, "prompt": "l" * LONG_CHARS + str(i),
+                     "max_tokens": max_tokens, "stream": True,
+                     "seed": 200 + i})
+    return reqs
+
+
+async def test_grouter_closed_loop_routes_both_classes_byte_identical():
+    """2 pools (agg + disagg) x 2 frontends: short prompts land agg,
+    long prompts clear the conditional-disagg thresholds and land
+    disagg, and every token stream is byte-identical to hitting one
+    frontend directly (MockEngine streams are position-addressed by
+    seed, so the proxy layer must add zero token-level noise)."""
+    cluster = uuid.uuid4().hex
+    p0 = await start_pool(cluster, "pool0", disagg=False, frontends=2)
+    p1 = await start_pool(cluster, "pool1", disagg=True, frontends=2)
+    grt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc", namespace="global"),
+        cluster_id=cluster).start()
+    grouter = await GlobalRouterService(
+        grt, host="127.0.0.1", port=0,
+        config=GlobalRouterConfig(disagg_min_isl=GROUTER_MIN_ISL,
+                                  disagg_ratio=0.7),
+        staleness_scrape_s=30.0).start()
+    try:
+        await wait_ready([p0, p1], grouter, n_pools=2)
+        reqs = trace(4)
+        async with aiohttp.ClientSession() as s:
+            via_grouter = await asyncio.gather(*(
+                sse_text(s, f"http://127.0.0.1:{grouter.port}", b)
+                for b in reqs))
+            direct = await asyncio.gather(*(
+                sse_text(s, f"http://127.0.0.1:{p0['frontends'][0]['port']}",
+                         b) for b in reqs))
+        assert all(via_grouter), "empty token stream through the grouter"
+        assert via_grouter == direct, "proxy layer changed token bytes"
+        routed = dict(grouter._routed)
+        assert ("pool0", "agg") in routed and routed[("pool0", "agg")] == 4
+        assert ("pool1", "disagg") in routed
+        assert routed[("pool1", "disagg")] == 4
+        # route latency got sampled for every forward
+        assert grouter.route_latency_quantiles()["count"] == len(reqs)
+        # unknown model 404s instead of hanging
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{grouter.port}/v1/completions",
+                json={"model": "nope", "prompt": "x"},
+            ) as r:
+                assert r.status == 404
+            # merged model list across pools
+            async with s.get(
+                f"http://127.0.0.1:{grouter.port}/v1/models") as r:
+                models = [m["id"] for m in (await r.json())["data"]]
+                assert models == [MODEL]
+    finally:
+        await grouter.close()
+        await grt.shutdown()
+        await stop_pool(p0)
+        await stop_pool(p1)
+
+
+async def test_grouter_classify_chaos_degrades_to_round_robin():
+    """Chaos seam grouter.classify: a policy fault must degrade to
+    round-robin (reason classify_error_rr) and keep serving — never
+    drop the request."""
+    cluster = uuid.uuid4().hex
+    p0 = await start_pool(cluster, "pool0", disagg=False)
+    grt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc", namespace="global"),
+        cluster_id=cluster).start()
+    grouter = await GlobalRouterService(
+        grt, host="127.0.0.1", port=0,
+        staleness_scrape_s=30.0).start()
+    plane = chaos.ChaosPlane(seed=7)
+    plane.rule("grouter.classify", "fail", times=1)
+    try:
+        await wait_ready([p0], grouter, n_pools=1)
+        body = {"model": MODEL, "prompt": "hello world", "max_tokens": 4,
+                "stream": True, "seed": 5}
+        with plane:
+            async with aiohttp.ClientSession() as s:
+                first = await sse_text(
+                    s, f"http://127.0.0.1:{grouter.port}", body)
+                second = await sse_text(
+                    s, f"http://127.0.0.1:{grouter.port}", body)
+        assert plane.injections
+        assert first and first == second  # degraded path, same bytes
+        routed = dict(grouter._routed)
+        assert routed.get(("pool0", "classify_error_rr")) == 1
+        assert routed.get(("pool0", "only_pool")) == 1
+    finally:
+        await grouter.close()
+        await grt.shutdown()
+        await stop_pool(p0)
+
+
+async def test_late_joining_frontend_inherits_inflight_slots():
+    """Frontend scale-out e2e: requests are IN FLIGHT on replica A when
+    replica B starts.  B's KvRouter must inherit A's slot view via
+    replica-sync snapshot-on-subscribe — within a tick, not after the
+    requests finish."""
+    cluster = uuid.uuid4().hex
+    ns = "poolz"
+    # slow decode keeps the requests in flight while B boots
+    pool = await start_pool(cluster, ns, disagg=False,
+                            engine_kw=dict(base_step_s=0.02))
+    fe_a = pool["frontends"][0]
+    try:
+        for _ in range(200):
+            if fe_a["manager"].get(MODEL):
+                break
+            await asyncio.sleep(0.02)
+        assert fe_a["manager"].get(MODEL)
+        url = f"http://127.0.0.1:{fe_a['port']}"
+        bodies = [{"model": MODEL, "prompt": "p" * 120 + str(i),
+                   "max_tokens": 60, "stream": True, "seed": i}
+                  for i in range(3)]
+        async with aiohttp.ClientSession() as s:
+            inflight = [asyncio.create_task(sse_text(s, url, b))
+                        for b in bodies]
+            try:
+                seqs_a = _route_attr(
+                    fe_a["manager"].get(MODEL).migration.route,
+                    "sequences")
+                for _ in range(200):
+                    if len(seqs_a._reqs) >= 3:
+                        break
+                    await asyncio.sleep(0.02)
+                assert len(seqs_a._reqs) >= 3, "requests never took slots"
+
+                # replica B joins late: watcher only, no HTTP needed
+                rt_b = await DistributedRuntime(
+                    config=RuntimeConfig(discovery_backend="mem",
+                                         event_plane="inproc",
+                                         namespace=ns),
+                    cluster_id=cluster).start()
+                manager_b = ModelManager()
+                watcher_b = await ModelWatcher(
+                    rt_b, manager_b, router_mode=RouterMode.KV,
+                    make_route=make_kv_route_factory(rt_b),
+                    namespaces={ns}).start()
+                try:
+                    for _ in range(200):
+                        if manager_b.get(MODEL):
+                            break
+                        await asyncio.sleep(0.02)
+                    route_b = manager_b.get(MODEL).migration.route
+                    seqs_b = _route_attr(route_b, "sequences")
+                    sync_b = _route_attr(route_b, "sync")
+                    peer_keys = None
+                    for _ in range(200):
+                        peer_keys = [k for k in seqs_b._reqs if "@" in k]
+                        if len(peer_keys) >= 3:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert len(peer_keys) >= 3, (
+                        f"late joiner never inherited A's in-flight "
+                        f"slots: {list(seqs_b._reqs)}")
+                    assert sync_b.stats()["snapshots_applied"] >= 1
+                    # B's per-worker load view matches A's for the
+                    # in-flight set (A counts them as own, B as peer)
+                    wid = pool["workers"][0].served.instance_id
+                    assert seqs_b.active_blocks(wid) > 0
+                finally:
+                    await watcher_b.close()
+                    await rt_b.shutdown()
+            finally:
+                texts = await asyncio.gather(*inflight)
+        assert all(texts)
+        # ...and the entries drain after the requests finish (frees
+        # propagate the same path the adds did)
+        for _ in range(200):
+            if not seqs_a._reqs:
+                break
+            await asyncio.sleep(0.02)
+        assert not seqs_a._reqs
+    finally:
+        await stop_pool(pool)

@@ -1,0 +1,92 @@
+"""make_indexer selection policy + native/Python parity.
+
+The C++ indexer (native/indexer.cc) is the promoted DEFAULT when its
+shared library is built — conftest.py builds it at session start
+whenever a toolchain exists, so on a toolchain'd box these tests
+exercise the real promotion path; without one the native half skips and
+the env-pinning contract is still covered.
+"""
+
+import random
+
+import pytest
+
+from dynamo_tpu.router.indexer import (PyKvIndexer, indexer_impl,
+                                       make_indexer)
+
+
+def native_built() -> bool:
+    try:
+        from dynamo_tpu.router.native_indexer import NativeKvIndexer  # noqa
+        return True
+    except (ImportError, OSError):
+        return False
+
+
+def test_env_pin_py_forces_reference_impl():
+    ix = make_indexer("py")
+    assert isinstance(ix, PyKvIndexer)
+    assert indexer_impl(ix) == "py"
+
+
+def test_invalid_impl_rejected_loudly():
+    with pytest.raises(ValueError, match="expected auto|py|native"):
+        make_indexer("bogus")
+
+
+def test_default_promotes_native_when_built():
+    ix = make_indexer()
+    if native_built():
+        assert indexer_impl(ix) == "native", (
+            "library is built but auto still degraded to Python")
+    else:
+        assert indexer_impl(ix) == "py"
+
+
+def test_native_pin_raises_when_absent_else_returns_native():
+    if native_built():
+        assert indexer_impl(make_indexer("native")) == "native"
+    else:
+        with pytest.raises((ImportError, OSError)):
+            make_indexer("native")
+
+
+def test_py_native_parity_randomized():
+    """Interleaved stores/removes/worker-drops on both impls, comparing
+    find_matches + num_blocks at every query — the same contract the
+    bench parity gate enforces (benchmarks/bench_indexer.py)."""
+    if not native_built():
+        pytest.skip("native library not built (no toolchain)")
+    py, nat = make_indexer("py"), make_indexer("native")
+    rng = random.Random(23)
+    universe = [rng.getrandbits(63) for _ in range(512)]
+    workers = list(range(6))
+    live = []
+    for _ in range(1500):
+        op = rng.random()
+        if op < 0.55:
+            w = rng.choice(workers)
+            hashes = rng.sample(universe, rng.randint(1, 12))
+            py.apply_stored(w, hashes)
+            nat.apply_stored(w, hashes)
+            live.append((w, hashes))
+        elif op < 0.75 and live:
+            w, hashes = live.pop(rng.randrange(len(live)))
+            py.apply_removed(w, hashes)
+            nat.apply_removed(w, hashes)
+        elif op < 0.8:
+            w = rng.choice(workers)
+            py.remove_worker(w)
+            nat.remove_worker(w)
+            live = [(lw, h) for lw, h in live if lw != w]
+        else:
+            # query: a prefix-ish slice biased toward stored runs
+            if live and rng.random() < 0.7:
+                _, base = rng.choice(live)
+                q = base + rng.sample(universe, rng.randint(0, 4))
+            else:
+                q = rng.sample(universe, rng.randint(1, 16))
+            assert py.find_matches(q) == nat.find_matches(q)
+            assert py.num_blocks == nat.num_blocks
+    assert py.num_blocks == nat.num_blocks
+    assert sorted(py.workers) == sorted(nat.workers)

@@ -109,7 +109,11 @@ def _packed_case(rng, lens, *, nkv=2, group=2, hd=16, bs=4, mb=8, L=2,
 
 def _assert_packed_parity(case, L=2, **pallas_kw):
     q, kc, vc, ks, vs, tables, seg_ids, positions, valid = case
-    for li in range(L):
+    # parity on the LAST layer only: the layer index selects a cache
+    # slice (the kernel body is layer-independent), and every extra
+    # layer is a second interpret-mode trace+compile of tier-1 wall
+    # clock; li=L-1 keeps the non-zero-offset slicing under test
+    for li in (L - 1,):
         ref = packed_prefill_attention(
             q, kc, vc, li, tables, seg_ids, positions, valid,
             impl="xla", k_scale=ks, v_scale=vs)
@@ -252,7 +256,9 @@ def test_int8_decode_pallas_matches_jnp():
     rng = np.random.default_rng(7)
     q, kc, vc, ks, vs, tables, kv_lens = _int8_decode_case(
         rng, [17, 24, 5])
-    for li in range(2):
+    # layer 1 only — same one-interpret-trace rationale as
+    # _assert_packed_parity, non-zero layer offset kept under test
+    for li in (1,):
         ref = paged_attention_decode_jnp(q, kc, vc, li, tables, kv_lens,
                                          k_scale=ks, v_scale=vs)
         out = paged_attention_decode_pallas(
@@ -352,15 +358,20 @@ async def test_engine_greedy_int8_pallas_byte_identity():
     for BOTH kernels with kv_cache_dtype=int8 and overlap scheduling ON
     — quantization composes with the fast path end to end."""
     prompt = [5, 9, 13, 2, 7, 11, 3, 1, 8, 20]
-    ref = await _greedy(
-        _engine_cfg(kv_cache_dtype="int8", overlap_scheduling=True),
-        prompt, 8, "i8-jnp")
+    # 5 decode steps cross a block boundary (block_size=4) so identity
+    # covers intra- and inter-block paging.  decode_fused_steps=1 and
+    # the smaller table keep tier-1 wall clock sane: every fusion-ladder
+    # rung is its own interpret-mode compile (~12s each on CPU, and the
+    # trace cost scales with max_blocks_per_seq); identical settings on
+    # both engines keep the comparison exact.
+    wall = dict(kv_cache_dtype="int8", overlap_scheduling=True,
+                decode_fused_steps=1, num_blocks=64, max_blocks_per_seq=8)
+    ref = await _greedy(_engine_cfg(**wall), prompt, 5, "i8-jnp")
     pal = await _greedy(
-        _engine_cfg(kv_cache_dtype="int8", overlap_scheduling=True,
-                    attn_impl="pallas_interpret",
-                    packed_attn_impl="pallas_interpret"),
-        prompt, 8, "i8-pal")
-    assert len(ref) == 8  # a crashed engine's empty stream is vacuous
+        _engine_cfg(attn_impl="pallas_interpret",
+                    packed_attn_impl="pallas_interpret", **wall),
+        prompt, 5, "i8-pal")
+    assert len(ref) == 5  # a crashed engine's empty stream is vacuous
     assert pal == ref
 
 
@@ -377,20 +388,24 @@ async def test_zero_recompiles_with_pallas_kernels():
     # the family-count contract is identical)
     eng = JaxEngine(_engine_cfg(
         kv_cache_dtype="int8", attn_impl="pallas_interpret",
-        packed_attn_impl="pallas_interpret", decode_fused_steps=1))
+        packed_attn_impl="pallas_interpret", decode_fused_steps=1,
+        num_blocks=64, max_blocks_per_seq=8))
     try:
         await asyncio.to_thread(eng.warmup_decode)
         from test_engine import collect, greedy_req
 
+        # 4 tokens/request: the compile-family counts under judgment are
+        # identical at any length ≥1, and every interpret-mode decode
+        # step is seconds of tier-1 wall clock
         await collect(eng, greedy_req([5, 9, 13, 2, 7, 11, 3, 1, 8, 20],
-                                      12, "pk-r0"))
+                                      4, "pk-r0"))
         counts = dict(eng.compile_watch.counts)
         assert counts.get("prefill_packed", 0) == 1
         assert counts.get("decode", 0) >= 1
         await collect(eng, greedy_req([6, 10, 14, 3, 8, 12, 4, 2, 9, 21],
-                                      12, "pk-r1"))
+                                      4, "pk-r1"))
         await collect(eng, greedy_req([9, 13, 17, 6, 11, 15, 7, 5, 12, 24],
-                                      12, "pk-r2"))
+                                      4, "pk-r2"))
         assert dict(eng.compile_watch.counts) == counts, \
             "steady-state serving recompiled a pallas-kernel program"
     finally:

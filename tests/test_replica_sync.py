@@ -1,0 +1,221 @@
+"""RouterReplicaSync: ordering, convergence, snapshot-on-subscribe,
+TTL stale-reap, and malformed-frame resilience.
+
+These are the slot-view guarantees the scaled-out frontend tier
+(global_router/) leans on: N replicas sharing one pool must converge to
+the same per-worker load picture, a late-started replica must inherit
+the in-flight picture within one tick, and a crashed replica's phantom
+load must decay instead of pinning workers busy forever.
+"""
+
+import asyncio
+import uuid
+
+from dynamo_tpu import chaos
+from dynamo_tpu.router.replica_sync import RouterReplicaSync
+from dynamo_tpu.router.sequences import ActiveSequences
+from dynamo_tpu.runtime import DistributedRuntime, RuntimeConfig
+
+
+async def make_sync(cluster: str, router_id=None, ttl=5.0):
+    rt = await DistributedRuntime(
+        config=RuntimeConfig(discovery_backend="mem",
+                             event_plane="inproc"),
+        cluster_id=cluster).start()
+    seqs = ActiveSequences()
+    sync = await RouterReplicaSync(rt, "ns", "comp", seqs,
+                                   router_id=router_id,
+                                   peer_ttl_s=ttl).start()
+    return rt, seqs, sync
+
+
+async def teardown(*stacks):
+    for rt, _seqs, sync in stacks:
+        await sync.close()
+        await rt.shutdown()
+
+
+async def poll(cond, timeout_s=3.0, interval=0.02):
+    for _ in range(int(timeout_s / interval)):
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return cond()
+
+
+async def test_free_before_add_never_leaves_phantom_load():
+    """The _outbox single-writer guarantee: add and free enqueued
+    back-to-back (before the send loop even wakes) must arrive in
+    order, so the peer ends with ZERO load — not a phantom entry."""
+    cluster = uuid.uuid4().hex
+    a = await make_sync(cluster, "ra")
+    b = await make_sync(cluster, "rb")
+    try:
+        # enqueue add+free synchronously, no await in between: a
+        # fire-and-forget implementation could publish these out of
+        # order (the first publish sets up the subscription socket)
+        a[2].publish_add("r1", worker_id=7, blocks=10, overlap_blocks=0)
+        a[2].publish_free("r1")
+        # a second request stays open so we can tell "converged" from
+        # "nothing arrived yet"
+        a[2].publish_add("r2", worker_id=7, blocks=4, overlap_blocks=0)
+        assert await poll(lambda: "r2@ra" in b[1]._reqs)
+        assert "r1@ra" not in b[1]._reqs
+        # only r2's load remains: 4 decode blocks + 2.0 * 4 prefill
+        assert b[1].active_blocks(7) == 12.0
+    finally:
+        await teardown(a, b)
+
+
+async def test_n_router_views_converge_under_concurrent_adds():
+    cluster = uuid.uuid4().hex
+    stacks = [await make_sync(cluster, f"r{i}") for i in range(4)]
+    try:
+
+        async def burst(i):
+            for k in range(5):
+                stacks[i][2].publish_add(f"q{i}-{k}", worker_id=k % 3,
+                                         blocks=k + 1, overlap_blocks=0)
+                await asyncio.sleep(0)  # interleave the four publishers
+
+        await asyncio.gather(*(burst(i) for i in range(4)))
+        # every router must fold in all 15 peer entries (its own 5 are
+        # applied by its KvRouter, not by sync — not simulated here)
+        assert await poll(lambda: all(
+            len(seqs._reqs) == 15 for _rt, seqs, _s in stacks))
+        # every burst has the same (worker, blocks) shape, so all four
+        # views — each the sum of the OTHER three bursts — must agree
+        # exactly on per-worker load
+        for w in range(3):
+            views = {round(seqs.active_blocks(w), 3)
+                     for _rt, seqs, _s in stacks}
+            assert len(views) == 1, (w, views)
+    finally:
+        await teardown(*stacks)
+
+
+async def test_malformed_frame_drop_keeps_loop_alive():
+    cluster = uuid.uuid4().hex
+    a = await make_sync(cluster, "ra")
+    b = await make_sync(cluster, "rb")
+    try:
+        # three shapes of garbage: not a dict field set, missing fields,
+        # wrong types — each must be dropped without killing the loop
+        for frame in (
+            {"op": "add", "router_id": "evil"},                # no fields
+            {"op": "add", "router_id": "evil", "request_id": "x",
+             "worker_id": "NaN", "blocks": "many"},            # bad types
+            {"router_id": "evil", "entries": None, "op": "snapshot",
+             "to": "rb"},                                      # bad body
+        ):
+            await a[0].event_plane.publish(a[2].subject, frame)
+        a[2].publish_add("ok", worker_id=1, blocks=2, overlap_blocks=0)
+        assert await poll(lambda: "ok@ra" in b[1]._reqs), (
+            "recv loop died on a malformed frame")
+    finally:
+        await teardown(a, b)
+
+
+async def test_ttl_reap_decays_crashed_peer_load():
+    cluster = uuid.uuid4().hex
+    a = await make_sync(cluster, "ra", ttl=0.25)
+    b = await make_sync(cluster, "rb", ttl=0.25)
+    try:
+        b[2].publish_add("z1", worker_id=2, blocks=8, overlap_blocks=0)
+        b[2].publish_add("z2", worker_id=2, blocks=8, overlap_blocks=0)
+        assert await poll(lambda: len(a[1]._reqs) == 2)
+        assert a[1].active_blocks(2) > 0
+        # crash rb: no free, no heartbeats — just silence
+        await b[2].close()
+        assert await poll(lambda: len(a[1]._reqs) == 0, timeout_s=5.0), (
+            "phantom load never reaped after peer went silent")
+        assert a[1].active_blocks(2) == 0.0
+        assert "rb" not in a[2].stats()["peer_inflight"]
+    finally:
+        await a[2].close()
+        await a[0].shutdown()
+        await b[0].shutdown()
+
+
+async def test_live_peer_with_idle_traffic_is_not_reaped():
+    """Heartbeats keep an idle-but-alive peer's entries resident past
+    the TTL — reap is for crashed peers, not quiet ones."""
+    cluster = uuid.uuid4().hex
+    a = await make_sync(cluster, "ra", ttl=0.3)
+    b = await make_sync(cluster, "rb", ttl=0.3)
+    try:
+        b[2].publish_add("idle", worker_id=1, blocks=3, overlap_blocks=0)
+        assert await poll(lambda: "idle@rb" in a[1]._reqs)
+        await asyncio.sleep(1.0)  # > 3x TTL, heartbeats flowing
+        assert "idle@rb" in a[1]._reqs
+    finally:
+        await teardown(a, b)
+
+
+async def test_snapshot_on_subscribe_late_joiner_converges():
+    """PR 14's late-joiner contract applied to slot state: a replica
+    started AFTER its peers took load inherits their in-flight adds —
+    including prefill_done transitions — within one sync tick."""
+    cluster = uuid.uuid4().hex
+    a = await make_sync(cluster, "ra")
+    b = await make_sync(cluster, "rb")
+    try:
+        a[2].publish_add("p1", worker_id=0, blocks=6, overlap_blocks=2)
+        a[2].publish_add("p2", worker_id=1, blocks=4, overlap_blocks=0)
+        a[2].publish_prefill_done("p2")
+        b[2].publish_add("p3", worker_id=0, blocks=5, overlap_blocks=0)
+        assert await poll(lambda: len(b[1]._reqs) == 2)  # a's two adds
+        # late joiner: no replayed live frames, only the snapshot
+        c = await make_sync(cluster, "rc")
+        try:
+            assert await poll(lambda: len(c[1]._reqs) == 3), (
+                c[1]._reqs.keys())
+            assert c[1]._reqs["p1@ra"].blocks == 6
+            assert c[1]._reqs["p1@ra"].overlap_blocks == 2
+            assert c[1]._reqs["p2@ra"].prefill_done is True
+            assert c[1]._reqs["p3@rb"].blocks == 5
+            # and load math matches a fully-synced peer's view of the
+            # same entries
+            assert c[1].active_blocks(0) == 6 + 2 * 4 + 5 + 2 * 5
+            assert c[2].stats()["snapshots_applied"] >= 1
+        finally:
+            await teardown(c)
+        # freed entries must never resurrect via a later snapshot
+        a[2].publish_free("p1")
+        d = await make_sync(cluster, "rd")
+        try:
+            assert await poll(lambda: "p2@ra" in d[1]._reqs)
+            await asyncio.sleep(0.1)
+            assert "p1@ra" not in d[1]._reqs
+        finally:
+            await teardown(d)
+    finally:
+        await teardown(a, b)
+
+
+async def test_snapshot_chaos_fault_is_survived_and_retried():
+    """A chaos fault in the snapshot answer (seam router_sync.snapshot)
+    must drop that one frame, keep the peer's recv loop alive, and the
+    joiner's subscribe retry still converges."""
+    cluster = uuid.uuid4().hex
+    a = await make_sync(cluster, "ra")
+    plane = chaos.ChaosPlane(seed=3)
+    plane.rule("router_sync.snapshot", "fail", times=1)
+    try:
+        a[2].publish_add("s1", worker_id=0, blocks=2, overlap_blocks=0)
+        with plane:
+            b = await make_sync(cluster, "rb")
+            try:
+                # first snapshot answer fails; the hello loop's retry
+                # gets the second one through
+                assert await poll(lambda: "s1@ra" in b[1]._reqs), (
+                    "joiner never converged after snapshot fault")
+                assert plane.injections
+                # peer's loop is alive: live traffic still applies
+                a[2].publish_add("s2", worker_id=0, blocks=2,
+                                 overlap_blocks=0)
+                assert await poll(lambda: "s2@ra" in b[1]._reqs)
+            finally:
+                await teardown(b)
+    finally:
+        await teardown(a)
