@@ -16,6 +16,10 @@ lines:
             gate[tpu]: zero mid-serving compiles
             (dynamo_engine_serving_compiles_total stays 0)
 
+plus the benches that emit their own gated r06 line, adopted verbatim
+(indexer, global_router, prefix_fleet — the fleet-prefix-cache
+cold-start A/B added with the tiered index work).
+
 Each bench contributes ONE summary JSON line to stdout:
 
   {"bench": ..., "round": "r06", "mode": "smoke"|"tpu",
@@ -74,6 +78,11 @@ BENCH_ARGS = {
     },
     "global_router": {
         "script": "bench_global_router.py",
+        "smoke": ["--mode", "smoke"],
+        "tpu": ["--mode", "tpu"],
+    },
+    "prefix_fleet": {
+        "script": "bench_prefix_fleet.py",
         "smoke": ["--mode", "smoke"],
         "tpu": ["--mode", "tpu"],
     },
@@ -179,7 +188,8 @@ def eval_gated_line(bench_name):
 EVALS = {"prefill": eval_prefill, "kv_quant": eval_kv_quant,
          "serving": eval_serving,
          "indexer": eval_gated_line("indexer"),
-         "global_router": eval_gated_line("global_router")}
+         "global_router": eval_gated_line("global_router"),
+         "prefix_fleet": eval_gated_line("prefix_fleet")}
 
 
 def main() -> int:

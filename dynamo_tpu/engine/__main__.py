@@ -94,11 +94,18 @@ def build_args() -> argparse.ArgumentParser:
                         "roofline MBU gauges (v5e: 819); 0 = unknown")
     p.add_argument("--host-cache-blocks", type=int, default=0,
                    help="G2 host-DRAM KV cache capacity (blocks); 0 off")
+    p.add_argument("--offload-watermark-blocks", type=int, default=0,
+                   help="offload coldest HBM blocks to G2 once free blocks "
+                        "fall below this (0 = num_blocks/4); raise toward "
+                        "num_blocks so allocation bursts can't evict a "
+                        "block before the offload pass copies it")
     p.add_argument("--disk-cache-dir", default="",
                    help="G3 disk KV cache directory")
     p.add_argument("--disk-cache-blocks", type=int, default=0)
-    p.add_argument("--object-store-dir", default="",
-                   help="G4 cluster-shared object store (shared FS path)")
+    p.add_argument("--object-store-dir",
+                   default=os.environ.get("DYN_KVBM_OBJECT_DIR", ""),
+                   help="G4 cluster-shared object store (shared FS path; "
+                        "defaults to $DYN_KVBM_OBJECT_DIR)")
     p.add_argument("--no-kvbm-remote", action="store_true",
                    help="disable cross-worker G2 pull")
     p.add_argument("--migration-limit", type=int, default=3)
@@ -173,6 +180,7 @@ async def main() -> None:
         peak_tflops=args.peak_tflops,
         peak_hbm_gbps=args.peak_hbm_gbps,
         host_cache_blocks=args.host_cache_blocks,
+        offload_watermark_blocks=args.offload_watermark_blocks,
         disk_cache_dir=args.disk_cache_dir or None,
         disk_cache_blocks=args.disk_cache_blocks,
         object_store_dir=args.object_store_dir or None,

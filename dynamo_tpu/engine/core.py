@@ -1213,6 +1213,44 @@ class JaxEngine:
             out.update(self.kvbm.occupancy())
         return out
 
+    def kv_block_bytes(self) -> int:
+        """Host-tier bytes one block's payload moves when onboarded
+        (all cache components, per physical block) — the numerator of
+        the worker's published per-tier onboard costs."""
+        try:
+            return int(sum(a.nbytes for a in self.kv)
+                       // max(1, self.config.num_blocks))
+        except Exception:
+            return 0
+
+    async def sweep_kvbm_g4(self) -> int:
+        """One lineage-driven GC pass over the shared G4 store (called
+        from the worker's load loop on a slow cadence, never from the
+        scheduler thread — the sweep lists a shared directory).  Hot
+        lineages get their TTL clock renewed, dead lineages reap early,
+        the rest age by TTL (kvbm/residency.py).  Reaped hashes are
+        folded through the consolidator ON the scheduler thread so the
+        engine's cross-tier books drop them too — a later re-spill of
+        the same hash must re-emit stored(g4) or routers never re-learn
+        the blob."""
+        if self.kvbm is None or self.kvbm.g4 is None:
+            return 0
+        from ..kvbm.residency import LineageResidency
+
+        res = LineageResidency(self.kv_ledger, pool=self.kvbm.g4)
+        try:
+            swept = await asyncio.to_thread(self.kvbm.g4.sweep, None, res)
+        except OSError:
+            logger.warning("G4 residency sweep failed", exc_info=True)
+            return 0
+        if swept:
+            def emit() -> int:
+                self._emit_tier_events([([], list(swept), "g4")])
+                return len(swept)
+
+            await self._call_on_scheduler(emit)
+        return len(swept)
+
     # -- KV ledger audit (obs/kv_ledger.py) --------------------------------
     def _audit_ledger_locked(self, where: str = "step") -> dict:
         """One reconciliation sweep: the ledger's books vs the
@@ -1413,8 +1451,10 @@ class JaxEngine:
         """Thread-safe KV event emission (called from the scheduler thread).
 
         Mutations are first folded through the cross-tier consolidator so
-        routers see net ownership (stored on first tier entered, removed on
-        last tier left).  The sink may be synchronous (preferred: enqueue +
+        routers see net PER-TIER residency (stored on entering a tier,
+        removed on leaving it — duplicate same-tier mutations net out; the
+        tier-aware index derives union ownership router-side).  The sink
+        may be synchronous (preferred: enqueue +
         serialized publish, see KvEventPublisher.enqueue_batch) or an async
         callable.  Either way it is invoked on the loop thread via
         call_soon_threadsafe, whose FIFO callback ordering keeps wire order
@@ -1928,8 +1968,8 @@ class JaxEngine:
         def read():
             out = []
             for h in hashes:
-                blk, events = self.kvbm.fetch(h) if self.kvbm is not None \
-                    else (None, [])
+                blk, events, _src = self.kvbm.fetch(h) \
+                    if self.kvbm is not None else (None, [], None)
                 self._emit_tier_events(events)
                 if blk is None:
                     break
@@ -1972,9 +2012,13 @@ class JaxEngine:
                 blocks=len(cands))
 
     def _try_onboard(self, slot: _Slot, hit: int, cap_blocks: int) -> int:
-        """Extend a G1 prefix hit with blocks onboarded from G2/G3: scatter
-        their payloads into the freshly allocated HBM blocks instead of
-        recomputing prefill.  Returns the number of blocks onboarded."""
+        """Extend a G1 prefix hit with blocks onboarded from G2/G3/G4:
+        scatter their payloads into the freshly allocated HBM blocks
+        instead of recomputing prefill.  match_run (and the fetch walk)
+        reach through the shared object store, so a cold worker under
+        shared-prefix load onboards the fleet's history — the G4 path the
+        tiered router prices and routes to.  Returns the number of blocks
+        onboarded."""
         if self.kvbm is None:
             return 0
         hashes = slot.seq.block_hashes
@@ -1986,8 +2030,9 @@ class JaxEngine:
         arity = len(self.kv)
         comps: List[list] = [[] for _ in range(arity)]
         ids = []
+        by_tier: Dict[str, int] = {}
         for i in range(hit, hit + run):
-            blk, events = self.kvbm.fetch(hashes[i])
+            blk, events, src = self.kvbm.fetch(hashes[i])
             self._emit_tier_events(events)
             if blk is None:  # dropped from the pool mid-walk
                 break
@@ -2003,6 +2048,11 @@ class JaxEngine:
             for c, arr in zip(comps, blk):
                 c.append(arr)
             ids.append(block_ids[i])
+            if src is not None:
+                by_tier[src] = by_tier.get(src, 0) + 1
+                if self.kv_ledger is not None:
+                    self.kv_ledger.onboard(hashes[i], src,
+                                           seq=self._seq_id(slot))
         if not ids:
             return 0
         n = len(ids)
@@ -2023,7 +2073,12 @@ class JaxEngine:
             self.kv, *(jnp.asarray(a) for a in stacked[:2]),
             jnp.asarray(ids_arr), *(jnp.asarray(a) for a in stacked[2:])
         )
-        obs.end("kvbm_onboard", t_obs, track=self._obs_track, blocks=n)
+        for src, cnt in by_tier.items():
+            key = f"kv_onboard_{src}"
+            self.metrics[key] = self.metrics.get(key, 0) + cnt
+        obs.end("kvbm_onboard", t_obs, track=self._obs_track, blocks=n,
+                tokens=n * self.config.block_size,
+                **{f"from_{s}": c for s, c in by_tier.items()})
         return n
 
     # -- prefill ----------------------------------------------------------

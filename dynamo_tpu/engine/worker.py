@@ -477,8 +477,26 @@ class JaxEngineWorker:
             return {**base, "schema": "dynamo.kv_ledger.v1",
                     "enabled": False}
         audit = await eng.audit_kv()
-        return {**base, **eng.kv_ledger.dump(), "audit": audit,
-                "kv": eng.kv_occupancy()}
+        out = {**base, **eng.kv_ledger.dump(), "audit": audit,
+               "kv": eng.kv_occupancy()}
+        if eng.kvbm is not None and eng.kvbm.g4 is not None:
+            # G4 residency picture: blob count + this worker's lineage
+            # verdicts over a bounded sample (the sweep applies the same
+            # policy; here it's read-only for the fleet aggregator)
+            from ..kvbm.residency import LineageResidency
+
+            try:
+                keys = []
+                for h in eng.kvbm.g4.keys():
+                    keys.append(h)
+                    if len(keys) >= 2048:
+                        break
+                res = LineageResidency(eng.kv_ledger, pool=eng.kvbm.g4)
+                out["g4"] = {"blobs_sampled": len(keys),
+                             "residency": res.verdicts(keys)}
+            except OSError:
+                pass  # shared dir raced a sweep; next scrape reads it
+        return out
 
     def debug_state(self) -> dict:
         """Live scheduler/KV/drain snapshot for /debug/state and the
@@ -698,8 +716,13 @@ class JaxEngineWorker:
         # (and /debug/state reads compile stats + ITL p95 off the same
         # window)
         fw = self._fpm_window
+        from ..router.tiered_index import compute_tier_costs
+
+        ticks = 0
+        tier_costs = None
         while True:
             await asyncio.sleep(0.5)
+            ticks += 1
             if self.engine is None or self.served is None:
                 continue
             # forward-pass metrics stream (ref fpm_publisher.rs): drain
@@ -736,11 +759,33 @@ class JaxEngineWorker:
             # tier-2 sender refs whose receiver died mid-pull (mirrors the
             # engine's parked-KV TTL)
             self._chunk_refs.sweep(self.engine.parked_ttl_s)
+            # per-tier onboard costs for the router's tiered selector:
+            # measured prefill rate (roofline plane) over the cache's
+            # per-block payload bytes.  Recomputed each tick — the
+            # measured rate converges as the window fills; the selector
+            # falls back to defaults until the first publish.
+            flops_rate, _bytes_rate = fw._phase_rates("prefill")
+            tok_rate = fw.prefill_tokens_per_s()
+            if flops_rate > 0.0 and tok_rate > 0.0:
+                tier_costs = compute_tier_costs(
+                    prefill_flops_per_s=flops_rate,
+                    flops_per_token=flops_rate / tok_rate,
+                    bytes_per_block=self.engine.kv_block_bytes(),
+                    block_tokens=self.config.block_size)
+            # lineage-driven G4 GC on a slow cadence (~30s): the shared
+            # store is swept by every mounted worker; hot lineages get
+            # their TTL renewed, dead ones reap early
+            if ticks % 60 == 0:
+                try:
+                    await self.engine.sweep_kvbm_g4()
+                except Exception:
+                    logger.warning("g4 sweep failed", exc_info=True)
             await self.runtime.event_plane.publish(subject, {
                 "worker_id": self.served.instance_id,
                 "active_seqs": self.engine.num_active_seqs,
                 "kv_usage": self.engine.kv_usage(),
                 "kv_total_blocks": self.config.num_blocks,
+                **({"kv_tier_costs": tier_costs} if tier_costs else {}),
                 # effective KV dtype: the planner checks live workers
                 # against the perf profile's dtype tag
                 "kv_cache_dtype": self.engine.kv_dtype,

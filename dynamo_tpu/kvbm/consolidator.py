@@ -2,14 +2,26 @@
 
 Ref: lib/kvbm-consolidator/src/lib.rs:1-12 — the reference dedups KV events
 from multiple sources (G1 engine stream + G2/G3 KVBM broadcast) into ONE
-router-compatible stream keyed by the 128-bit PLH.  Routers stay tier-blind:
-a block is owned by a worker while *any* tier holds it, so
+router-compatible stream keyed by the 128-bit PLH.
 
-  * `stored` is published only when a block enters its FIRST tier, and
-  * `removed` only when it leaves its LAST tier.
+The stream is **per-tier netted** (the fleet prefix-cache contract):
 
-Without this, `stored(g1) → offload stored(g2) → evict removed(g1)` would
-make a tier-blind router drop a block the worker can still onboard.
+  * `stored(tier=t)` is published when a block enters tier *t* and was not
+    already resident there, and
+  * `removed(tier=t)` when it leaves a tier it was resident in.
+
+Tier-aware consumers (router/tiered_index.py, kvbm/remote.py's
+RemoteBlockIndex) rebuild exact per-(worker, tier) residency from this;
+union membership ("the worker can serve the block from SOME tier") is the
+OR across tiers, which the tiered indexer derives on its side.  Duplicate
+mutations inside one tier still net to nothing, so `stored(g1) → offload
+stored(g2) → evict removed(g1)` tells the router precisely what happened:
+the block demoted from HBM to host — onboardable, but no longer free.
+
+G4 is the shared object store: any worker may sweep a blob another worker
+spilled, so `removed(tier="g4")` passes through even when this worker's
+books never saw the store — the consolidator must not eat a GC
+notification just because the sweeper wasn't the spiller.
 
 Runs on the engine scheduler thread (same thread as every cache mutation),
 so net-event order equals mutation order.
@@ -37,18 +49,26 @@ class KvEventConsolidator:
         net_removed: List[int] = []
         for h in removed:
             tiers = self._tiers.get(h)
-            if tiers is None:
+            if tiers is None or tier not in tiers:
+                if tier == "g4":
+                    # shared-store GC: the sweeper may not be the spiller
+                    net_removed.append(h)
                 continue
             tiers.discard(tier)
             if not tiers:
                 del self._tiers[h]
-                net_removed.append(h)
+            net_removed.append(h)
         net_stored: List[int] = []
         for h in stored:
             tiers = self._tiers.get(h)
             if tiers is None:
                 self._tiers[h] = {tier}
                 net_stored.append(h)
-            else:
+            elif tier not in tiers:
                 tiers.add(tier)
+                net_stored.append(h)
         return net_stored, net_removed, tier
+
+    def resident_tiers(self, h: int) -> Set[str]:
+        """Tiers the block is currently resident in (empty set if gone)."""
+        return set(self._tiers.get(h, ()))

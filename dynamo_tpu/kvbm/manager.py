@@ -168,19 +168,24 @@ class TieredKvManager:
             n += 1
         return n
 
-    def fetch(self, h: int) -> Tuple[Optional[Block], TierEvents]:
-        """Read one block for onboarding.  G3 hits are promoted into G2.
+    def fetch(self, h: int) -> Tuple[Optional[Block], TierEvents, Optional[str]]:
+        """Read one block for onboarding.  G3/G4 hits are promoted into G2.
 
-        Returns (block, tier_events); block is None on a miss.  The events
-        must be emitted even on a miss: an unreadable G3 file is dropped
-        from the pool here, and the router must see that removal or it will
-        keep routing prefixes to a block that can never onboard."""
+        Returns (block, tier_events, src_tier); block is None on a miss
+        (src_tier None).  src_tier names the tier that actually served the
+        bytes — the engine's per-tier onboard accounting and the ledger's
+        `onboard` marks key off it.  The events must be emitted even on a
+        miss: an unreadable G3 file is dropped from the pool here, and the
+        router must see that removal or it will keep routing prefixes to a
+        block that can never onboard."""
         blk = self.g2.get(h)
+        src: Optional[str] = "g2" if blk is not None else None
         events: TierEvents = []
         if blk is None and self.g3 is not None:
             was_held = h in self.g3
             blk = self.g3.get(h)
             if blk is not None:
+                src = "g3"
                 self.stats["disk_hits"] += 1
                 events.append(([h], [], "g2"))
                 for victim_h, victim in self.g2.put(h, *blk):
@@ -191,14 +196,15 @@ class TieredKvManager:
             blk = self.g4.get(h)
             if blk is not None:
                 # promote into G2 (the blob stays in G4 — it's shared)
+                src = "g4"
                 self.stats["g4_hits"] = self.stats.get("g4_hits", 0) + 1
                 events.append(([h], [], "g2"))
                 for victim_h, victim in self.g2.put(h, *blk):
                     events.extend(self._demote(victim_h, victim))
         if blk is None:
-            return None, events
+            return None, events, None
         self.stats["onboarded"] += 1
-        return blk, events
+        return blk, events, src
 
     def clear(self) -> TierEvents:
         events: TierEvents = []

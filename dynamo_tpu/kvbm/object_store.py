@@ -89,13 +89,28 @@ class ObjectStorePool:
         except (OSError, KeyError, ValueError, TypeError, AttributeError):
             return None  # concurrent GC / torn write: treat as miss
 
-    def sweep(self, now: Optional[float] = None) -> int:
-        """GC: TTL eviction by mtime (when a TTL is set) plus reaping of
-        pre-128-bit-key legacy blobs (16 hex chars — never indexed under
-        the widened naming, so without this they would sit unindexed and
-        unevicted forever).  Safe to run from any client concurrently."""
+    def sweep(self, now: Optional[float] = None,
+              residency=None) -> List[int]:
+        """GC; returns the reaped hashes (so the caller can publish
+        ``removed(tier="g4")`` — the sweeper need not be the spiller).
+
+        Baseline policy is TTL-by-mtime (when a TTL is set) plus reaping
+        of pre-128-bit-key legacy blobs (16 hex chars — never indexed
+        under the widened naming, so without this they would sit
+        unindexed and unevicted forever).
+
+        `residency` (lineage-driven policy, kvbm/residency.py) upgrades
+        the verdict per blob: a callable hash -> "hot" | "dead" | None.
+        "hot" blobs get their mtime touched, so shared-prefix lineages
+        the ledger still sees live traffic on NEVER age out under the
+        TTL; "dead" blobs (dead-lineage attribution) are reaped
+        immediately, ahead of their TTL; None falls back to the TTL
+        clock — per-worker views disagree harmlessly because a blob only
+        dies when NO sweeper with a live view touches it before its TTL.
+        Safe to run from any client concurrently (unlink/utime races are
+        benign)."""
         now = now if now is not None else time.time()
-        removed = 0
+        removed: List[int] = []
         for sub in os.listdir(self.dir):
             d = os.path.join(self.dir, sub)
             if not os.path.isdir(d):
@@ -103,18 +118,28 @@ class ObjectStorePool:
             for name in os.listdir(d):
                 p = os.path.join(d, name)
                 legacy = False
-                if len(name) == 16 and ".tmp" not in name:
+                h: Optional[int] = None
+                if ".tmp" not in name:
                     try:
-                        int(name, 16)  # only reap actual legacy keys
-                        legacy = True
+                        if len(name) == 16:
+                            int(name, 16)  # only reap actual legacy keys
+                            legacy = True
+                        elif len(name) == 32:
+                            h = int(name, 16)
                     except ValueError:
                         pass
+                verdict = (residency(h) if residency is not None
+                           and h is not None else None)
                 try:
-                    if legacy or (
-                            self.ttl_s is not None
+                    if legacy or verdict == "dead" or (
+                            verdict is None
+                            and self.ttl_s is not None
                             and now - os.path.getmtime(p) > self.ttl_s):
                         os.unlink(p)
-                        removed += 1
+                        if h is not None:
+                            removed.append(h)
+                    elif verdict == "hot":
+                        os.utime(p)  # lease renewal: restart the TTL clock
                 except OSError:
                     continue
         return removed

@@ -31,12 +31,16 @@ from ..runtime.retry import KVBM_POLICY, call_with_retry
 
 logger = logging.getLogger(__name__)
 
-# tiers a peer can serve from host memory/disk without device work
-PULLABLE_TIERS = ("g2", "g3")
+# tiers a peer can serve from host memory/disk without device work.
+# g4 rides the same path: a worker WITHOUT the shared-FS mount pulls
+# object-store blobs through a peer that has one (the peer's fetch
+# promotes the blob into its G2 and streams it) — every worker reaches
+# the fleet prefix cache even when only some mount DYN_KVBM_OBJECT_DIR.
+PULLABLE_TIERS = ("g2", "g3", "g4")
 
 
 class RemoteBlockIndex:
-    """hash -> set(worker ids) for host-resident (G2/G3) blocks, built by
+    """hash -> set(worker ids) for pullable (G2/G3/G4) blocks, built by
     following the component's KV event stream."""
 
     def __init__(self, runtime, namespace: str, component: str,
@@ -62,6 +66,22 @@ class RemoteBlockIndex:
                 try:
                     ev = KvCacheEvent.from_wire(payload)
                 except Exception:
+                    continue
+                if ev.op == "removed" and ev.tier == "g4":
+                    # shared-store GC: one sweep (by ANY worker,
+                    # ourselves included) kills the blob for every
+                    # holder — clear the g4 tier fleet-wide
+                    for h in ev.block_hashes:
+                        by_worker = self.holders.get(h)
+                        if not by_worker:
+                            continue
+                        for w in list(by_worker):
+                            tiers = by_worker[w]
+                            tiers.discard("g4")
+                            if not tiers:
+                                del by_worker[w]
+                        if not by_worker:
+                            del self.holders[h]
                     continue
                 if ev.worker_id == self.self_id:
                     continue  # local blocks are found via the local kvbm

@@ -1,0 +1,76 @@
+"""Lineage-driven G4 residency policy.
+
+Blind TTL-by-mtime treats a hot shared-prefix lineage and a dead one
+identically: the system prompt every request hits ages out the moment
+traffic pauses longer than the TTL, while blobs whose lineage is broken
+(parent gone everywhere — unreachable by leading-run prefix matching,
+the ledger's `dead_frac` notion) squat until the clock runs out.  This
+policy upgrades each blob's sweep verdict from the books the PR 14
+ledger already keeps:
+
+    hot    the hash (or a block that chains to it) saw traffic within
+           `hot_window_s` — the sweep touches the blob's mtime, so live
+           lineages NEVER TTL out
+    dead   the blob's parent is gone from every tier this worker can
+           see (its own books AND the shared store itself) — it can
+           never head or extend a leading run again; reap early
+    None   unknown (no lineage record, parent alive, or traffic stale
+           but lineage intact) — the TTL clock decides, unchanged
+
+Per-worker views disagree harmlessly: a blob only dies by TTL when NO
+sweeper with a live view renews it first, and a `dead` verdict is
+conservative — the parent check consults the shared store, which every
+mounted worker sees identically.  The object store stays policy-free;
+this module is just the `residency` callable its sweep accepts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+# traffic within this window marks a lineage hot (sweep cadence is the
+# worker load loop's seconds-scale tick, so minutes-scale is "live")
+DEFAULT_HOT_WINDOW_S = 300.0
+
+
+class LineageResidency:
+    """hash -> "hot" | "dead" | None, from the ledger's lineage books.
+
+    Built per sweep (the resident set is snapshotted once, not per
+    blob); pass the instance straight as ObjectStorePool.sweep's
+    `residency` argument."""
+
+    def __init__(self, ledger, pool=None,
+                 hot_window_s: float = DEFAULT_HOT_WINDOW_S,
+                 now: Optional[float] = None):
+        self.ledger = ledger
+        self.pool = pool
+        self.hot_window_s = hot_window_s
+        self._now = now if now is not None else time.monotonic()
+        self._resident = (ledger.resident_hashes()
+                          if ledger is not None else set())
+
+    def __call__(self, h: int) -> Optional[str]:
+        if self.ledger is None:
+            return None
+        if self.ledger.touched_within(h, self.hot_window_s, now=self._now):
+            return "hot"
+        known, parent = self.ledger.lineage_parent(h)
+        if not known:
+            return None  # commit record aged out: TTL decides
+        if parent is None:
+            return None  # lineage root: reachable by definition
+        if parent in self._resident:
+            return None
+        if self.pool is not None and parent in self.pool:
+            return None  # parent lives in the shared store itself
+        return "dead"
+
+    def verdicts(self, hashes) -> dict:
+        """Debug surface (/debug/kv): verdict histogram + examples."""
+        counts = {"hot": 0, "dead": 0, "ttl": 0}
+        for h in hashes:
+            v = self(h) or "ttl"
+            counts[v] = counts.get(v, 0) + 1
+        return counts

@@ -98,6 +98,19 @@ class MockEngineArgs:
     # KvLedger (hash-keyed) so /debug/kv and the auditor are tier-1
     # testable CPU-only.
     kv_ledger: Optional[bool] = None
+    # -- simulated KVBM tiers (fleet prefix cache) ------------------------
+    # G2 host-LRU capacity in blocks (0 = no host tier): G1 evictions
+    # demote here; G2 overflow spills into `object_store`
+    host_blocks: int = 0
+    # a SHARED kv_cache_sim.SimObjectStore standing in for the G4
+    # shared-FS object store — pass ONE instance to every worker of a
+    # simulated fleet so they see the same fleet prefix cache
+    object_store: Optional[object] = None
+    # onboard latency model: seconds charged per block served back into
+    # G1 from each tier (added to the admitting step's simulated time,
+    # and the source of the worker's advertised kv_tier_costs)
+    g2_onboard_s_per_block: float = 0.0005
+    g4_onboard_s_per_block: float = 0.002
     # -- simulated device-performance plane (obs satellites) --------------
     # the first dispatch of each program family emits a `compile` FPM
     # record of this duration — the exact record shape the JAX engine's
@@ -171,7 +184,12 @@ class MockEngine:
                           if ledger_enabled(args.kv_ledger) else None)
         self.cache = KvCacheSim(args.num_blocks, args.enable_prefix_caching,
                                 kv_cache_dtype=args.kv_cache_dtype,
-                                ledger=self.kv_ledger)
+                                ledger=self.kv_ledger,
+                                host_blocks=args.host_blocks,
+                                object_store=args.object_store)
+        # onboard latency debt: seconds the NEXT step pays for blocks
+        # admission served back into G1 from G2/G4 this step
+        self._onboard_debt_s = 0.0
         self.publisher = kv_event_publisher
         self.waiting: List[_Seq] = []
         self.running: List[_Seq] = []
@@ -194,6 +212,9 @@ class MockEngine:
         if args.speculative is not None:
             self.metrics["spec_proposed"] = 0
             self.metrics["spec_accepted"] = 0
+        if args.host_blocks or args.object_store is not None:
+            self.metrics["kv_onboard_g2"] = 0
+            self.metrics["kv_onboard_g4"] = 0
         self.itl_ema_s = 0.0  # simulated inter-token latency (SLA planner)
         # forward-pass-metrics ring (the JAX engine's fpm analogue): the
         # worker drains it onto the event plane; with `speculative` set it
@@ -424,7 +445,14 @@ class MockEngine:
         if self.publisher is None or res is None:
             return
         # removed-before-stored within one mutation, serialized on the wire
-        self.publisher.enqueue_batch(stored=res.stored, removed=res.removed)
+        if res.stored or res.removed:
+            self.publisher.enqueue_batch(stored=res.stored,
+                                         removed=res.removed)
+        # tier sim: demotion/onboard batches ride the same wire with
+        # their tier tag (the engine's _emit_tier_events contract)
+        for stored, removed, tier in getattr(res, "tier_events", ()):
+            self.publisher.enqueue_batch(stored=stored, removed=removed,
+                                         tier=tier)
 
     async def _loop(self) -> None:
         try:
@@ -461,6 +489,13 @@ class MockEngine:
                 break  # capacity; keep FIFO order
             self.metrics["cache_hit_blocks"] += res.cached_blocks
             seq.cached_blocks = res.cached_blocks
+            if res.onboarded:
+                per = {"g2": self.args.g2_onboard_s_per_block,
+                       "g4": self.args.g4_onboard_s_per_block}
+                for t, nblk in res.onboarded.items():
+                    self.metrics[f"kv_onboard_{t}"] = \
+                        self.metrics.get(f"kv_onboard_{t}", 0) + nblk
+                    self._onboard_debt_s += nblk * per.get(t, 0.0)
             # prefix-cached tokens skip prefill compute
             seq.prefill_pos = min(
                 res.cached_blocks * self.args.block_size, seq.num_prompt_tokens
@@ -566,10 +601,16 @@ class MockEngine:
 
         # simulated step latency: one base dispatch cost per BURST (the
         # fused path's amortization), per-token costs unchanged
+        # onboard debt: blocks served back into G1 from G2/G4 by this
+        # step's admissions pay their tier's transfer latency here —
+        # cheaper than the prefill recompute they displaced, which is
+        # exactly the gap the cold-start bench measures
+        onboard_s, self._onboard_debt_s = self._onboard_debt_s, 0.0
         step_s = (
             self.args.base_step_s
             + prefill_tokens * self.args.prefill_s_per_token
             + k * len(decode_seqs) * self.args.decode_s_per_seq
+            + onboard_s
         ) / max(self.args.speedup_ratio, 1e-6)
         if self.args.overlap_scheduling:
             # host scheduling hides behind the device: the sleep only

@@ -5,13 +5,68 @@ by PositionalLineageHash: an admitted sequence reuses cached full blocks
 (prefix cache hit), allocates fresh blocks for the rest, and on free its
 blocks stay cached (refcount 0, LRU-evictable) until capacity pressure evicts
 them.  Every store/evict is reported so the worker can publish KV events.
+
+Tier simulation (fleet prefix cache): with `host_blocks` > 0 and/or a
+shared :class:`SimObjectStore`, G1 evictions demote down the same
+G2 (host) → G4 (shared object store) ladder the real KVBM walks, and
+admission onboards tier-resident blocks back into G1 instead of
+recomputing prefill — emitting the SAME per-tier event batches and
+ledger ops (stage/tier_evict/onboard/commit-with-parent) as
+engine/core.py, so the router's tiered index, the G4 residency policy,
+and the cold-start bench all run CPU-only in tier-1.
 """
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+class SimObjectStore:
+    """Shared in-process G4: the mocker's stand-in for
+    kvbm/object_store.py's ObjectStorePool.  Content-addressed by PLH,
+    one instance SHARED by every simulated worker in a fleet test (the
+    shared-FS mount analogue), with the same sweep contract — a
+    residency callable upgrades the blind TTL verdict to hot/dead, and
+    sweep returns the reaped hashes so the sweeper can publish
+    removed(g4) fleet-wide."""
+
+    def __init__(self, ttl_s: float = 3600.0):
+        self.ttl_s = ttl_s
+        self._blobs: Dict[int, float] = {}  # hash -> last-renewed time
+
+    def __contains__(self, h: int) -> bool:
+        return int(h) in self._blobs
+
+    def __len__(self) -> int:
+        return len(self._blobs)
+
+    def put(self, h: int) -> bool:
+        """Idempotent content-addressed put; True when newly stored."""
+        new = int(h) not in self._blobs
+        self._blobs[int(h)] = time.monotonic()
+        return new
+
+    def keys(self) -> List[int]:
+        return list(self._blobs)
+
+    def sweep(self, now: Optional[float] = None,
+              residency=None) -> List[int]:
+        """Same verdict ladder as ObjectStorePool.sweep: hot renews,
+        dead reaps early, None falls back to the TTL clock."""
+        now = now if now is not None else time.monotonic()
+        reaped: List[int] = []
+        for h, t in list(self._blobs.items()):
+            verdict = residency(h) if residency is not None else None
+            if verdict == "hot":
+                self._blobs[h] = now
+            elif verdict == "dead" or (verdict is None
+                                       and now - t > self.ttl_s):
+                del self._blobs[h]
+                reaped.append(h)
+        return reaped
 
 
 def kv_dtype_capacity_blocks(num_blocks: int, kv_cache_dtype: str,
@@ -32,15 +87,31 @@ class CacheStepResult:
     stored: List[int] = field(default_factory=list)  # newly stored full-block PLHs
     removed: List[int] = field(default_factory=list)  # evicted PLHs
     cached_blocks: int = 0  # prefix-cache hits for this allocation
+    # per-tier event batches beyond g1: [(stored, removed, tier), ...] —
+    # the exact batch shape engine/core.py's _emit_tier_events feeds the
+    # publisher, so the router sees identical wire traffic from the sim
+    tier_events: List[Tuple[List[int], List[int], str]] = \
+        field(default_factory=list)
+    # blocks served into G1 from a lower tier this mutation, by source —
+    # drives the engine's onboard-latency model + kv_onboard_* metrics
+    onboarded: Dict[str, int] = field(default_factory=dict)
 
 
 class KvCacheSim:
     def __init__(self, num_blocks: int, enable_prefix_caching: bool = True,
-                 kv_cache_dtype: str = "bf16", ledger=None):
+                 kv_cache_dtype: str = "bf16", ledger=None,
+                 host_blocks: int = 0, object_store=None):
         num_blocks = kv_dtype_capacity_blocks(num_blocks, kv_cache_dtype)
         self.kv_cache_dtype = kv_cache_dtype
         self.num_blocks = num_blocks
         self.enable_prefix_caching = enable_prefix_caching
+        # simulated KVBM tiers: a bounded G2 host LRU fed by G1
+        # demotions, whose own overflow spills into the SHARED G4
+        # object store (the fleet prefix cache).  Zero host_blocks with
+        # a store attached spills G1 evictions straight to G4.
+        self.host_blocks = max(0, host_blocks)
+        self._g2: "OrderedDict[int, None]" = OrderedDict()
+        self.g4 = object_store
         # block-lifecycle ledger (obs/kv_ledger.py), hash-keyed — sim
         # blocks have no physical identity; partial blocks record as
         # anonymous per-seq counts.  Same accounting contract as
@@ -79,8 +150,46 @@ class KvCacheSim:
             out.removed.append(h)
             if led is not None:
                 led.evict(h, h)
+            self._demote(h, out)
             n -= 1
         return True
+
+    # -- tier plumbing ----------------------------------------------------
+    def _tier_event(self, out: CacheStepResult, stored: List[int],
+                    removed: List[int], tier: str) -> None:
+        out.tier_events.append((stored, removed, tier))
+        if self.ledger is not None:
+            self.ledger.tier_batch(stored, removed, tier)
+
+    def _demote(self, h: int, out: CacheStepResult) -> None:
+        """G1 eviction spills to the G2 host LRU; a full G2 spills ITS
+        LRU victim into the shared G4 store — the offload ladder the
+        real engine's KVBM walks, one hop per pressure event."""
+        if self.host_blocks <= 0:
+            self._spill_g4(h, out)
+            return
+        if h in self._g2:
+            self._g2.move_to_end(h)
+            return
+        while len(self._g2) >= self.host_blocks:
+            victim, _ = self._g2.popitem(last=False)
+            self._tier_event(out, [], [victim], "g2")
+            self._spill_g4(victim, out)
+        self._g2[h] = None
+        self._tier_event(out, [h], [], "g2")
+
+    def _spill_g4(self, h: int, out: CacheStepResult) -> None:
+        if self.g4 is None:
+            return
+        self.g4.put(h)
+        # stored(g4) is emitted per SPILLER (content-addressed dedup
+        # lives in the store): the router attributes the blob to this
+        # worker too, and the consolidator nets re-spills locally
+        self._tier_event(out, [h], [], "g4")
+
+    @property
+    def g2_blocks(self) -> int:
+        return len(self._g2)
 
     # -- sequence lifecycle ----------------------------------------------
     def lookup(self, block_hashes: Sequence[int]) -> int:
@@ -123,18 +232,50 @@ class KvCacheSim:
             if led is not None:
                 led.pin(h, seq_id)
         # allocate + store the remaining full blocks; an eviction hole can
-        # leave later blocks still cached — pin those instead of re-storing
-        for h in block_hashes[hit:]:
+        # leave later blocks still cached — pin those instead of re-storing.
+        # While the reuse run is still CONTIGUOUS from the g1 hit, a
+        # g2/g4-resident block onboards into G1 instead of recomputing
+        # prefill (the engine's _try_onboard path); the first true miss
+        # breaks the run — prefix KV is position-addressed, so nothing
+        # after a hole is reusable.
+        run_alive = self.enable_prefix_caching
+        for i in range(hit, len(block_hashes)):
+            h = block_hashes[i]
+            prev = block_hashes[i - 1] if i > 0 else None
             if h in self._ref:
                 self._pin(h)
                 if led is not None:
                     led.pin(h, seq_id)
+                if run_alive:
+                    out.cached_blocks += 1
                 continue
+            src = None
+            if run_alive:
+                if h in self._g2:
+                    src = "g2"
+                elif self.g4 is not None and h in self.g4:
+                    src = "g4"
             self.free_blocks -= 1
             self._ref[h] = 1
             out.stored.append(h)
             if led is not None:
                 led.alloc(h, seq_id, h=h)
+                # lineage: parent of block i is block i-1's PLH — what
+                # the G4 residency policy walks (kvbm/residency.py)
+                led.commit(h, h, parent=prev, seq=seq_id)
+            if src is None:
+                run_alive = False
+                continue
+            # onboard: promote the tier copy into G1.  The g2 copy
+            # moves (host slot freed); the g4 blob STAYS — it is the
+            # shared fleet copy every other worker scores on.
+            out.onboarded[src] = out.onboarded.get(src, 0) + 1
+            out.cached_blocks += 1
+            if src == "g2":
+                self._g2.pop(h, None)
+                self._tier_event(out, [], [h], "g2")
+            if led is not None:
+                led.onboard(h, src, seq=seq_id)
         # partial blocks are held but unhashed
         n_partial = total_blocks - len(block_hashes)
         self.free_blocks -= n_partial
@@ -143,7 +284,9 @@ class KvCacheSim:
 
         self._seq_full[seq_id] = list(block_hashes)
         self._seq_partial[seq_id] = n_partial
-        out.cached_blocks = hit
+        # realized reuse = g1 leading hits + the contiguous onboarded/
+        # pinned extension counted above (forensic cached_tokens)
+        out.cached_blocks += hit
         return out
 
     def _pin(self, h: int) -> None:
@@ -162,7 +305,9 @@ class KvCacheSim:
             # the partial block the seq held gains its identity; the physical
             # slot it occupies is unchanged
             self._seq_partial[seq_id] -= 1
-            self._seq_full[seq_id].append(completed_hash)
+            full = self._seq_full[seq_id]
+            parent = full[-1] if full else None
+            full.append(completed_hash)
             if completed_hash in self._ref:
                 # identical block already cached (e.g. same seed replay):
                 # pin it so eviction can't take it out from under us; the
@@ -176,6 +321,8 @@ class KvCacheSim:
                 out.stored.append(completed_hash)
                 if led is not None:
                     led.alloc(completed_hash, seq_id, h=completed_hash)
+                    led.commit(completed_hash, completed_hash,
+                               parent=parent, seq=seq_id)
             if led is not None:
                 led.partial(seq_id, -1)
         if need_new_block:

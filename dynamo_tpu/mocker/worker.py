@@ -232,6 +232,17 @@ class MockerWorker:
             out["violations_total"] = merged.violations_by_kind()
             out["ranks"] = [{"dp_rank": r, "audit": a}
                             for r, a in enumerate(audits)]
+        store = self.args.object_store
+        if store is not None:
+            # G4 residency view (the JAX worker's contract): lineage
+            # verdict histogram over a bounded blob sample
+            from ..kvbm.residency import LineageResidency
+
+            keys = store.keys()[:2048]
+            res = LineageResidency(engines[0].kv_ledger, pool=store)
+            out["g4"] = {"blobs_total": len(store),
+                         "blobs_sampled": len(keys),
+                         "residency": res.verdicts(keys)}
         return out
 
     def debug_state(self) -> dict:
@@ -279,8 +290,17 @@ class MockerWorker:
             "slots": slots,
             "tokens_in_flight": sum(
                 s["prompt_len"] + s["generated"] for s in slots),
-            "kv": {"g1": {"used": used, "free": cap - used,
-                          "capacity": cap}},
+            "kv": {
+                "g1": {"used": used, "free": cap - used,
+                       "capacity": cap},
+                **({"g2": {"used": sum(e.cache.g2_blocks
+                                       for e in engines),
+                           "capacity": self.args.host_blocks
+                           * len(engines)}}
+                   if self.args.host_blocks else {}),
+                **({"g4": {"used": len(self.args.object_store)}}
+                   if self.args.object_store is not None else {}),
+            },
             "kv_usage": (sum(e.kv_usage() for e in engines)
                          / len(engines)) if engines else 0.0,
             "kv_cache_dtype": self.args.kv_cache_dtype,
@@ -305,10 +325,12 @@ class MockerWorker:
         # see spec acceptance etc. without a planner attached (and
         # /debug/state reads compile stats + ITL p95 off the window)
         fw = self._fpm_window
+        ticks = 0
         while True:
             await asyncio.sleep(0.25)
             if self.engine is None or self.served is None:
                 continue
+            ticks += 1
             # drain the simulated FPM rings (spec_verify acceptance
             # records) onto the same subject the JAX worker uses, so
             # FpmObserver.spec_acceptance works against the mocker
@@ -329,12 +351,36 @@ class MockerWorker:
             observe_compile_records(m, steps)
             used = sum(e.cache.used_blocks for e in self.engines)
             cap = sum(e.cache.num_blocks for e in self.engines)
+            occ = {"g1": {"used": used, "free": cap - used,
+                          "capacity": cap}}
+            store = self.args.object_store
+            if self.args.host_blocks:
+                g2u = sum(e.cache.g2_blocks for e in self.engines)
+                g2c = self.args.host_blocks * len(self.engines)
+                occ["g2"] = {"used": g2u, "free": g2c - g2u,
+                             "capacity": g2c}
+            if store is not None:
+                occ["g4"] = {"used": len(store)}
             export_engine_gauges(
                 m, fw, peak_tflops=self.args.peak_tflops,
                 peak_hbm_gbps=self.args.peak_hbm_gbps,
-                occupancy={"g1": {"used": used, "free": cap - used,
-                                  "capacity": cap}},
+                occupancy=occ,
                 kv_ledger=self._merged_ledgers())
+            if store is not None and ticks % 40 == 0:
+                # G4 sweep cadence (the JAX worker's load-loop parity):
+                # lineage verdicts upgrade the TTL, and the swept hashes
+                # publish removed(g4) — one sweep kills the blob for
+                # every holder's router/consolidator books fleet-wide
+                from ..kvbm.residency import LineageResidency
+
+                led = self.engines[0].kv_ledger
+                res = (LineageResidency(led, pool=store)
+                       if led is not None else None)
+                swept = store.sweep(None, res)
+                if swept:
+                    self.publisher.enqueue_batch(removed=swept, tier="g4")
+                    if led is not None:
+                        led.tier_batch([], swept, "g4")
             if steps:
                 try:
                     await self.runtime.event_plane.publish(fpm_subject, {
@@ -353,6 +399,23 @@ class MockerWorker:
             itl = sum(w * e.itl_ema_s
                       for w, e in zip(weights, self.engines)) \
                 / sum(weights)
+            # tier costs from the timing model itself: onboard seconds
+            # per block vs the prefill recompute it displaces — the same
+            # ratio the JAX worker derives from measured roofline rates
+            # (router/tiered_index.compute_tier_costs), known in closed
+            # form here.  speedup_ratio scales both sides, so it cancels.
+            tier_costs = None
+            if self.args.host_blocks or store is not None:
+                recompute = (self.args.block_size
+                             * self.args.prefill_s_per_token)
+                if recompute > 0:
+                    tier_costs = {
+                        "g1": 0.0,
+                        "g2": min(1.0, self.args.g2_onboard_s_per_block
+                                  / recompute),
+                        "g4": min(1.0, self.args.g4_onboard_s_per_block
+                                  / recompute),
+                    }
             await self.runtime.event_plane.publish(subject, {
                 "worker_id": self.served.instance_id,
                 "active_seqs": sum(e.num_active_seqs for e in self.engines),
@@ -373,6 +436,9 @@ class MockerWorker:
                 "prompt_tokens_total": sum(e.metrics["prompt_tokens"]
                                            for e in self.engines),
                 "itl_ema_s": itl,
+                # router cost input: per-tier onboard price relative to
+                # recompute (selector.overlap_cost_blocks consumes this)
+                **({"kv_tier_costs": tier_costs} if tier_costs else {}),
             })
 
     async def drain(self, deadline_s: float = 5.0) -> None:

@@ -434,6 +434,9 @@ def reduce_kv_ledgers(kv_states: List[dict]) -> Optional[dict]:
         return None
     violations: Dict[str, int] = {}
     occupancy: Dict[str, Dict[str, int]] = {}
+    onboards: Dict[str, int] = {}
+    g4_residency: Dict[str, int] = {}
+    g4_workers = 0
     for s in kv_states:
         for kind, tiers in (s.get("violations_total") or {}).items():
             violations[kind] = violations.get(kind, 0) \
@@ -444,12 +447,30 @@ def reduce_kv_ledgers(kv_states: List[dict]) -> Optional[dict]:
                           "pinned_by_transfer", "partial"):
                 if state in states_:
                     dst[state] = dst.get(state, 0) + int(states_[state])
-    return {
+        # fleet prefix cache: onboard totals by source tier + the G4
+        # lineage-residency verdicts (each worker samples its own view
+        # of the shared store; the fold is a fleet-health histogram,
+        # not a dedup — overlapping samples are fine for a headline)
+        for tier, n in (s.get("onboards_by_tier") or {}).items():
+            onboards[tier] = onboards.get(tier, 0) + int(n)
+        g4 = s.get("g4")
+        if isinstance(g4, dict):
+            g4_workers += 1
+            for verdict, n in (g4.get("residency") or {}).items():
+                g4_residency[verdict] = g4_residency.get(verdict, 0) \
+                    + int(n)
+    out = {
         "workers_reporting": len(kv_states),
         "violations": violations,
         "violations_total": sum(violations.values()),
         "occupancy": occupancy,
     }
+    if onboards:
+        out["onboards_by_tier"] = onboards
+    if g4_workers:
+        out["g4"] = {"workers_reporting": g4_workers,
+                     "residency": g4_residency}
+    return out
 
 
 def summarize_states(states: List[dict], frontend_states: List[dict] = (),

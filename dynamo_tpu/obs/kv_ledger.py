@@ -88,15 +88,27 @@ logger = logging.getLogger(__name__)
 #   partial     mocker parity: anonymous (unhashed) block count delta
 #   stage       a block stored into a KVBM tier (g2/g3/g4)
 #   tier_evict  a block dropped from a KVBM tier
+#   onboard     a block's payload served back INTO G1 from a lower tier
+#               (tape/counter only — the allocator's commit and the
+#               fetch promotion's stage already move the membership
+#               books; this mark is what lets the auditor and the
+#               fleet-prefix-cache bench attribute reuse to its source
+#               tier)
 #   clear       whole-cache clear (clear_kv_blocks)
 LEDGER_OPS = frozenset({
     "alloc", "pin", "unpin", "cache", "commit", "evict", "release",
-    "park", "unpark", "partial", "stage", "tier_evict", "clear",
+    "park", "unpark", "partial", "stage", "tier_evict", "onboard",
+    "clear",
 })
 
 VIOLATION_KINDS = ("leak", "double-free", "orphan", "refcount-drift")
 
 DEFAULT_RING = 4096
+
+# bounded lineage-parent / recent-touch maps feeding the G4 residency
+# policy (kvbm/residency.py): oldest entries age out FIFO, which only
+# degrades a verdict to the TTL fallback, never to a wrong "dead"
+LINEAGE_CAP = 65536
 
 
 def ledger_enabled(override: Optional[bool] = None) -> bool:
@@ -142,6 +154,15 @@ class KvLedger:
         self._partials: Dict[str, int] = {}      # mocker: seq -> count
         self._parked_seqs: Set[str] = set()
         self._seq_trace: Dict[str, str] = {}
+        # lineage + liveness surfaces for the G4 residency policy
+        # (kvbm/residency.py): hash -> parent hash (from commit), and
+        # hash -> last touch time (pin/commit/stage/onboard).  Both
+        # FIFO-bounded at LINEAGE_CAP.
+        from collections import OrderedDict
+
+        self._lineage: "OrderedDict[int, Optional[int]]" = OrderedDict()
+        self._touch: "OrderedDict[int, float]" = OrderedDict()
+        self._onboards: Dict[str, int] = {}  # tier -> blocks onboarded
         # the event tape: (t, op, tier, key, h, seq, trace_id)
         self.events: "deque[tuple]" = deque(maxlen=max(64, ring))
         self.counts: Dict[str, int] = {}
@@ -158,6 +179,15 @@ class KvLedger:
         self.counts[op] = self.counts.get(op, 0) + 1
         self.events.append((time.monotonic(), op, tier, key, h, seq,
                             self._seq_trace.get(seq) if seq else None))
+
+    def _touch_h(self, h: Optional[int]) -> None:
+        # callers hold self._lock
+        if h is None:
+            return
+        self._touch[h] = time.monotonic()
+        self._touch.move_to_end(h)
+        while len(self._touch) > LINEAGE_CAP:
+            self._touch.popitem(last=False)
 
     def bind_seq(self, seq: str, trace_id: Optional[str]) -> None:
         """Associate a request's propagated trace_id with its seq_id so
@@ -185,6 +215,7 @@ class KvLedger:
                 ent = self._blk[key] = _Entry()
             ent.rc += 1
             ent.owners[seq] = ent.owners.get(seq, 0) + 1
+            self._touch_h(ent.h)
             self._note("pin", "g1", key, ent.h, seq)
 
     def unpin(self, key: int, seq: str) -> None:
@@ -220,6 +251,11 @@ class KvLedger:
             if ent is not None:
                 ent.h = h
                 ent.parent = parent
+            self._lineage[h] = parent
+            self._lineage.move_to_end(h)
+            while len(self._lineage) > LINEAGE_CAP:
+                self._lineage.popitem(last=False)
+            self._touch_h(h)
             self._note("commit", "g1", key, h, seq)
 
     def evict(self, key: int, h: Optional[int] = None) -> None:
@@ -285,7 +321,24 @@ class KvLedger:
             for h in stored:
                 if s is not None:
                     s.add(h)
+                self._touch_h(h)
                 self._note("stage", tier, None, h, None)
+
+    def onboard(self, h: int, tier: str, seq: Optional[str] = None) -> None:
+        """One block served back into G1 from `tier` (tape/counter only;
+        the membership books move via commit + the fetch promotion's
+        stage).  Touches the hash — onboarded lineages are live by
+        definition, which is what keeps them G4-resident."""
+        with self._lock:
+            self._onboards[tier] = self._onboards.get(tier, 0) + 1
+            self._touch_h(h)
+            self._note("onboard", tier, None, h, seq)
+
+    def onboard_counts(self) -> Dict[str, int]:
+        """Per-tier onboard totals (exported as
+        dynamo_engine_kv_onboard_total{tier})."""
+        with self._lock:
+            return dict(self._onboards)
 
     def clear(self) -> None:
         with self._lock:
@@ -293,6 +346,34 @@ class KvLedger:
             self._tiers.clear()
             self._partials.clear()
             self._note("clear", "g1", None, None, None)
+
+    # -- residency surfaces (kvbm/residency.py reads these) ---------------
+    def lineage_parent(self, h: int):
+        """(known, parent): known=False when the commit that would have
+        recorded the parent aged out of the bounded map (or never ran on
+        this worker) — the residency policy must fall back to TTL, not
+        guess."""
+        with self._lock:
+            if h in self._lineage:
+                return True, self._lineage[h]
+            return False, None
+
+    def touched_within(self, h: int, window_s: float,
+                       now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.monotonic()
+        with self._lock:
+            t = self._touch.get(h)
+        return t is not None and (now - t) <= window_s
+
+    def resident_hashes(self) -> Set[int]:
+        """Every hash this worker's books currently account for, across
+        G1 and the KVBM tiers — the liveness set lineage verdicts check
+        parents against."""
+        with self._lock:
+            out = {e.h for e in self._blk.values() if e.h is not None}
+            for s in self._tiers.values():
+                out |= s
+            return out
 
     # -- audit cadence ----------------------------------------------------
     def audit_due(self, idle_interval_s: Optional[float] = None) -> bool:
@@ -571,6 +652,7 @@ class KvLedger:
             "schema": "dynamo.kv_ledger.v1",
             "enabled": True,
             "counts": counts,
+            "onboards_by_tier": self.onboard_counts(),
             "attribution": self.attribution(),
             "violations_total": self.violations_by_kind(),
             "last_audit": last,
@@ -614,6 +696,13 @@ class MergedLedgers:
                 for state, v in states.items():
                     if isinstance(v, (int, float)):
                         dst[state] = dst.get(state, 0) + v
+        return out
+
+    def onboard_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for led in self.ledgers:
+            for tier, n in led.onboard_counts().items():
+                out[tier] = out.get(tier, 0) + n
         return out
 
 

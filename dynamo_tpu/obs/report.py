@@ -299,6 +299,7 @@ def report(events: List[Dict[str, Any]], peak_tflops: float = 0.0,
             gap["cont_burst_frac"] = round(cont / len(decode), 4)
     trace_ids = {ev["args"]["trace_id"] for ev in events
                  if "trace_id" in ev["args"]}
+    fpc = fleet_prefix_cache(events)
     return {
         "spans": len(events),
         "tracks": len(by_track),
@@ -307,6 +308,52 @@ def report(events: List[Dict[str, Any]], peak_tflops: float = 0.0,
         "gap": gap,
         "kinds": kinds,
         "roofline": roofline(events, peak_tflops, peak_hbm_gbps),
+        **({"fleet_prefix_cache": fpc} if fpc else {}),
+    }
+
+
+def fleet_prefix_cache(events: List[Dict[str, Any]]):
+    """TTFT attributed to tier hits: every block a ``kvbm_onboard`` span
+    served back into G1 skipped its share of prefill recompute and paid
+    the tier transfer instead.  Saved time per tier = onboarded tokens ×
+    the SAME trace's measured prefill seconds/token; the net headline
+    subtracts the transfer time actually spent inside the onboard spans.
+    None when the trace has no onboard spans (section omitted)."""
+    onboards = [ev for ev in events if ev["name"] == "kvbm_onboard"]
+    if not onboards:
+        return None
+    prefill = [ev for ev in events if ev["name"] == "prefill_dispatch"
+               and ev["args"].get("tokens")]
+    tok = sum(float(e["args"]["tokens"]) for e in prefill)
+    s_per_tok = (sum(e["dur"] for e in prefill) / 1e6 / tok) \
+        if tok > 0 else 0.0
+    by_tier: Dict[str, Dict[str, float]] = {}
+    onboard_s = 0.0
+    for ev in onboards:
+        a = ev["args"]
+        onboard_s += ev["dur"] / 1e6
+        blocks = float(a.get("blocks") or 0)
+        toks_per_block = (float(a.get("tokens") or 0) / blocks
+                          if blocks else 0.0)
+        for k, v in a.items():
+            if k.startswith("from_"):
+                d = by_tier.setdefault(k[5:], {"blocks": 0,
+                                               "tokens": 0.0})
+                d["blocks"] += int(v)
+                d["tokens"] += float(v) * toks_per_block
+    total_saved = 0.0
+    tiers: Dict[str, Any] = {}
+    for t, d in sorted(by_tier.items()):
+        saved = d["tokens"] * s_per_tok
+        total_saved += saved
+        tiers[t] = {"blocks": int(d["blocks"]),
+                    "recompute_saved_s": round(saved, 6)}
+    return {
+        "onboard_spans": len(onboards),
+        "onboard_s": round(onboard_s, 6),
+        "prefill_s_per_token": round(s_per_tok, 9),
+        "by_tier": tiers,
+        "ttft_saved_s": round(total_saved - onboard_s, 6),
     }
 
 

@@ -479,6 +479,13 @@ def export_engine_gauges(metrics, fw: FpmWindow, peak_tflops: float = 0.0,
                 metrics.set(f"dynamo_engine_kv_blocks_{state}",
                             occ[state], tier=tier)
     if kv_ledger is not None:
+        # fleet prefix cache: blocks served back into G1 by source tier
+        # (the counter the cold-start bench reads TTFT savings off)
+        for tier, n in kv_ledger.onboard_counts().items():
+            metrics.set("dynamo_engine_kv_onboard_total", float(n),
+                        "KV blocks onboarded into HBM by source tier "
+                        "(g2 host / g3 disk / g4 shared object store)",
+                        tier=tier)
         # block-accounting violations (obs/kv_ledger.py auditor):
         # monotonic totals per class+tier — any nonzero sample is a
         # page-worthy capacity-integrity signal, and the zero samples

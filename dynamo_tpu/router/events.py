@@ -106,12 +106,13 @@ class KvEventPublisher:
         self._out: deque[KvCacheEvent] = deque()
         self._drain_task: Optional[asyncio.Task] = None
         # resident-set mirror of the netted stream (loop-thread only,
-        # like id assignment): hash -> tier of its latest store.  The
-        # stream is consolidator-netted, so stored fires once when a
-        # block enters its first tier and removed once when it leaves
-        # its last — membership here is exactly "this worker can serve
-        # the block", the snapshot a late subscriber needs.
-        self._resident: Dict[int, str] = {}
+        # like id assignment): hash -> tiers it is resident in.  The
+        # stream is consolidator-netted PER TIER, so stored fires when a
+        # block enters a tier and removed when it leaves one — the union
+        # over tiers is exactly "this worker can serve the block", and
+        # the per-tier split is what a tier-aware subscriber (the fleet
+        # prefix cache) needs its snapshot grouped by.
+        self._resident: Dict[int, set] = {}
 
     def _mk(self, op: str, block_hashes: Sequence[int],
             parent_hash: Optional[int], tier: str) -> KvCacheEvent:
@@ -144,11 +145,15 @@ class KvEventPublisher:
         if removed:
             self._out.append(self._mk("removed", removed, None, tier))
             for h in removed:
-                self._resident.pop(int(h), None)
+                tiers = self._resident.get(int(h))
+                if tiers is not None:
+                    tiers.discard(tier)
+                    if not tiers:
+                        del self._resident[int(h)]
         if stored:
             self._out.append(self._mk("stored", stored, parent_hash, tier))
             for h in stored:
-                self._resident[int(h)] = tier
+                self._resident.setdefault(int(h), set()).add(tier)
         self._kick()
 
     def _kick(self) -> None:
@@ -212,8 +217,9 @@ class KvEventPublisher:
         consistency: ids and the mirror advance together)."""
         last_id = max(0, self._next_id - 1)
         by_tier: Dict[str, List[int]] = {}
-        for h, tier in self._resident.items():
-            by_tier.setdefault(tier, []).append(h)
+        for h, tiers in self._resident.items():
+            for tier in tiers:
+                by_tier.setdefault(tier, []).append(h)
         return [
             KvCacheEvent(
                 worker_id=self.worker_id, event_id=last_id, op="stored",

@@ -102,8 +102,12 @@ class PyKvIndexer:
 
 
 def indexer_impl(ix) -> str:
-    """Implementation tag for debug/metrics surfaces ("py" | "native")."""
-    return "py" if isinstance(ix, PyKvIndexer) else "native"
+    """Implementation tag for debug/metrics surfaces ("py" | "native").
+
+    Unwraps the tier-aware layer (router/tiered_index.py) — the tag names
+    the underlying membership engine, which is what perf A/Bs compare."""
+    base = getattr(ix, "base", ix)
+    return "py" if isinstance(base, PyKvIndexer) else "native"
 
 
 def make_indexer(impl: Optional[str] = None):

@@ -24,9 +24,10 @@ from ..protocols import ModelDeploymentCard, PreprocessedRequest
 from ..runtime import Client, DistributedRuntime
 from ..tokens import compute_block_hashes_for_request
 from .events import KvCacheEvent, kv_event_subject
-from .indexer import indexer_impl, make_indexer
+from .indexer import indexer_impl
 from .replica_sync import RouterReplicaSync
 from .selector import DefaultWorkerSelector, KvRouterConfig, WorkerState
+from .tiered_index import make_tiered_indexer
 from .sequences import ActiveSequences
 from .targets import TargetMap
 
@@ -44,7 +45,9 @@ class KvRouter:
         self.component = component
         self.client = client  # generate-endpoint client (instance discovery)
         self.block_size = block_size
-        self.indexer = make_indexer()
+        # tier-aware fleet prefix cache: per-(worker, tier) ownership
+        # over either base indexer impl + the fleet-wide G4 set
+        self.indexer = make_tiered_indexer()
         self.selector = DefaultWorkerSelector(config)
         self.sequences = ActiveSequences()
         # LoRA replica placement (lora/routing.py): adapter-carrying
@@ -167,9 +170,9 @@ class KvRouter:
             ev.event_id, last if last is not None else -1
         )
         if ev.op == "stored":
-            self.indexer.apply_stored(tid, ev.block_hashes)
+            self.indexer.apply_stored(tid, ev.block_hashes, tier=ev.tier)
         elif ev.op == "removed":
-            self.indexer.apply_removed(tid, ev.block_hashes)
+            self.indexer.apply_removed(tid, ev.block_hashes, tier=ev.tier)
         elif ev.op == "cleared":
             self.indexer.clear_worker(tid)
 
@@ -223,9 +226,11 @@ class KvRouter:
                 self.indexer.clear_worker(tid)
             for ev in events:
                 if ev.op == "stored":
-                    self.indexer.apply_stored(tid, ev.block_hashes)
+                    self.indexer.apply_stored(tid, ev.block_hashes,
+                                              tier=ev.tier)
                 elif ev.op == "removed":
-                    self.indexer.apply_removed(tid, ev.block_hashes)
+                    self.indexer.apply_removed(tid, ev.block_hashes,
+                                               tier=ev.tier)
                 elif ev.op == "cleared":
                     self.indexer.clear_worker(tid)
             logger.info("recovered %d kv events for target %d since %d",
@@ -266,7 +271,8 @@ class KvRouter:
                     # this target: its first live event triggered the
                     # replay-from-birth/snapshot recovery path.
                     continue
-                self.indexer.apply_stored(tid, ev.block_hashes)
+                self.indexer.apply_stored(tid, ev.block_hashes,
+                                          tier=ev.tier)
                 self.indexer.last_event_id[tid] = max(
                     ev.event_id, last if last is not None else -1)
                 n += len(ev.block_hashes)
@@ -292,6 +298,7 @@ class KvRouter:
                 # per-rank load when the worker reports dp ranks
                 # (ref: per-dp_rank publishers, vllm/main.py:379-425)
                 ranks = payload.get("ranks")
+                tier_costs = payload.get("kv_tier_costs") or {}
                 if ranks:
                     for r in ranks:
                         tid = self.targets.observe(
@@ -302,10 +309,14 @@ class KvRouter:
                         st.kv_total_blocks = r.get(
                             "kv_total_blocks",
                             payload.get("kv_total_blocks", 0))
+                        if tier_costs:
+                            st.tier_costs = dict(tier_costs)
                 else:
                     st = self.states.setdefault(w, WorkerState())
                     st.kv_usage = payload.get("kv_usage", 0.0)
                     st.kv_total_blocks = payload.get("kv_total_blocks", 0)
+                    if tier_costs:
+                        st.tier_costs = dict(tier_costs)
         except asyncio.CancelledError:
             pass
 
@@ -374,7 +385,11 @@ class KvRouter:
             request.token_ids, self.block_size, lora_name=request.lora_name,
             media_hashes=request.media_hashes,
         )
-        overlaps = self.indexer.find_matches(hashes)
+        # tier-aware overlap (fleet prefix cache): the run extends past
+        # local residency through the shared G4 store, and the selector
+        # prices each block at its cheapest source tier
+        tier_overlaps = self.indexer.find_matches_tiered(hashes, candidates)
+        overlaps = {w: sum(c.values()) for w, c in tier_overlaps.items()}
         request_blocks = (len(request.token_ids) + self.block_size - 1) \
             // self.block_size
         # refresh decode-load estimates from the slot manager
@@ -383,7 +398,7 @@ class KvRouter:
             st.active_blocks = self.sequences.active_blocks(t)
         choice, logits = self.selector.select_verbose(
             candidates, request_blocks, overlaps, self.states,
-            avoid=avoid_targets,
+            avoid=avoid_targets, tier_overlaps=tier_overlaps,
         )
         if choice is not None:
             blocks = request_blocks + (request.stop.max_tokens
@@ -398,9 +413,15 @@ class KvRouter:
             self._metrics.inc("dynamo_router_routed_requests_total",
                               worker=str(choice))
             self._metrics.observe("dynamo_router_overlap_blocks", overlap)
+            for t_name, t_blocks in tier_overlaps.get(choice, {}).items():
+                self._metrics.inc(
+                    "dynamo_router_overlap_by_tier", t_blocks,
+                    "chosen-worker overlap blocks by cheapest source tier",
+                    tier=t_name)
             self._record_decision(request.request_id, choice,
                                   request_blocks, overlap, logits,
-                                  overlaps)
+                                  overlaps,
+                                  by_tier=tier_overlaps.get(choice))
             # the wire needs the instance; the engine needs the rank
             worker_id, dp_rank = self.targets.resolve(choice)
             request.dp_rank = dp_rank
@@ -412,7 +433,8 @@ class KvRouter:
     def _record_decision(self, request_id: str, choice: int,
                          request_blocks: int, overlap: int,
                          logits: Dict[int, float],
-                         overlaps: Dict[int, int]) -> dict:
+                         overlaps: Dict[int, int],
+                         by_tier: Optional[Dict[str, int]] = None) -> dict:
         """One decision record per pick: the chosen target's predicted
         overlap + cost, every candidate's score (top-8 by cost), the
         best REJECTED candidate (what routing left on the table — the
@@ -427,6 +449,7 @@ class KvRouter:
         decision: dict = {
             "target": choice,
             "predicted_overlap_blocks": int(overlap),
+            **({"overlap_by_tier": dict(by_tier)} if by_tier else {}),
             "request_blocks": int(request_blocks),
             "score": round(chosen, 3),
             "regret": round(regret, 3),
@@ -498,6 +521,7 @@ class KvRouter:
             "realized_minus_predicted_mean": (round((reals - preds) / n, 3)
                                               if n else None),
             "indexer_impl": indexer_impl(self.indexer),
+            "g4_blocks": getattr(self.indexer, "g4_blocks", 0),
             **({"replica_sync": self.sync.stats()}
                if self.sync is not None else {}),
         }

@@ -202,6 +202,45 @@ def test_bench_global_router_smoke_closed_loop():
                    for r_ in pool["replicas"].values()) > 0
 
 
+def test_bench_prefix_fleet_smoke_closed_loop():
+    """The ISSUE-19 fleet-prefix-cache A/B at smoke scale runs IN
+    tier-1 (seconds on CPU): warm fleet -> junk churn demotes prefixes
+    into the shared G4 store -> a cold worker in a fresh namespace
+    onboards them.  The mechanism gates — byte identity across arms,
+    store populated, cold onboarding from G4, router-visible G4
+    blocks, clean ledger audits — are enforced even in smoke mode (the
+    bench exits 1 on failure); only the TTFT-penalty chip bars are
+    skipped."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_prefix_fleet.py"),
+         "--mode", "smoke"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    (rep,) = [json.loads(line) for line in r.stdout.splitlines()
+              if line.startswith("{")]
+    status = {g["name"]: g["status"] for g in rep["gates"]}
+    assert status["prefix_fleet_byte_identity"] == "pass"
+    assert status["prefix_fleet_store_populated"] == "pass"
+    assert status["prefix_fleet_cold_onboard_g4"] == "pass"
+    assert status["prefix_fleet_router_g4_visible"] == "pass"
+    assert status["prefix_fleet_ledger_audit"] == "pass"
+    assert status["prefix_fleet_cold_start_penalty"] == "skipped_smoke"
+    res = rep["result"]
+    g4, ctl = res["g4"], res["control"]
+    # the cold worker really onboarded from the shared store, and the
+    # control arm really had no tier ladder to lean on
+    assert g4["cold_onboards"]["g4"] > 0 and g4["store_blobs"] > 0
+    assert ctl["cold_onboards"]["g4"] == 0 and ctl["store_blobs"] == 0
+    # G4 residency verdicts surface on the cold worker's /debug/kv
+    assert sum(g4["cold_g4_residency"]["residency"].values()) > 0
+    # even unenforced, the smoke-scale penalty must point the right
+    # way: onboarding strictly cheaper than the control's recompute
+    assert g4["cold_start_penalty"] < ctl["cold_start_penalty"]
+
+
 def test_run_round_help_exits_zero():
     """benchmarks/run_round.py is not matched by the bench_*.py glob
     above, so it gets its own drift gate: --help must import the driver
@@ -233,7 +272,8 @@ def test_run_round_smoke_emits_gated_json_per_bench():
              if line.startswith("{")]
     by_bench = {rep["bench"]: rep for rep in lines}
     assert set(by_bench) == {"prefill", "kv_quant", "serving",
-                             "indexer", "global_router"}
+                             "indexer", "global_router",
+                             "prefix_fleet"}
     gate_names = set()
     for rep in by_bench.values():
         assert rep["round"] == "r06"
@@ -252,7 +292,10 @@ def test_run_round_smoke_emits_gated_json_per_bench():
                           "grouter_byte_identity",
                           "grouter_pools_routed",
                           "grouter_route_p99_ms",
-                          "grouter_staleness_spread"}
+                          "grouter_staleness_spread",
+                          "prefix_fleet_byte_identity",
+                          "prefix_fleet_cold_onboard_g4",
+                          "prefix_fleet_cold_start_penalty"}
     # the correctness bars really ran
     assert {g["name"]: g["status"]
             for g in by_bench["global_router"]["gates"]

@@ -273,7 +273,7 @@ def test_kvbm_tiers_roundtrip_quantized_blocks(tmp_path):
         mgr.offload(h, *blk)  # capacity 2: 11 demotes to G3
     assert 11 in mgr.g3 and 11 not in mgr.g2
     for h, want in blocks.items():
-        got, _events = mgr.fetch(h)
+        got, _events, _src = mgr.fetch(h)
         assert got is not None and len(got) == 4
         for a, b in zip(got, want):
             assert a.dtype == b.dtype

@@ -5,6 +5,7 @@ features): pool/consolidator units first, then engine e2e where evicted
 blocks round-trip HBM→host→HBM instead of being recomputed."""
 
 import asyncio
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -145,9 +146,10 @@ def test_manager_demotes_g2_to_g3_and_promotes_back(tmp_path):
     assert ([1], [], "g3") in ev2 and ([], [1], "g2") in ev2
     assert mgr.match_run([1, 2]) == 2
     # fetching the disk-resident block promotes it back into G2
-    (k, v), ev3 = mgr.fetch(1)
+    (k, v), ev3, src = mgr.fetch(1)
     np.testing.assert_array_equal(k, blk(1)[0])
     assert ([1], [], "g2") in ev3
+    assert src == "g3"
     assert mgr.stats["disk_hits"] == 1
 
 
@@ -162,25 +164,46 @@ def test_manager_fetch_emits_removal_for_vanished_disk_block(tmp_path):
     mgr.offload(2, *blk(2))  # demotes 1 to disk
     for f in os.listdir(tmp_path):
         os.unlink(os.path.join(tmp_path, f))
-    blk_out, events = mgr.fetch(1)
-    assert blk_out is None
+    blk_out, events, src = mgr.fetch(1)
+    assert blk_out is None and src is None
     assert ([], [1], "g3") in events
 
 
 # -------------------------- consolidator --------------------------------
 
 
-def test_consolidator_nets_events_across_tiers():
+def test_consolidator_nets_events_per_tier():
+    """Per-tier netting: each tier's membership nets independently, so
+    an offload IS wire-visible as stored(g2) — the tier-aware router
+    needs to know which tier holds the copy (pricing + the tier-blind
+    inflation fix), unlike the old union netting that swallowed it."""
     c = KvEventConsolidator()
     assert c.apply([1, 2], [], "g1") == ([1, 2], [], "g1")
-    # offload copies into g2: no net store (router already owns them)
+    # offload copies into g2: visible — the hash ENTERS g2
+    assert c.apply([1], [], "g2") == ([1], [], "g2")
+    # re-offload of a g2-resident hash: netted (no membership change)
     assert c.apply([1], [], "g2") == ([], [], "g2")
-    # g1 eviction while g2 holds: no net removal
+    # g1 eviction while g2 holds: visible — the hash LEAVES g1 (the
+    # router downgrades it from a free g1 hit to a priced g2 onboard)
+    assert c.apply([], [1], "g1") == ([], [1], "g1")
+    # double-remove from g1: netted (not a g1 member anymore)
     assert c.apply([], [1], "g1") == ([], [], "g1")
-    # g2 drop is the LAST tier: net removal
+    # g2 drop: the last copy goes
     assert c.apply([], [1], "g2") == ([], [1], "g2")
+    assert c.resident_tiers(1) == set()
     # hash 2 only ever in g1
     assert c.apply([], [2], "g1") == ([], [2], "g1")
+
+
+def test_consolidator_g4_removal_passes_through():
+    """removed(g4) forwards even when this worker never stored the blob:
+    the shared store's sweeper may not be the spiller, and the removal
+    must still reach the fleet's routers."""
+    c = KvEventConsolidator()
+    assert c.apply([], [7], "g4") == ([], [7], "g4")
+    # but a LOCAL g4 spill still nets on re-apply
+    assert c.apply([8], [], "g4") == ([8], [], "g4")
+    assert c.apply([8], [], "g4") == ([], [], "g4")
 
 
 def test_consolidator_evict_reregister_same_mutation():
@@ -314,3 +337,181 @@ def test_object_store_keys_full_128_bits(tmp_path):
     assert float(g1[0].view(np.float32).ravel()[0]) == 1.0
     assert float(g2[0].view(np.float32).ravel()[0]) == 2.0
     assert sorted(pool.keys()) == sorted([h1, h2])
+
+
+# ------------------- G4 object store: concurrency + residency -------------------
+
+
+def test_object_store_atomic_put_racing_writers(tmp_path):
+    """Uncoordinated same-hash writers (two engines demoting the same
+    shared prefix at once): the tmp+rename put stays atomic — the blob
+    is whole and readable afterwards and no tmp litter survives."""
+    import threading
+
+    import numpy as np
+    from dynamo_tpu.kvbm.object_store import ObjectStorePool
+
+    pool = ObjectStorePool(str(tmp_path))
+    h = (7 << 64) | 0x1234
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def writer():
+        barrier.wait()
+        if pool.put(h, arr, arr):
+            wins.append(1)
+
+    threads = [threading.Thread(target=writer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert wins and h in pool
+    got = pool.get(h)
+    assert got is not None
+    assert np.array_equal(got[0].view(np.float32).reshape(8, 8), arr)
+    litter = [n for _, _, files in os.walk(str(tmp_path))
+              for n in files if ".tmp" in n]
+    assert litter == []
+    # content-addressed dedup: a later put is a no-op, not a rewrite
+    assert pool.put(h, arr, arr) is False
+
+
+def test_object_store_read_during_gc(tmp_path):
+    """Readers racing an aggressive TTL sweep see either the blob or a
+    clean miss, never an exception — the engine's onboard path treats
+    None as a broken run and recomputes from there."""
+    import threading
+    import time as _time
+
+    import numpy as np
+    from dynamo_tpu.kvbm.object_store import ObjectStorePool
+
+    pool = ObjectStorePool(str(tmp_path), ttl_s=0.0)
+    arr = np.ones((4, 4), dtype=np.float32)
+    hashes = [(i << 64) | 0xABC for i in range(1, 33)]
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            for h in hashes:
+                try:
+                    pool.get(h)
+                except Exception as e:  # noqa: BLE001 - the contract
+                    errors.append(e)
+                    return
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for _ in range(10):
+            for h in hashes:
+                pool.put(h, arr, arr)
+            pool.sweep(now=_time.time() + 1.0)
+    finally:
+        stop.set()
+        t.join()
+    assert errors == []
+
+
+def test_object_store_multi_client_uncoordinated_gc(tmp_path):
+    """Two mounted clients sweep the same directory concurrently: every
+    expired blob is reaped EXACTLY once across both (the unlink race is
+    benign and losers do not report), so fleet-wide removed(g4) events
+    never double-fire for one blob."""
+    import threading
+    import time as _time
+
+    import numpy as np
+    from dynamo_tpu.kvbm.object_store import ObjectStorePool
+
+    a = ObjectStorePool(str(tmp_path), ttl_s=5.0)
+    b = ObjectStorePool(str(tmp_path), ttl_s=5.0)
+    arr = np.ones((2, 2), dtype=np.float32)
+    hashes = [(i << 64) | 0xF00D for i in range(1, 65)]
+    for h in hashes:
+        assert a.put(h, arr, arr)
+    assert sorted(b.keys()) == sorted(hashes)  # shared view, no handoff
+    out = {}
+    barrier = threading.Barrier(2)
+    future = _time.time() + 10.0
+
+    def sweep(name, pool):
+        barrier.wait()
+        out[name] = pool.sweep(now=future)
+
+    ta = threading.Thread(target=sweep, args=("a", a))
+    tb = threading.Thread(target=sweep, args=("b", b))
+    ta.start()
+    tb.start()
+    ta.join()
+    tb.join()
+    assert sorted(out["a"] + out["b"]) == sorted(hashes)
+    assert list(a.keys()) == []
+
+
+def test_object_store_residency_verdicts_drive_sweep(tmp_path):
+    """The lineage policy upgrades the blind TTL: hot renews past its
+    deadline, dead reaps ahead of it, None leaves the clock in charge."""
+    import time as _time
+
+    import numpy as np
+    from dynamo_tpu.kvbm.object_store import ObjectStorePool
+
+    pool = ObjectStorePool(str(tmp_path), ttl_s=5.0)
+    arr = np.ones((2, 2), dtype=np.float32)
+    hot, dead, young, old = [(i << 64) | i for i in range(1, 5)]
+    for h in (hot, dead, young, old):
+        pool.put(h, arr, arr)
+    # age the hot and old blobs past the TTL
+    stale = _time.time() - 6.0
+    os.utime(pool._path(hot), (stale, stale))
+    os.utime(pool._path(old), (stale, stale))
+    reaped = pool.sweep(residency={hot: "hot", dead: "dead"}.get)
+    # dead dies early, old dies by TTL; hot was renewed despite its age
+    assert set(reaped) == {dead, old}
+    assert hot in pool and young in pool
+    # the renewal restarted hot's TTL clock: a blind sweep keeps it
+    assert pool.sweep() == []
+
+
+def test_lineage_residency_from_ledger():
+    """LineageResidency verdicts straight from the ledger's books:
+    touched-recently => hot; parent gone from the books AND the shared
+    store => dead; roots, live parents, and unknown hashes => TTL."""
+    import time as _time
+
+    from dynamo_tpu.kvbm.residency import LineageResidency
+    from dynamo_tpu.obs.kv_ledger import KvLedger
+
+    led = KvLedger()
+    root, child, orphan = 101, 102, 103
+    led.alloc(1, "s", h=root)
+    led.commit(1, root, parent=None, seq="s")
+    led.alloc(2, "s", h=child)
+    led.commit(2, child, parent=root, seq="s")
+    led.alloc(3, "s", h=orphan)
+    led.commit(3, orphan, parent=999, seq="s")
+    # freshly committed: everything is hot (commit touches the hash)
+    res = LineageResidency(led)
+    assert res(child) == "hot" and res(orphan) == "hot"
+    # past the hot window the lineage verdicts take over
+    later = _time.monotonic() + 1000.0
+    res = LineageResidency(led, now=later)
+    assert res(root) is None        # lineage root: reachable by definition
+    assert res(child) is None       # parent resident in the books
+    assert res(orphan) == "dead"    # parent gone everywhere
+
+    class Store:  # parent alive only in the shared store itself
+        def __contains__(self, h):
+            return h == 999
+
+    assert LineageResidency(led, pool=Store(), now=later)(orphan) is None
+    # commit record never ran here: the policy must not guess
+    known, _ = led.lineage_parent(555)
+    assert not known
+    assert LineageResidency(led, now=later)(555) is None
+    assert LineageResidency(led, now=later).verdicts(
+        [root, child, orphan]) == {"hot": 0, "dead": 1, "ttl": 2}

@@ -126,3 +126,123 @@ async def test_engine_deterministic_with_seed():
     assert a == b
     assert a != c
     await eng.close()
+
+
+# ----------------------- tier sim (fleet prefix cache) -----------------------
+
+
+def test_cache_sim_demotion_chain_and_g4_onboard():
+    """G1 evictions walk the G2 host LRU into the shared store, emitting
+    the same per-tier event batches the real engine publishes; a later
+    admission onboards the whole run back instead of recomputing."""
+    from dynamo_tpu.mocker.kv_cache_sim import SimObjectStore
+    from dynamo_tpu.obs.kv_ledger import KvLedger
+
+    store = SimObjectStore()
+    led = KvLedger()
+    sim = KvCacheSim(num_blocks=8, ledger=led, host_blocks=2,
+                     object_store=store)
+    prefix = [1001, 1002, 1003, 1004]
+    res = sim.allocate("a", prefix, 4)
+    assert res.cached_blocks == 0 and res.onboarded == {}
+    sim.free("a")
+    # junk floods G1: the prefix demotes into the 2-slot host LRU, whose
+    # own overflow spills on into the shared store
+    res2 = sim.allocate("j", [2000 + i for i in range(8)], 8)
+    g2_stored = [h for st, _, t in res2.tier_events for h in st
+                 if t == "g2"]
+    g4_stored = [h for st, _, t in res2.tier_events for h in st
+                 if t == "g4"]
+    assert set(res2.removed) == set(prefix)
+    assert set(g2_stored) == set(prefix)  # every demotion hops through g2
+    assert g4_stored == [1001, 1002]      # LRU overflow spilled the oldest
+    assert sim.g2_blocks == 2 and 1001 in store
+    sim.free("j")
+    # the prefix comes back: onboarded (g2/g4 mix), not recomputed
+    res3 = sim.allocate("b", prefix, 4)
+    assert sum(res3.onboarded.values()) == 4
+    assert res3.cached_blocks == 4
+    assert led.onboard_counts() == dict(res3.onboarded)
+    # g4 blobs STAY in the shared store (fleet copy) after onboarding
+    assert 1001 in store and 1002 in store
+
+
+def test_cache_sim_g2_onboard_moves_host_copy():
+    from dynamo_tpu.mocker.kv_cache_sim import KvCacheSim
+
+    sim = KvCacheSim(num_blocks=4, host_blocks=4)
+    prefix = [11, 12]
+    sim.allocate("a", prefix, 2)
+    sim.free("a")
+    sim.allocate("j", [21, 22, 23, 24], 4)  # evicts the prefix into g2
+    assert sim.g2_blocks == 2
+    sim.free("j")
+    res = sim.allocate("b", prefix, 2)
+    assert res.onboarded == {"g2": 2}
+    g2_removed = [h for _, rm, t in res.tier_events for h in rm
+                  if t == "g2"]
+    # the host copy MOVES into G1 (slot freed), unlike the shared g4 blob
+    assert set(g2_removed) >= set(prefix)
+
+
+def test_cache_sim_onboard_run_breaks_at_miss():
+    """Prefix KV is position-addressed: a missing middle block ends the
+    onboardable run — later store-resident blocks must not count."""
+    from dynamo_tpu.mocker.kv_cache_sim import KvCacheSim, SimObjectStore
+
+    store = SimObjectStore()
+    store.put(31)
+    store.put(33)  # 32 missing: the run must break there
+    sim = KvCacheSim(num_blocks=8, object_store=store)
+    res = sim.allocate("a", [31, 32, 33], 3)
+    assert res.onboarded == {"g4": 1}
+    assert res.cached_blocks == 1
+
+
+def test_sim_object_store_sweep_verdicts():
+    """Same verdict ladder as ObjectStorePool.sweep: hot renews, dead
+    reaps early, None falls back to the TTL clock."""
+    import time
+
+    from dynamo_tpu.mocker.kv_cache_sim import SimObjectStore
+
+    store = SimObjectStore(ttl_s=10.0)
+    for h in (1, 2, 3):
+        store.put(h)
+    now = time.monotonic() + 20.0
+    reaped = store.sweep(now=now, residency={1: "hot", 2: "dead"}.get)
+    assert set(reaped) == {2, 3}  # dead early + TTL-expired
+    assert 1 in store and len(store) == 1
+    # the hot renewal restarted the clock...
+    assert store.sweep(now=now + 5.0) == []
+    # ...but without fresh traffic the TTL eventually wins
+    assert store.sweep(now=now + 50.0) == [1]
+
+
+async def test_engine_g4_onboarding_across_engines():
+    """Two simulated engines share one SimObjectStore (the shared-FS
+    mount analogue): engine A computes a prefix and churns it down to
+    G4; a COLD engine B serves the same prefix by onboarding — counted
+    in kv_onboard_g4, marked in its ledger, books still clean."""
+    from dynamo_tpu.mocker.kv_cache_sim import SimObjectStore
+
+    store = SimObjectStore()
+    a = MockEngine(make_args(num_blocks=8, host_blocks=2,
+                             object_store=store, kv_ledger=True))
+    prompt = list(range(16))  # 4 blocks of 4
+    async for _ in a.generate(req(prompt, max_tokens=2, seed=1)):
+        pass
+    for i in range(4):
+        junk = list(range(100 + 16 * i, 116 + 16 * i))
+        async for _ in a.generate(req(junk, max_tokens=2)):
+            pass
+    assert len(store) >= 4, "churn never reached the shared store"
+    b = MockEngine(make_args(num_blocks=16, host_blocks=2,
+                             object_store=store, kv_ledger=True))
+    async for _ in b.generate(req(prompt, max_tokens=2, seed=1)):
+        pass
+    assert b.metrics.get("kv_onboard_g4", 0) >= 4
+    assert b.kv_ledger.onboard_counts().get("g4", 0) >= 4
+    assert b.audit_kv()["clean"] and a.audit_kv()["clean"]
+    await a.close()
+    await b.close()

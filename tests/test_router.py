@@ -444,3 +444,161 @@ async def test_dp_ranks_are_distinct_routing_targets():
     await client.close()
     await w.close()
     await rt.shutdown()
+
+
+# ----------------------- fleet prefix cache: tiered index -----------------------
+
+
+def make_tiered_indexers():
+    from dynamo_tpu.router.tiered_index import TieredKvIndexer
+
+    return [TieredKvIndexer(base) for base in make_indexers()]
+
+
+def test_tiered_indexer_parity_on_tier_ingestion():
+    """Python- and C++-backed tiered indexers agree on randomized
+    PER-TIER event streams: the union view (base membership is derived
+    from local-tier residency) and the tiered overlap query both match,
+    so the py/native parity the classic tests pin carries over to the
+    fleet-prefix-cache ingestion path."""
+    idx = make_tiered_indexers()
+    assert len(idx) == 2, "native indexer missing"
+    rng = random.Random(7)
+    workers = [11, 22, 33]
+    universe = [H(i) for i in range(160)]
+    tiers = ["g1", "g1", "g2", "g3", "g4"]
+    for step in range(400):
+        op = rng.random()
+        w = rng.choice(workers)
+        tier = rng.choice(tiers)
+        if op < 0.55:
+            start = rng.randrange(0, 120)
+            chunk = universe[start:start + rng.randrange(1, 16)]
+            for ix in idx:
+                ix.apply_stored(w, chunk, tier=tier)
+        elif op < 0.85:
+            start = rng.randrange(0, 150)
+            chunk = universe[start:start + rng.randrange(1, 8)]
+            for ix in idx:
+                ix.apply_removed(w, chunk, tier=tier)
+        elif op < 0.95:
+            for ix in idx:
+                ix.remove_worker(w)
+        else:
+            for ix in idx:
+                ix.clear_worker(w)
+        if step % 10 == 0:
+            start = rng.randrange(0, 100)
+            q = universe[start:start + rng.randrange(1, 40)]
+            assert idx[0].find_matches(q) == idx[1].find_matches(q), \
+                f"union divergence at step {step}"
+            assert (idx[0].find_matches_tiered(q, workers)
+                    == idx[1].find_matches_tiered(q, workers)), \
+                f"tiered divergence at step {step}"
+    assert idx[0].g4_blocks == idx[1].g4_blocks
+
+
+def test_tiered_index_g4_scores_for_every_candidate():
+    """G4 ownership is fleet-wide: the shared store's blobs extend ANY
+    candidate's leading run, the sweeper need not be the spiller to
+    remove one, and blobs outlive their spiller (remove_worker) but not
+    a resync clear of the worker they are attributed to."""
+    from dynamo_tpu.router.tiered_index import TieredKvIndexer
+
+    ix = TieredKvIndexer(PyKvIndexer())
+    hs = [H(i) for i in range(5)]
+    ix.apply_stored(1, hs[:2], tier="g1")
+    ix.apply_stored(1, hs[:4], tier="g4")  # spilled copies of the head
+    m = ix.find_matches_tiered(hs, [1, 2, 3])
+    assert m[1] == {"g1": 2, "g4": 2}  # own g1 is the cheaper source
+    assert m[2] == {"g4": 4} and m[3] == {"g4": 4}
+    # the union view stays local-tiers-only: only the spiller appears
+    assert ix.find_matches(hs) == {1: 2}
+    # a sweeper that never stored the blob removes it fleet-wide
+    ix.apply_removed(99, [hs[2]], tier="g4")
+    assert ix.find_matches_tiered(hs, [2])[2] == {"g4": 2}
+    # the spiller dying keeps its G4 blobs onboardable...
+    ix.remove_worker(1)
+    assert ix.find_matches_tiered(hs, [2])[2] == {"g4": 2}
+    # ...but a resync clear drops the worker's attributed blobs
+    ix2 = TieredKvIndexer(PyKvIndexer())
+    ix2.apply_stored(1, hs[:4], tier="g4")
+    ix2.clear_worker(1)
+    assert ix2.g4_blocks == 0
+    assert ix2.find_matches_tiered(hs, [2]) == {}
+
+
+def test_spilled_block_no_longer_free_g1_hit():
+    """Regression for the tier-blind overlap inflation: a block the
+    worker offloaded out of HBM used to keep scoring as a FREE G1 hit
+    for its spiller (the union index never saw the demotion, so routing
+    chased overlap that would be re-onboarded at real cost).  With
+    per-tier events it must downgrade to a priced g4 hit, and the
+    selector must prefer genuine HBM residency on another worker."""
+    from dynamo_tpu.router.tiered_index import TieredKvIndexer
+
+    ix = TieredKvIndexer(PyKvIndexer())
+    hs = [H(i) for i in range(8)]
+    # worker 1 computed the prefix, then demoted all of it down to G4
+    ix.apply_stored(1, hs, tier="g1")
+    ix.apply_stored(1, hs, tier="g4")
+    ix.apply_removed(1, hs, tier="g1")
+    # worker 2 holds the same prefix hot in HBM
+    ix.apply_stored(2, hs, tier="g1")
+    tiers = ix.find_matches_tiered(hs, [1, 2])
+    assert tiers[1] == {"g4": 8}, "spilled run still counted as g1"
+    assert tiers[2] == {"g1": 8}
+    sel = DefaultWorkerSelector(KvRouterConfig(temperature=0.0, seed=0))
+    states = {1: WorkerState(), 2: WorkerState()}
+    overlaps = {w: sum(c.values()) for w, c in tiers.items()}
+    assert sel.select([1, 2], 8, overlaps, states,
+                      tier_overlaps=tiers) == 2
+
+
+def test_selector_tier_pricing():
+    import pytest as _pytest
+
+    sel = DefaultWorkerSelector(KvRouterConfig(temperature=0.0, seed=0))
+    tiers = {1: {"g4": 8}, 2: {"g1": 8}}
+    states = {1: WorkerState(), 2: WorkerState()}
+    choice, logits = sel.select_verbose([1, 2], 10, {}, states,
+                                        tier_overlaps=tiers)
+    assert choice == 2
+    assert logits[2] == _pytest.approx(2.0)  # pure-g1 = classic formula
+    assert logits[1] == _pytest.approx(2 + 8 * 0.7)  # default g4 cost
+    # measured tier costs from load_metrics override the defaults
+    states[1].tier_costs = {"g4": 0.05}
+    _, logits = sel.select_verbose([1, 2], 10, {}, states,
+                                   tier_overlaps=tiers)
+    assert logits[1] == _pytest.approx(2 + 8 * 0.05)
+    # cheap-enough onboarding beats a busier g1 holder
+    states[2].active_blocks = 10
+    assert sel.select([1, 2], 10, {}, states, tier_overlaps=tiers) == 1
+    # onboarding is never priced above recompute (cap at 1.0)
+    states[1].tier_costs = {"g4": 9.0}
+    _, logits = sel.select_verbose([1, 2], 10, {}, states,
+                                   tier_overlaps=tiers)
+    assert logits[1] == _pytest.approx(2 + 8 * 1.0)
+
+
+def test_compute_tier_costs_roofline():
+    import pytest as _pytest
+
+    from dynamo_tpu.router.tiered_index import (
+        DEFAULT_TIER_COSTS,
+        compute_tier_costs,
+    )
+
+    # recompute_s = 16 tok * 2e9 flop/tok / 1e12 flop/s = 32 ms/block;
+    # a 32 MB block over a 1 GB/s shared FS is ALSO 32 ms -> cost 1.0
+    costs = compute_tier_costs(prefill_flops_per_s=1e12,
+                               flops_per_token=2e9,
+                               bytes_per_block=32e6, block_tokens=16,
+                               tier_bw={"g4": 1e9})
+    assert costs["g1"] == 0.0
+    assert costs["g4"] == _pytest.approx(1.0, abs=0.01)
+    # g2 at the default 8 GB/s staging rate: 4 ms onboard -> 0.125
+    assert costs["g2"] == _pytest.approx(0.125, abs=0.01)
+    # unmeasured chip rate falls back to the static defaults
+    assert compute_tier_costs(None, 2e9, 32e6, 16) == DEFAULT_TIER_COSTS
+    assert compute_tier_costs(0.0, 2e9, 32e6, 16) == DEFAULT_TIER_COSTS
