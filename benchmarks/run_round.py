@@ -1,12 +1,10 @@
 """Bench round driver: one command cashes in a whole round.
 
-Round r06 closes the loop the r04/r05 forensics opened: the packed
-Pallas prefill kernel now chains its chunk DMAs across tile/segment
-boundaries (ops/pallas_packed_prefill.py), decode can stream the final
-projection through the fused sampling epilogue (ops/fused_sampling.py),
-and this driver runs the three benches that measure both — in one shot,
-with the round's acceptance gates evaluated from the benches' own JSON
-lines:
+Round r07 hardens the cache fabric r06 built: every persisted/
+transferred KV block now carries a crc32 footer, checksum failures
+quarantine the blob and fall back to recompute, and per-tier circuit
+breakers bound how much a failing shared mount can cost.  The kernel/
+serving benches carry over from r06:
 
   prefill   bench_prefill_phases.py --impl ab packed
             gate[tpu]: packed-Pallas est MFU >= 0.4
@@ -16,13 +14,15 @@ lines:
             gate[tpu]: zero mid-serving compiles
             (dynamo_engine_serving_compiles_total stays 0)
 
-plus the benches that emit their own gated r06 line, adopted verbatim
-(indexer, global_router, prefix_fleet — the fleet-prefix-cache
-cold-start A/B added with the tiered index work).
+plus the benches that emit their own gated line, adopted verbatim
+(indexer, global_router, prefix_fleet, and — new this round —
+chaos_cache, the KV-integrity A/B: byte-identical serving under
+injected G4 corruption + stalls, every corruption attributed in the
+ledger, breaker tripped, p90 TTFT bounded by recompute).
 
 Each bench contributes ONE summary JSON line to stdout:
 
-  {"bench": ..., "round": "r06", "mode": "smoke"|"tpu",
+  {"bench": ..., "round": "r07", "mode": "smoke"|"tpu",
    "gates": [{"name", "target", "value", "status"}...], "result": {...}}
 
 Off-TPU every bench still runs end to end at smoke scale (tiny model,
@@ -43,7 +43,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH_DIR = os.path.join(REPO, "benchmarks")
 
-ROUND = "r06"
+ROUND = "r07"
 TARGET_PREFILL_MFU = 0.4
 
 # per-bench argv at each scale: smoke keeps every bench CPU-runnable
@@ -83,6 +83,11 @@ BENCH_ARGS = {
     },
     "prefix_fleet": {
         "script": "bench_prefix_fleet.py",
+        "smoke": ["--mode", "smoke"],
+        "tpu": ["--mode", "tpu"],
+    },
+    "chaos_cache": {
+        "script": "bench_chaos_cache.py",
         "smoke": ["--mode", "smoke"],
         "tpu": ["--mode", "tpu"],
     },
@@ -172,9 +177,10 @@ def eval_serving(lines, enforced):
 
 
 def eval_gated_line(bench_name):
-    """Benches that emit their own r06 gated line (indexer,
-    global_router): adopt their gates verbatim — enforcement already
-    followed the --mode flag the driver passed down."""
+    """Benches that emit their own gated line (indexer, global_router,
+    prefix_fleet, chaos_cache): adopt their gates verbatim —
+    enforcement already followed the --mode flag the driver passed
+    down."""
     def _eval(lines, enforced):
         row = next((l for l in lines if l.get("bench") == bench_name),
                    None)
@@ -189,7 +195,8 @@ EVALS = {"prefill": eval_prefill, "kv_quant": eval_kv_quant,
          "serving": eval_serving,
          "indexer": eval_gated_line("indexer"),
          "global_router": eval_gated_line("global_router"),
-         "prefix_fleet": eval_gated_line("prefix_fleet")}
+         "prefix_fleet": eval_gated_line("prefix_fleet"),
+         "chaos_cache": eval_gated_line("chaos_cache")}
 
 
 def main() -> int:

@@ -53,7 +53,17 @@ Seam registry (name — wired at — supported actions):
                            tiers (fail = pull failure partway through
                            the sequence, delay = slow peer)
   kvbm.remote_pull         RemoteKvbmPuller.fetch_run, per peer pull
-                           (fail, delay)
+                           (fail, delay, corrupt = flip a byte in the
+                           frame payload before decode — the wire
+                           checksum must catch it and mark the source
+                           suspect)
+  kvbm.object_io           ObjectStorePool get/put (kvbm/object_store.py,
+                           on the G4 I/O thread) and SimObjectStore
+                           lookups (mocker/kv_cache_sim.py), per op.
+                           corrupt = payload bytes differ from the
+                           committed crc32 → quarantine; stall = hung
+                           shared mount → the caller's deadline +
+                           tier breaker; fail = I/O error
   engine.step              JaxEngine._sched_step / MockEngine._step,
                            per scheduler step (fail = crash on step N,
                            wedge = stop stepping)
@@ -98,9 +108,14 @@ from typing import Dict, List, Optional
 logger = logging.getLogger(__name__)
 
 # actions a rule may carry; "drop"/"truncate" are interpreted by the
-# call site (only the frame seam understands them), the rest are
-# executed by hit()/ahit() themselves
-ACTIONS = ("fail", "delay", "wedge", "drop", "truncate")
+# call site (only the frame seam understands them), and so are
+# "corrupt" (the site tampers the bytes it just read, so the integrity
+# checksum — not the injector — is what catches the fault) and "stall"
+# (the site decides between really sleeping on its I/O thread and
+# charging its deadline, so an event-loop site never blocks the loop);
+# the rest are executed by hit()/ahit() themselves
+ACTIONS = ("fail", "delay", "wedge", "drop", "truncate", "corrupt",
+           "stall")
 
 # THE canonical seam registry: every hit()/ahit() call site names one of
 # these, ChaosPlane.rule() rejects anything else, and the DYN006 lint
@@ -117,6 +132,7 @@ SEAMS = frozenset({
     "discovery.lease",
     "disagg.pull.chunk",
     "kvbm.remote_pull",
+    "kvbm.object_io",
     "engine.step",
     "engine.kv_account",
     "planner.scale",

@@ -195,7 +195,23 @@ def encode_chunk_frame(b0: int, kb: np.ndarray, vb: np.ndarray,
     if ksb is not None:
         frame["ks"] = np.ascontiguousarray(ksb).tobytes()
         frame["vs"] = np.ascontiguousarray(vsb).tobytes()
+    frame["crc"] = _frame_crc(frame)
     return frame
+
+
+def _frame_crc(frame: Dict[str, Any]) -> int:
+    """crc32 over the frame's payload byte members in canonical order,
+    seeded with (block_start, block_count) so a frame spliced onto the
+    wrong block range fails verification too."""
+    import zlib
+
+    crc = zlib.crc32(
+        f"{int(frame['block_start'])}:{int(frame['block_count'])}"
+        .encode())
+    for name in ("k", "v", "ks", "vs"):
+        if name in frame:
+            crc = zlib.crc32(frame[name], crc)
+    return crc & 0xFFFFFFFF
 
 
 def decode_chunk_frame(
@@ -211,6 +227,13 @@ def decode_chunk_frame(
     if not (0 <= b0 and n >= 1 and b0 + n <= layout.num_blocks):
         raise ValueError(f"chunk out of bounds: blocks=[{b0},{b0 + n}) of "
                          f"{layout.num_blocks}")
+    if "crc" in frame and _frame_crc(frame) != int(frame["crc"]):
+        # same failure family as every other malformed frame — the
+        # caller's existing local-prefill fallback handles it (a frame
+        # without a crc is an unupgraded sender and passes)
+        raise ValueError(
+            f"chunk frame for blocks [{b0},{b0 + n}) failed its crc32 "
+            "footer")
     dt = _np_dtype(layout.dtype)
     lo = layout
     kb = np.frombuffer(frame["k"], dtype=dt).reshape(

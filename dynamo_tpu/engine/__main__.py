@@ -106,6 +106,17 @@ def build_args() -> argparse.ArgumentParser:
                    default=os.environ.get("DYN_KVBM_OBJECT_DIR", ""),
                    help="G4 cluster-shared object store (shared FS path; "
                         "defaults to $DYN_KVBM_OBJECT_DIR)")
+    p.add_argument("--kv-io-deadline-s", type=float, default=0.25,
+                   help="per-op deadline for shared-FS (G4) KV I/O on the "
+                        "dedicated I/O thread; a wedged mount is a bounded "
+                        "timeout off the scheduler path")
+    p.add_argument("--kv-breaker-threshold", type=int, default=3,
+                   help="consecutive tier failures that trip the tier's "
+                        "circuit breaker open (tier skipped and priced at "
+                        "recompute until a half-open probe succeeds)")
+    p.add_argument("--kv-breaker-cooldown-s", type=float, default=30.0,
+                   help="seconds an open tier breaker waits before "
+                        "admitting one half-open probe op")
     p.add_argument("--no-kvbm-remote", action="store_true",
                    help="disable cross-worker G2 pull")
     p.add_argument("--migration-limit", type=int, default=3)
@@ -184,6 +195,9 @@ async def main() -> None:
         disk_cache_dir=args.disk_cache_dir or None,
         disk_cache_blocks=args.disk_cache_blocks,
         object_store_dir=args.object_store_dir or None,
+        kv_io_deadline_s=args.kv_io_deadline_s,
+        kv_breaker_threshold=args.kv_breaker_threshold,
+        kv_breaker_cooldown_s=args.kv_breaker_cooldown_s,
         kvbm_remote=not args.no_kvbm_remote,
         role=args.role,
         warmup=not args.no_warmup,

@@ -219,6 +219,15 @@ class EngineConfig:
     kvbm_remote_max_blocks: int = 64
     offload_watermark_blocks: int = 0      # 0 = num_blocks // 4
     offload_batch: int = 16                # max blocks gathered per step
+    # KV integrity / degraded modes (kvbm/object_io.py, kvbm/breaker.py):
+    # every G4 op the serving path issues is awaited at most
+    # kv_io_deadline_s on a dedicated I/O thread; kv_breaker_threshold
+    # consecutive per-tier failures trip that tier's circuit breaker
+    # open (priced as recompute in the advertised kv_tier_costs) until a
+    # half-open probe succeeds after kv_breaker_cooldown_s
+    kv_io_deadline_s: float = 0.25
+    kv_breaker_threshold: int = 3
+    kv_breaker_cooldown_s: float = 30.0
 
     # disagg KV transfer: bound on one wire frame's K+V payload bytes
     # (disagg/transfer.py chunk sizing)

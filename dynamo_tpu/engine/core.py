@@ -325,7 +325,16 @@ class JaxEngine:
                 disk_blocks=config.disk_cache_blocks,
                 object_dir=config.object_store_dir,
                 object_ttl_s=config.object_store_ttl_s,
+                io_deadline_s=config.kv_io_deadline_s,
+                breaker_threshold=config.kv_breaker_threshold,
+                breaker_cooldown_s=config.kv_breaker_cooldown_s,
             )
+            self.kvbm.on_corruption = self._note_kv_corruption
+        # (tier, action) -> count for
+        # dynamo_kv_integrity_failures_total; quarantines land here via
+        # _note_kv_corruption (g3/g4/remote/disagg), timeouts/errors are
+        # merged in from the manager's I/O stats at export time
+        self.kv_integrity: Dict[Tuple[str, str], int] = {}
         # cross-worker G2 pull (kvbm/remote.py): installed by the worker;
         # async callable(hashes) -> [(h, k, v), ...]
         self.remote_kvbm_fetch = None
@@ -1235,6 +1244,10 @@ class JaxEngine:
         the blob."""
         if self.kvbm is None or self.kvbm.g4 is None:
             return 0
+        if self.kvbm.breaker.state("g4") == "open":
+            # the tier is dark: hammering a dead mount from the sweep
+            # only delays the half-open probe's clean read
+            return 0
         from ..kvbm.residency import LineageResidency
 
         res = LineageResidency(self.kv_ledger, pool=self.kvbm.g4)
@@ -1250,6 +1263,35 @@ class JaxEngine:
 
             await self._call_on_scheduler(emit)
         return len(swept)
+
+    # -- KV integrity (checksummed cache fabric) ---------------------------
+    def _note_kv_corruption(self, tier: str, h: Optional[int]) -> None:
+        """One checksum-failed consume anywhere in the fabric (G3 pool,
+        G4 object store, remote pull, disagg frame): count it for
+        dynamo_kv_integrity_failures_total{tier,action="quarantine"} and
+        attribute it in the KV ledger (violation kind `corrupt`, flight
+        snapshot on each tier's first).  The caller already quarantined
+        the bytes and degraded to a miss — serving falls back to
+        recompute with byte-identical output, so this hook is purely
+        forensic and must never raise."""
+        try:
+            key = (tier, "quarantine")
+            self.kv_integrity[key] = self.kv_integrity.get(key, 0) + 1
+            if self.kv_ledger is not None:
+                self.kv_ledger.corruption(tier, h)
+        except Exception:
+            logger.warning("kv corruption attribution failed",
+                           exc_info=True)
+
+    def kv_integrity_counters(self) -> Dict[Tuple[str, str], int]:
+        """(tier, action) -> count rows for the integrity-failure
+        counter: quarantines recorded here + the KVBM manager's I/O
+        timeouts/errors."""
+        out = dict(self.kv_integrity)
+        if self.kvbm is not None:
+            for k, v in self.kvbm.io_failure_counters().items():
+                out[k] = out.get(k, 0) + v
+        return out
 
     # -- KV ledger audit (obs/kv_ledger.py) --------------------------------
     def _audit_ledger_locked(self, where: str = "step") -> dict:

@@ -409,10 +409,15 @@ class JaxEngineWorker:
                 rt, self.namespace, self.component, instance_id).start()
             self._kvbm_pull_client = await (
                 comp.endpoint("kvbm_pull").client().start())
-            self.engine.remote_kvbm_fetch = RemoteKvbmPuller(
+            puller = RemoteKvbmPuller(
                 self._kvbm_index, self._kvbm_pull_client,
                 max_blocks=self.config.kvbm_remote_max_blocks,
-            ).fetch_run
+            )
+            # corrupt pulled frames attribute like every other tier's
+            # corruptions (ledger kind `corrupt`, tier="remote") and the
+            # index marks the serving peer suspect
+            puller.on_corruption = self.engine._note_kv_corruption
+            self.engine.remote_kvbm_fetch = puller.fetch_run
         if self.engine.supports_embedding:
             # embed rides the step broadcast like every other collective
             # program, so multi-host slices serve it too
@@ -479,7 +484,18 @@ class JaxEngineWorker:
         audit = await eng.audit_kv()
         out = {**base, **eng.kv_ledger.dump(), "audit": audit,
                "kv": eng.kv_occupancy()}
-        if eng.kvbm is not None and eng.kvbm.g4 is not None:
+        if eng.kvbm is not None:
+            # degraded-mode picture: breaker state per tier + the
+            # manager's I/O/quarantine counters (obs/fleet.py folds
+            # tier_state across workers into the fleet summary)
+            out["tier_state"] = eng.kvbm.tier_states()
+            out["kvbm_stats"] = dict(eng.kvbm.stats)
+            out["integrity"] = {
+                f"{tier}:{action}": n
+                for (tier, action), n in
+                eng.kv_integrity_counters().items()}
+        if (eng.kvbm is not None and eng.kvbm.g4 is not None
+                and eng.kvbm.breaker.state("g4") != "open"):
             # G4 residency picture: blob count + this worker's lineage
             # verdicts over a bounded sample (the sweep applies the same
             # policy; here it's read-only for the fleet aggregator)
@@ -772,6 +788,27 @@ class JaxEngineWorker:
                     flops_per_token=flops_rate / tok_rate,
                     bytes_per_block=self.engine.kv_block_bytes(),
                     block_tokens=self.config.block_size)
+            # degraded-mode plane: fold circuit-breaker states into the
+            # advertised costs (a non-closed tier is priced AT recompute
+            # so the selector stops steering traffic toward its blocks)
+            # and export the breaker + integrity-failure gauges
+            if self.engine.kvbm is not None:
+                from ..kvbm import breaker as kvbm_breaker
+                from ..router.tiered_index import degraded_tier_costs
+
+                states = self.engine.kvbm.tier_states()
+                tier_costs = degraded_tier_costs(tier_costs, states)
+                for tier, st in states.items():
+                    m.set("dynamo_kvbm_tier_state",
+                          float(kvbm_breaker.NUMERIC.get(st, 0)),
+                          "KV tier circuit-breaker state "
+                          "(0=closed, 1=half_open, 2=open)", tier=tier)
+            for (tier, action), n in \
+                    self.engine.kv_integrity_counters().items():
+                m.set("dynamo_kv_integrity_failures_total", float(n),
+                      "checksum quarantines and deadline/breaker I/O "
+                      "failures across the KV cache fabric",
+                      tier=tier, action=action)
             # lineage-driven G4 GC on a slow cadence (~30s): the shared
             # store is swept by every mounted worker; hot lineages get
             # their TTL renewed, dead ones reap early

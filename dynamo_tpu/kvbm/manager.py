@@ -23,10 +23,11 @@ from __future__ import annotations
 
 import logging
 from collections import OrderedDict
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .breaker import TierBreaker
 from .pools import Block, DiskBlockPool, HostBlockPool
 
 logger = logging.getLogger(__name__)
@@ -50,18 +51,37 @@ class _OffloadSkip:
 class TieredKvManager:
     def __init__(self, host_blocks: int, disk_dir: Optional[str] = None,
                  disk_blocks: int = 0, object_dir: Optional[str] = None,
-                 object_ttl_s: Optional[float] = None):
+                 object_ttl_s: Optional[float] = None,
+                 io_deadline_s: float = 0.25,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 30.0):
+        from .object_io import ObjectIO
         from .object_store import ObjectStorePool
 
         self.g2 = HostBlockPool(host_blocks)
         self.g3 = (DiskBlockPool(disk_dir, disk_blocks)
                    if disk_dir and disk_blocks > 0 else None)
         # G4: cluster-shared content-addressed store; receives what the
-        # local tier ladder would otherwise drop (object_store.py)
+        # local tier ladder would otherwise drop (object_store.py).  All
+        # serving-path access goes through the ObjectIO thread so every
+        # shared-FS touch is deadline-bounded off the scheduler.
         self.g4 = (ObjectStorePool(object_dir, ttl_s=object_ttl_s)
                    if object_dir else None)
+        self._io = (ObjectIO(self.g4, deadline_s=io_deadline_s)
+                    if self.g4 is not None else None)
+        self.breaker = TierBreaker(
+            ("g3", "g4"), threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s)
         self.stats = {"offloaded": 0, "onboarded": 0, "demoted": 0,
                       "dropped": 0, "disk_hits": 0}
+        # attribution hook the engine installs: (tier, hash) per
+        # checksum-failed consume — feeds the KV ledger's `corrupt`
+        # violation kind + dynamo_kv_integrity_failures_total
+        self.on_corruption: Optional[Callable[[str, int], None]] = None
+        if self.g3 is not None:
+            self.g3.on_corruption = \
+                lambda h: self._note_corruption("g3", h)
+            self.g3.on_io_error = self._g3_io_error
         # cooldown FIFO of capacity-dropped hashes; bounded so entries age
         # out as churn elsewhere produces new drops
         self._dropped: "OrderedDict[int, None]" = OrderedDict()
@@ -73,6 +93,38 @@ class TieredKvManager:
         an in-process successor engine can take over the cache dir)."""
         if self.g3 is not None:
             self.g3.close()
+        if self._io is not None:
+            self._io.close()
+
+    def _note_corruption(self, tier: str, h: int) -> None:
+        key = f"{tier}_quarantined"
+        self.stats[key] = self.stats.get(key, 0) + 1
+        if self.on_corruption is not None:
+            self.on_corruption(tier, h)
+
+    def _g3_io_error(self) -> None:
+        self.stats["g3_io_errors"] = self.stats.get("g3_io_errors", 0) + 1
+        self.breaker.record_failure("g3")
+
+    def _g4_failed(self, status: str) -> None:
+        """Fold one failed ObjectIO op into the breaker + stats."""
+        key = f"g4_{'timeouts' if status == 'timeout' else 'io_errors'}"
+        self.stats[key] = self.stats.get(key, 0) + 1
+        self.breaker.record_failure("g4")
+
+    def tier_states(self) -> Dict[str, str]:
+        """Breaker state per breakable tier — /debug/kv + fleet fold."""
+        return self.breaker.states()
+
+    def io_failure_counters(self) -> Dict[Tuple[str, str], int]:
+        """(tier, action) -> count rows for
+        dynamo_kv_integrity_failures_total (quarantine rows are kept by
+        the engine, which sees every tier's corruptions including
+        remote pulls)."""
+        rows = {("g4", "timeout"): self.stats.get("g4_timeouts", 0),
+                ("g4", "error"): self.stats.get("g4_io_errors", 0),
+                ("g3", "error"): self.stats.get("g3_io_errors", 0)}
+        return {k: v for k, v in rows.items() if v}
 
     def occupancy(self) -> dict:
         """Per-tier block occupancy for /metrics gauges (the engine's
@@ -87,11 +139,10 @@ class TieredKvManager:
             out["g3"] = {"used": len(self.g3),
                          "capacity": self.g3.capacity,
                          "free": max(0, self.g3.capacity - len(self.g3))}
-        if self.g4 is not None:
-            try:
-                out["g4"] = {"used": sum(1 for _ in self.g4.keys())}
-            except OSError:
-                pass  # shared dir raced a sweep; next tick reads it
+        if self._io is not None:
+            # bounded count through the I/O thread: a dark mount
+            # degrades to the last observed count, never a stuck gauge
+            out["g4"] = {"used": self._io.count()}
         return out
 
     def manifest(self) -> dict:
@@ -113,8 +164,26 @@ class TieredKvManager:
             self._dropped.popitem(last=False)
 
     def __contains__(self, h: int) -> bool:
-        return (h in self.g2 or (self.g3 is not None and h in self.g3)
-                or (self.g4 is not None and h in self.g4))
+        """Tier membership as admission sees it.  G2/G3 are in-memory
+        book checks; G4 is one deadline-bounded stat on the I/O thread —
+        and a tier whose breaker is open reports nothing, so match_run
+        never promises blocks fetch() would refuse to read."""
+        if h in self.g2:
+            return True
+        if (self.g3 is not None and h in self.g3
+                and self.breaker.state("g3") != "open"):
+            return True
+        return self._g4_contains(h)
+
+    def _g4_contains(self, h: int) -> bool:
+        if self._io is None or not self.breaker.allow("g4"):
+            return False
+        st = self._io.contains(h)
+        if st in ("hit", "miss"):
+            self.breaker.record_ok("g4")
+            return st == "hit"
+        self._g4_failed(st)
+        return False
 
     def offload(self, h: int, *arrays: np.ndarray) -> TierEvents:
         """Place one block into G2 ((k, v), or (k, v, ks, vs) for an int8
@@ -132,17 +201,28 @@ class TieredKvManager:
         store.  G4 events are still published per-worker — the
         consolidator nets them, and the router keeps seeing the prefix as
         onboardable somewhere."""
-        if self.g4 is not None and blk is not None:
-            if self.g4.put(h, *blk):
+        if (self._io is not None and blk is not None
+                and self.breaker.allow("g4")):
+            st = self._io.put(h, blk)
+            if st == "stored":
+                self.breaker.record_ok("g4")
                 self.stats["g4_spilled"] = self.stats.get("g4_spilled", 0) + 1
                 return [([h], [], "g4")]
-            return []  # already in G4 (same content by construction)
+            if st == "exists":
+                self.breaker.record_ok("g4")
+                return []  # already in G4 (same content by construction)
+            # timeout/error: the op may still land late on the I/O
+            # thread, but we publish nothing — an unadvertised blob is
+            # just a future re-spill or TTL reap, both safe
+            self._g4_failed(st)
         self.stats["dropped"] += 1
         self._mark_dropped(h)
         return []
 
     def _demote(self, h: int, blk: Block) -> TierEvents:
-        if self.g3 is None:
+        if self.g3 is None or not self.breaker.allow("g3"):
+            # no G3, or its breaker is open (dying disk): skip straight
+            # to the G4 spill / drop — degrade, don't wedge on writes
             events = self._spill_to_g4(h, blk)
             events.append(([], [h], "g2"))
             return events
@@ -151,6 +231,13 @@ class TieredKvManager:
             dropped = self.g3.put_with_victims(h, *blk)
         else:
             dropped = [(old, None) for old in self.g3.put(h, *blk)]
+        if h not in self.g3:
+            # the write failed (pool dropped it + fed the breaker):
+            # fall through to the G4 spill so the bytes still land somewhere
+            events = self._spill_to_g4(h, blk)
+            events.append(([], [h], "g2"))
+            return events
+        self.breaker.record_ok("g3")
         # one batch carries one tier: g3 store first, then the g2 removal,
         # so the consolidator never sees the block tierless in between
         events: TierEvents = [([h], [], "g3"), ([], [h], "g2")]
@@ -160,7 +247,8 @@ class TieredKvManager:
         return events
 
     def match_run(self, hashes: Sequence[int]) -> int:
-        """Longest leading run of hashes held in G2∪G3."""
+        """Longest leading run of hashes onboardable right now (G2∪G3∪G4,
+        minus any tier whose circuit breaker is open)."""
         n = 0
         for h in hashes:
             if h not in self:
@@ -181,26 +269,45 @@ class TieredKvManager:
         blk = self.g2.get(h)
         src: Optional[str] = "g2" if blk is not None else None
         events: TierEvents = []
-        if blk is None and self.g3 is not None:
+        if (blk is None and self.g3 is not None
+                and self.breaker.allow("g3")):
             was_held = h in self.g3
             blk = self.g3.get(h)
             if blk is not None:
                 src = "g3"
+                self.breaker.record_ok("g3")
                 self.stats["disk_hits"] += 1
                 events.append(([h], [], "g2"))
                 for victim_h, victim in self.g2.put(h, *blk):
                     events.extend(self._demote(victim_h, victim))
             elif was_held:
+                # unreadable or quarantined (the pool already attributed
+                # a corruption); either way the router must see it gone
                 events.append(([], [h], "g3"))
-        if blk is None and self.g4 is not None:
-            blk = self.g4.get(h)
-            if blk is not None:
+        if (blk is None and self._io is not None
+                and self.breaker.allow("g4")):
+            st, got = self._io.get(h)
+            if st == "hit":
+                self.breaker.record_ok("g4")
                 # promote into G2 (the blob stays in G4 — it's shared)
+                blk = got
                 src = "g4"
                 self.stats["g4_hits"] = self.stats.get("g4_hits", 0) + 1
                 events.append(([h], [], "g2"))
                 for victim_h, victim in self.g2.put(h, *blk):
                     events.extend(self._demote(victim_h, victim))
+            elif st == "miss":
+                self.breaker.record_ok("g4")
+            elif st == "corrupt":
+                # the pool already deleted the blob; the mount itself is
+                # healthy (we got bytes, just wrong ones) so the breaker
+                # is NOT fed — publish removed(g4) fleet-wide and
+                # attribute the corruption; the caller recomputes
+                self.breaker.record_ok("g4")
+                events.append(([], [h], "g4"))
+                self._note_corruption("g4", h)
+            else:
+                self._g4_failed(st)
         if blk is None:
             return None, events, None
         self.stats["onboarded"] += 1

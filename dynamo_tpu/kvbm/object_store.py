@@ -22,16 +22,38 @@ import logging
 import os
 import secrets
 import time
+import zipfile
 from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
 
-from .pools import _load_block, _save_block
+from .. import chaos
+from .pools import (BlockIntegrityError, _save_block, read_block_file,
+                    verify_block)
 
 logger = logging.getLogger(__name__)
 
 # (k, v) — plus (k_scale, v_scale) for int8-quantized blocks (quant/kv.py)
 Block = Tuple[np.ndarray, ...]
+
+# how long an injected "stall" action wedges the calling thread — long
+# enough to blow any sane ObjectIO deadline (the point: prove the
+# scheduler never waits this out), short enough that the daemon I/O
+# thread unwedges within a test run.  Tests monkeypatch it down.
+_STALL_S = 30.0
+
+# orphaned-tmp grace when the pool has no TTL: a *.tmp blob older than
+# this was abandoned mid-put (crashed writer, non-OSError failure on a
+# pre-hardening version) and is reaped by sweep()
+_TMP_TTL_S = 3600.0
+
+
+def _tamper(blk: Block) -> Block:
+    """Flip one byte of the first member (chaos "corrupt" action): the
+    crc32 verification — not the injector — must catch it."""
+    a = blk[0].copy()
+    a.view(np.uint8).reshape(-1)[0] ^= 0xFF
+    return (a,) + tuple(blk[1:])
 
 
 class ObjectStorePool:
@@ -62,6 +84,9 @@ class ObjectStorePool:
     def put(self, h: int, *arrays: np.ndarray) -> bool:
         """Atomic write; returns False if the blob already existed (same
         content by construction — PLH keys commit to the payload)."""
+        act = chaos.hit("kvbm.object_io", key=f"put:{int(h):032x}")
+        if act == "stall":
+            time.sleep(_STALL_S)
         p = self._path(h)
         if os.path.isfile(p):
             return False
@@ -75,19 +100,80 @@ class ObjectStorePool:
             os.replace(tmp, p)
         except OSError:
             logger.warning("G4 put failed for %032x", h, exc_info=True)
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+            self._reap_tmp(tmp)
             return False
+        except BaseException:
+            # ANY other failure (bad payload TypeError, interrupt, ...)
+            # must still reap the tmp blob — an orphan on the shared
+            # volume is every client's problem, and sweep() only ages
+            # them out after a whole TTL
+            self._reap_tmp(tmp)
+            raise
         return True
 
-    def get(self, h: int) -> Optional[Block]:
+    def _reap_tmp(self, tmp: str) -> None:
         try:
-            with np.load(self._path(h)) as z:
-                return _load_block(z)
-        except (OSError, KeyError, ValueError, TypeError, AttributeError):
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+    def get(self, h: int) -> Optional[Block]:
+        """One verified read.  Returns the block or None (miss).  A blob
+        whose payload fails its crc32 footer is deleted (quarantined at
+        the source, fleet-wide) before BlockIntegrityError is raised —
+        the caller attributes the event and degrades to a miss.  Legacy
+        unchecksummed blobs are read once, verified-by-construction
+        (nothing to verify) and re-stamped with the footer in place — or
+        reaped when the re-stamp cannot land."""
+        act = chaos.hit("kvbm.object_io", key=f"get:{int(h):032x}")
+        if act == "stall":
+            time.sleep(_STALL_S)
+        p = self._path(h)
+        try:
+            blk, crc = read_block_file(p)
+        except (OSError, KeyError, ValueError, TypeError, AttributeError,
+                zipfile.BadZipFile):
             return None  # concurrent GC / torn write: treat as miss
+        if act == "corrupt" and blk:
+            blk = _tamper(blk)
+        try:
+            verify_block(blk, crc)
+        except BlockIntegrityError:
+            self.quarantine(h)
+            raise BlockIntegrityError(
+                f"G4 blob {int(h):032x} failed its crc32 footer; "
+                "quarantined")
+        if crc is None:
+            self._restamp(h, blk)
+        return blk
+
+    def quarantine(self, h: int) -> bool:
+        """Delete a blob that failed verification: the shared namespace
+        must never serve it again (every consumer would fail the same
+        way — and a fresh spill from any worker re-creates it clean)."""
+        try:
+            os.unlink(self._path(h))
+            return True
+        except OSError:
+            return False
+
+    def _restamp(self, h: int, blk: Block) -> None:
+        """Rewrite a legacy blob with the checksum footer (atomic, same
+        tmp+rename as put).  If the rewrite cannot land, reap the blob:
+        a blob that can never be verified must not sit in the shared
+        namespace forever."""
+        p = self._path(h)
+        tmp = f"{p}.tmp{secrets.token_hex(4)}"
+        try:
+            with open(tmp, "wb") as f:
+                _save_block(f, blk)
+            os.replace(tmp, p)
+            logger.info("G4 re-stamped legacy blob %032x", int(h))
+        except Exception:
+            self._reap_tmp(tmp)
+            self.quarantine(h)
+            logger.warning("G4 legacy blob %032x could not be re-stamped;"
+                           " reaped", int(h))
 
     def sweep(self, now: Optional[float] = None,
               residency=None) -> List[int]:
@@ -110,24 +196,34 @@ class ObjectStorePool:
         Safe to run from any client concurrently (unlink/utime races are
         benign)."""
         now = now if now is not None else time.time()
+        tmp_ttl = self.ttl_s if self.ttl_s is not None else _TMP_TTL_S
         removed: List[int] = []
-        for sub in os.listdir(self.dir):
+        for sub in self._listdir(self.dir):
             d = os.path.join(self.dir, sub)
             if not os.path.isdir(d):
                 continue
-            for name in os.listdir(d):
+            for name in self._listdir(d):
                 p = os.path.join(d, name)
+                if ".tmp" in name:
+                    # an abandoned mid-put tmp blob (crashed writer):
+                    # reap once it is older than the TTL — a *live* put
+                    # renames within milliseconds, so age is the signal
+                    try:
+                        if now - os.path.getmtime(p) > tmp_ttl:
+                            os.unlink(p)
+                    except OSError:
+                        pass
+                    continue
                 legacy = False
                 h: Optional[int] = None
-                if ".tmp" not in name:
-                    try:
-                        if len(name) == 16:
-                            int(name, 16)  # only reap actual legacy keys
-                            legacy = True
-                        elif len(name) == 32:
-                            h = int(name, 16)
-                    except ValueError:
-                        pass
+                try:
+                    if len(name) == 16:
+                        int(name, 16)  # only reap actual legacy keys
+                        legacy = True
+                    elif len(name) == 32:
+                        h = int(name, 16)
+                except ValueError:
+                    pass
                 verdict = (residency(h) if residency is not None
                            and h is not None else None)
                 try:
@@ -144,12 +240,24 @@ class ObjectStorePool:
                     continue
         return removed
 
+    @staticmethod
+    def _listdir(d: str) -> List[str]:
+        """One directory listing, degraded: a concurrently-removed
+        fanout dir or unmounted volume yields an empty listing (partial
+        sweep / partial manifest) instead of raising out of every
+        caller."""
+        try:
+            return os.listdir(d)
+        except OSError:
+            logger.warning("G4 listing failed for %s (partial view)", d)
+            return []
+
     def keys(self) -> Iterable[int]:
-        for sub in os.listdir(self.dir):
+        for sub in self._listdir(self.dir):
             d = os.path.join(self.dir, sub)
             if not os.path.isdir(d):
                 continue
-            for name in os.listdir(d):
+            for name in self._listdir(d):
                 # legacy 16-char blobs are invisible here by design;
                 # sweep() reaps them
                 if len(name) == 32 and ".tmp" not in name:

@@ -21,8 +21,10 @@ from __future__ import annotations
 
 import logging
 import os
+import zipfile
+import zlib
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -35,21 +37,83 @@ Block = Tuple[np.ndarray, ...]
 _MEMBERS = ("k", "v", "ks", "vs")
 
 
+class BlockIntegrityError(ValueError):
+    """A persisted/transferred block's payload failed its crc32 footer.
+
+    Subclasses ValueError so pre-checksum catch lists still treat a
+    corrupt blob as unreadable, while consume sites that care (G4
+    quarantine, remote-pull suspect marking) can catch it specifically
+    and attribute the corruption before degrading to a miss."""
+
+
+def block_crc(arrays: Sequence[np.ndarray]) -> int:
+    """crc32 over the payload tuple's byte views, chained per member.
+
+    Each member contributes its ``name:dtype:shape`` header before its
+    bytes, so the checksum commits to dtype and shape too — a
+    version-skewed blob whose dtype member was rewritten (or whose bytes
+    were re-viewed at the wrong width) fails verification exactly like a
+    flipped bit."""
+    crc = 0
+    for name, arr in zip(_MEMBERS, arrays):
+        a = np.ascontiguousarray(arr)
+        crc = zlib.crc32(f"{name}:{a.dtype}:{a.shape}".encode(), crc)
+        crc = zlib.crc32(a.view(np.uint8).reshape(-1), crc)
+    return crc & 0xFFFFFFFF
+
+
 def _save_block(path_or_file, arrays: Sequence[np.ndarray]) -> None:
     """npz round-trips ml_dtypes (bfloat16, the default KV dtype) as raw
-    void ('|V2') — persist byte views + dtype names and view() back."""
+    void ('|V2') — persist byte views + dtype names and view() back.
+
+    A ``crc`` footer (crc32 of the byte views, dtype/shape committed —
+    see block_crc) rides in every blob; _load_block verifies it at every
+    tier-crossing consume."""
     payload = {}
     for name, arr in zip(_MEMBERS, arrays):
         payload[name] = np.ascontiguousarray(arr).view(np.uint8)
         payload[name + "d"] = str(arr.dtype)
+    payload["crc"] = np.uint32(block_crc(arrays))
     np.savez(path_or_file, **payload)
 
 
-def _load_block(z) -> Block:
-    return tuple(
+def has_checksum(z) -> bool:
+    """True when a loaded npz carries the crc footer (False = legacy
+    blob from a pre-checksum writer: read-once, then re-stamp or reap)."""
+    return "crc" in getattr(z, "files", z)
+
+
+def _load_block(z, verify: bool = True) -> Block:
+    blk = tuple(
         z[name].view(_np_dtype(z[name + "d"].item()))
         for name in _MEMBERS if name in getattr(z, "files", z)
     )
+    if verify and has_checksum(z) and block_crc(blk) != int(z["crc"]):
+        raise BlockIntegrityError(
+            "KV block payload failed its crc32 footer")
+    return blk
+
+
+def read_block_file(path: str) -> Tuple[Block, Optional[int]]:
+    """Load one persisted block file WITHOUT verifying; returns
+    ``(block, stored_crc)`` where stored_crc is None for a legacy
+    (pre-checksum) blob.  Callers verify via verify_block — split so the
+    G4 read path can interpose its chaos tamper seam between load and
+    verify, proving the checksum (not the injector) catches the fault.
+    This and _load_block are the ONLY sanctioned npz readers for block
+    payloads (dynlint DYN014)."""
+    with np.load(path) as z:
+        blk = _load_block(z, verify=False)
+        crc = int(z["crc"]) if has_checksum(z) else None
+    return blk, crc
+
+
+def verify_block(blk: Sequence[np.ndarray], crc: Optional[int]) -> None:
+    """Raise BlockIntegrityError when `blk` does not match its stored
+    crc; a None crc (legacy blob) passes — the caller re-stamps it."""
+    if crc is not None and block_crc(blk) != crc:
+        raise BlockIntegrityError(
+            "KV block payload failed its crc32 footer")
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -120,6 +184,11 @@ class DiskBlockPool:
         self.capacity = capacity_blocks
         os.makedirs(directory, exist_ok=True)
         self._order: "OrderedDict[int, None]" = OrderedDict()
+        # integrity/degradation hooks (set by TieredKvManager): fired on
+        # a checksum-failed read (blob already quarantined) and on a raw
+        # I/O failure (feeds the g3 circuit breaker)
+        self.on_corruption: Optional[Callable[[int], None]] = None
+        self.on_io_error: Optional[Callable[[], None]] = None
         # Exclusive ownership: two engines misconfigured with the same
         # disk_cache_dir would silently destroy each other's live blocks
         # (the wipe below, plus LRU evictions).  Hold an flock for the
@@ -162,11 +231,14 @@ class DiskBlockPool:
         return h in self._order
 
     def put(self, h: int, *arrays: np.ndarray) -> List[int]:
-        """Persist a block; returns hashes evicted to make room."""
+        """Persist a block; returns hashes evicted to make room.  A
+        write failure (disk full, dying device) drops the block instead
+        of raising into the scheduler loop."""
         if h in self._order:
             self._order.move_to_end(h)
             return []
-        _save_block(self._path(h), arrays)
+        if not self._write(h, arrays):
+            return []
         self._order[h] = None
         evicted: List[int] = []
         while len(self._order) > self.capacity:
@@ -184,7 +256,8 @@ class DiskBlockPool:
         if h in self._order:
             self._order.move_to_end(h)
             return []
-        _save_block(self._path(h), arrays)
+        if not self._write(h, arrays):
+            return []
         self._order[h] = None
         evicted: List[Tuple[int, Optional[Block]]] = []
         while len(self._order) > self.capacity:
@@ -195,18 +268,45 @@ class DiskBlockPool:
             evicted.append((old, blk))
         return evicted
 
+    def _write(self, h: int, arrays: Sequence[np.ndarray]) -> bool:
+        try:
+            _save_block(self._path(h), arrays)
+        except OSError:
+            logger.warning("G3 put failed for %x; dropping block", h,
+                           exc_info=True)
+            self._unlink(h)  # no partial file may linger
+            if self.on_io_error is not None:
+                self.on_io_error()
+            return False
+        return True
+
     def get(self, h: int) -> Optional[Block]:
         """Returns the block, or None.  An unreadable file is dropped from
         the pool — callers that saw `h in pool` beforehand must treat a None
-        here as a G3 removal (and emit the removed event)."""
+        here as a G3 removal (and emit the removed event).  A checksum
+        failure additionally unlinks the file (quarantine) and fires
+        on_corruption so the event is attributed, not just absorbed."""
         if h not in self._order:
             return None
         try:
             with np.load(self._path(h)) as z:
                 blk = _load_block(z)
-        except (OSError, KeyError, TypeError, AttributeError):
+        except BlockIntegrityError:
+            logger.warning("G3 block %x failed checksum; quarantined", h)
+            self._order.pop(h, None)
+            self._unlink(h)
+            if self.on_corruption is not None:
+                self.on_corruption(h)
+            return None
+        except (OSError, KeyError, ValueError, TypeError, AttributeError,
+                zipfile.BadZipFile) as e:
+            # BadZipFile is what a torn/truncated npz actually raises —
+            # subclasses Exception directly, so the ValueError family
+            # above would let it escape into the scheduler
             logger.warning("G3 block %x unreadable; dropping", h)
             self._order.pop(h, None)
+            if isinstance(e, OSError) and self.on_io_error is not None:
+                self.on_io_error()
             return None
         self._order.move_to_end(h)
         return blk

@@ -52,6 +52,11 @@ class RemoteBlockIndex:
         # a G2→G3 demotion is (g3 stored, g2 removed) on the SAME worker,
         # which must not erase the holder.
         self.holders: Dict[int, Dict[int, Set[str]]] = {}
+        # poisoned-source book: worker -> corrupt frames served.  A
+        # suspect worker is dropped from the index (its future stored
+        # events re-admit it — one bad frame shouldn't exile a peer
+        # forever, but it must stop being the best_run answer NOW).
+        self.suspects: Dict[int, int] = {}
         self._cancel = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
 
@@ -116,6 +121,15 @@ class RemoteBlockIndex:
             if not by_worker:
                 del self.holders[h]
 
+    def mark_suspect(self, worker_id: int) -> None:
+        """A peer served a checksum-failed frame: record it and stop
+        advertising anything it holds."""
+        self.suspects[worker_id] = self.suspects.get(worker_id, 0) + 1
+        logger.warning(
+            "kvbm peer %d marked suspect (%d corrupt frames); dropping "
+            "its advertised blocks", worker_id, self.suspects[worker_id])
+        self.drop_worker(worker_id)
+
     def best_run(self, hashes: Sequence[int]) -> Tuple[Optional[int], int]:
         """(worker, run_length): the peer holding the longest leading run
         of `hashes`."""
@@ -150,8 +164,12 @@ _WIRE_MEMBERS = ("k", "v", "ks", "vs")
 def encode_block(h: int, *arrays: np.ndarray) -> Dict:
     """Block payload -> wire frame: (k, v) or (k, v, ks, vs) — an int8
     block's quantized data + fp32 scales move verbatim (half the bytes
-    of a bf16 pull, scales bit-exact)."""
-    d: Dict = {"h": h}
+    of a bf16 pull, scales bit-exact).  A crc32 footer (same canonical
+    checksum the persisted tiers use, dtype/shape committed) rides
+    every frame; decode_block verifies it."""
+    from .pools import block_crc
+
+    d: Dict = {"h": h, "crc": block_crc(arrays)}
     for name, arr in zip(_WIRE_MEMBERS, arrays):
         d[name] = np.ascontiguousarray(arr).view(np.uint8).tobytes()
         d[name + "d"] = str(arr.dtype)
@@ -160,14 +178,35 @@ def encode_block(h: int, *arrays: np.ndarray) -> Dict:
 
 
 def decode_block(d: Dict) -> Tuple:
-    from .pools import _np_dtype
+    """Wire frame -> (h, *arrays).  Raises BlockIntegrityError when the
+    payload does not match its crc footer (a frame without one — an
+    unupgraded peer — passes: mixed-version fleets keep pulling)."""
+    from .pools import BlockIntegrityError, _np_dtype, block_crc
 
     arrays = tuple(
         np.frombuffer(d[name], np.uint8).view(
             _np_dtype(d[name + "d"])).reshape(d[name + "shape"])
         for name in _WIRE_MEMBERS if name in d
     )
+    crc = d.get("crc")
+    if crc is not None and block_crc(arrays) != int(crc):
+        raise BlockIntegrityError(
+            f"remote KV block {int(d['h']):x} failed its crc32 footer")
     return (d["h"], *arrays)
+
+
+def _tamper_frame(frame: Dict) -> Dict:
+    """Chaos "corrupt" action: flip one byte of the frame's first
+    payload member before decode — the wire checksum, not the injector,
+    must catch it."""
+    out = dict(frame)
+    for name in _WIRE_MEMBERS:
+        if isinstance(out.get(name), (bytes, bytearray)) and out[name]:
+            b = bytearray(out[name])
+            b[0] ^= 0xFF
+            out[name] = bytes(b)
+            break
+    return out
 
 
 class RemoteKvbmPuller:
@@ -179,6 +218,9 @@ class RemoteKvbmPuller:
         self.client = client  # kvbm_pull endpoint client
         self.max_blocks = max_blocks
         self.timeout_s = timeout_s
+        # attribution hook the engine installs: fired once per corrupt
+        # frame detection with (tier="remote", block hash)
+        self.on_corruption = None
 
     async def fetch_run(
         self, hashes: Sequence[int]
@@ -193,18 +235,37 @@ class RemoteKvbmPuller:
         out: List[Tuple] = []
 
         async def pull() -> None:
+            from .pools import BlockIntegrityError
+
             # each attempt restarts the run — the leading-run contract
             # below would reject a resumed walk with a gap anyway
             out.clear()
             async for frame in self.client.generate(
                     {"hashes": want}, instance_id=worker):
                 # chaos seam: peer pull fails partway through the run /
-                # slow peer (key carries the frame ordinal for after=N)
-                await chaos.ahit("kvbm.remote_pull",
-                                 key=f"{worker}:{len(out)}")
+                # slow peer / corrupt frame (key carries the frame
+                # ordinal for after=N)
+                act = await chaos.ahit("kvbm.remote_pull",
+                                       key=f"{worker}:{len(out)}")
                 if frame.get("h") is None:
                     break  # peer signals end-of-run (evicted mid-walk)
-                out.append(decode_block(frame))
+                if act == "corrupt":
+                    frame = _tamper_frame(frame)
+                try:
+                    out.append(decode_block(frame))
+                except BlockIntegrityError:
+                    # attribute at detection time (a retry may heal a
+                    # transient flip, but the event happened) and mark
+                    # the source suspect before the retry policy decides
+                    # anything
+                    self.index.mark_suspect(worker)
+                    if self.on_corruption is not None:
+                        try:
+                            self.on_corruption("remote",
+                                               int(frame.get("h") or 0))
+                        except Exception:
+                            pass
+                    raise
 
         try:
             # unified retry (runtime/retry.py): a transient peer hiccup

@@ -1,4 +1,4 @@
-"""dynlint rules DYN001–DYN013: each one encodes a bug this repo really
+"""dynlint rules DYN001–DYN014: each one encodes a bug this repo really
 shipped (the PR it came from is named per rule), turning a
 found-late-by-review-or-live-fleet failure into a permanently-enforced
 invariant.  The README "Static analysis" table is generated from the
@@ -680,3 +680,45 @@ def book_mutation(mod: Module) -> Iterable[Finding]:
             a = _book_attr(node.func.value)
             if a is not None:
                 yield _flag(a, f".{node.func.attr}()")
+
+
+# ---------------------------------------------------------------------------
+# DYN014 — raw np.load/np.savez of KV block payloads
+# ---------------------------------------------------------------------------
+
+_NPZ_CALLS = {
+    "np.load", "numpy.load", "np.savez", "numpy.savez",
+    "np.savez_compressed", "numpy.savez_compressed",
+}
+# the sanctioned readers/writers live in kvbm/pools.py (_save_block /
+# _load_block / read_block_file, the only code allowed to touch the npz
+# layer directly — it is what stamps and verifies the crc32 footer);
+# multimodal/encoder.py decodes MEDIA tensors from the wire, not KV
+# block payloads, so the checksummed-block contract does not apply
+_NPZ_EXEMPT = (
+    "dynamo_tpu/kvbm/pools.py",
+    "dynamo_tpu/multimodal/encoder.py",
+)
+
+
+@register(
+    "DYN014",
+    "raw np.load/np.savez outside the checksummed block helpers",
+    "PR 20: persisted/transferred KV blocks carry a crc32 footer verified "
+    "at every tier-crossing consume — a direct np.load/np.savez of a "
+    "block payload bypasses both the stamp and the verify, re-creating "
+    "the unchecksummed blobs the integrity plane exists to retire",
+    applies=lambda p: _in_pkg(p) and p not in _NPZ_EXEMPT)
+def raw_npz(mod: Module) -> Iterable[Finding]:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d not in _NPZ_CALLS:
+            continue
+        yield mod.finding(
+            "DYN014", node,
+            f"direct {d}() of a block payload bypasses the crc32 "
+            "stamp/verify: persist through kvbm/pools._save_block and "
+            "consume through _load_block/read_block_file (+verify_block) "
+            "so a corrupt blob quarantines instead of serving bytes")

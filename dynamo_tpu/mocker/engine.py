@@ -111,6 +111,13 @@ class MockEngineArgs:
     # and the source of the worker's advertised kv_tier_costs)
     g2_onboard_s_per_block: float = 0.0005
     g4_onboard_s_per_block: float = 0.002
+    # KV-integrity parity (engine/config.py kv_io_deadline_s /
+    # kv_breaker_*): simulated per-lookup G4 deadline charged when a
+    # chaos "stall" fires, and the tier circuit breaker that prices a
+    # failing G4 at recompute after `threshold` consecutive failures
+    g4_deadline_s: float = 0.05
+    kv_breaker_threshold: int = 3
+    kv_breaker_cooldown_s: float = 5.0
     # -- simulated device-performance plane (obs satellites) --------------
     # the first dispatch of each program family emits a `compile` FPM
     # record of this duration — the exact record shape the JAX engine's
@@ -182,11 +189,28 @@ class MockEngine:
 
         self.kv_ledger = (KvLedger()
                           if ledger_enabled(args.kv_ledger) else None)
+        # tier breaker (kvbm/breaker.py — the real manager's class, so
+        # state names / thresholds can't drift between engines); only G4
+        # is breakable in the sim (G2 is an in-process dict)
+        if args.object_store is not None:
+            from ..kvbm.breaker import TierBreaker
+
+            self.kv_breaker = TierBreaker(
+                ("g4",), threshold=args.kv_breaker_threshold,
+                cooldown_s=args.kv_breaker_cooldown_s)
+        else:
+            self.kv_breaker = None
+        # per-(tier, action) integrity failure counts — the mocker
+        # analogue of JaxEngine.kv_integrity_counters()
+        self.kv_integrity: Dict = {}
         self.cache = KvCacheSim(args.num_blocks, args.enable_prefix_caching,
                                 kv_cache_dtype=args.kv_cache_dtype,
                                 ledger=self.kv_ledger,
                                 host_blocks=args.host_blocks,
-                                object_store=args.object_store)
+                                object_store=args.object_store,
+                                breaker=self.kv_breaker,
+                                g4_deadline_s=args.g4_deadline_s,
+                                on_corruption=self._note_kv_corruption)
         # onboard latency debt: seconds the NEXT step pays for blocks
         # admission served back into G1 from G2/G4 this step
         self._onboard_debt_s = 0.0
@@ -606,6 +630,12 @@ class MockEngine:
         # cheaper than the prefill recompute they displaced, which is
         # exactly the gap the cold-start bench measures
         onboard_s, self._onboard_debt_s = self._onboard_debt_s, 0.0
+        # deadline-bounded G4 I/O: stalled lookups charged their
+        # deadline by the capacity sim (no real sleep) pay it here as
+        # simulated step time — the mocker analogue of the real
+        # engine's bounded ObjectIO waits
+        onboard_s += self.cache.io_penalty_s
+        self.cache.io_penalty_s = 0.0
         step_s = (
             self.args.base_step_s
             + prefill_tokens * self.args.prefill_s_per_token
@@ -770,6 +800,29 @@ class MockEngine:
             self.audit_kv(where="step")
         obs.end("step", t_step, track=self._obs_track,
                 active=len(self.running), waiting=len(self.waiting))
+
+    def _note_kv_corruption(self, tier: str, h: int) -> None:
+        """Attribute a quarantined block (JaxEngine._note_kv_corruption
+        parity).  The capacity sim already recorded the ledger violation
+        + quarantine op; this keeps the engine-level counter the worker
+        exports as dynamo_kv_integrity_failures_total."""
+        key = (tier, "quarantine")
+        self.kv_integrity[key] = self.kv_integrity.get(key, 0) + 1
+
+    def kv_integrity_counters(self) -> dict:
+        """(tier, action) -> count, merging the sim's G4 I/O failures —
+        the same row shape JaxEngine.kv_integrity_counters() returns."""
+        out = dict(self.kv_integrity)
+        for action, n in self.cache.io_failures.items():
+            if n:
+                out[("g4", action)] = out.get(("g4", action), 0) + n
+        return out
+
+    def tier_states(self) -> dict:
+        """tier -> breaker state (TieredKvManager.tier_states parity)."""
+        if self.kv_breaker is None:
+            return {}
+        return self.kv_breaker.states()
 
     def audit_kv(self, where: str = "on_demand") -> dict:
         """Reconcile the ledger's books against the capacity sim — the

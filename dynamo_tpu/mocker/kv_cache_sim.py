@@ -23,6 +23,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from .. import chaos
+
 
 class SimObjectStore:
     """Shared in-process G4: the mocker's stand-in for
@@ -48,6 +50,12 @@ class SimObjectStore:
         new = int(h) not in self._blobs
         self._blobs[int(h)] = time.monotonic()
         return new
+
+    def quarantine(self, h: int) -> bool:
+        """Delete a blob that failed verification (the sim analogue of
+        ObjectStorePool.quarantine — fleet-wide, since the store is
+        shared by every simulated worker)."""
+        return self._blobs.pop(int(h), None) is not None
 
     def keys(self) -> List[int]:
         return list(self._blobs)
@@ -100,7 +108,9 @@ class CacheStepResult:
 class KvCacheSim:
     def __init__(self, num_blocks: int, enable_prefix_caching: bool = True,
                  kv_cache_dtype: str = "bf16", ledger=None,
-                 host_blocks: int = 0, object_store=None):
+                 host_blocks: int = 0, object_store=None,
+                 breaker=None, g4_deadline_s: float = 0.0,
+                 on_corruption=None):
         num_blocks = kv_dtype_capacity_blocks(num_blocks, kv_cache_dtype)
         self.kv_cache_dtype = kv_cache_dtype
         self.num_blocks = num_blocks
@@ -112,6 +122,19 @@ class KvCacheSim:
         self.host_blocks = max(0, host_blocks)
         self._g2: "OrderedDict[int, None]" = OrderedDict()
         self.g4 = object_store
+        # KV-integrity parity (kvbm/breaker.py, chaos kvbm.object_io):
+        # every G4 lookup runs through the chaos seam + the tier
+        # breaker; a "stall" charges g4_deadline_s of simulated time to
+        # io_penalty_s (deadline-bounded give-up, no real sleep — the
+        # sim runs on the event loop) and the engine drains it into the
+        # step's onboard debt
+        self.breaker = breaker
+        self.g4_deadline_s = float(g4_deadline_s)
+        self.on_corruption = on_corruption
+        self.io_penalty_s = 0.0
+        # G4 I/O failure counts by action, the sim analogue of
+        # TieredKvManager.io_failure_counters() rows
+        self.io_failures: Dict[str, int] = {}
         # block-lifecycle ledger (obs/kv_ledger.py), hash-keyed — sim
         # blocks have no physical identity; partial blocks record as
         # anonymous per-seq counts.  Same accounting contract as
@@ -187,6 +210,51 @@ class KvCacheSim:
         # worker too, and the consolidator nets re-spills locally
         self._tier_event(out, [h], [], "g4")
 
+    def _g4_lookup(self, h: int, out: CacheStepResult) -> bool:
+        """Probe the shared store with the real manager's integrity
+        semantics (kvbm/manager.py fetch, G4 branch): the lookup runs
+        through the kvbm.object_io chaos seam and the tier breaker.  An
+        injected "stall" models a hung shared mount — the sim charges
+        the I/O deadline to ``io_penalty_s`` (drained into the engine's
+        onboard debt) and gives up, feeding the breaker; "corrupt"
+        quarantines the blob fleet-wide, publishes removed(g4), and
+        attributes the corruption in the ledger — a data fault, so the
+        breaker records OK (the mount answered)."""
+        if self.g4 is None:
+            return False
+        br = self.breaker
+        if br is not None and not br.allow("g4"):
+            return False
+        try:
+            act = chaos.hit("kvbm.object_io", key=f"get:{int(h):x}")
+        except chaos.ChaosError:
+            self.io_failures["error"] = self.io_failures.get("error", 0) + 1
+            if br is not None:
+                br.record_failure("g4")
+            return False
+        if act == "stall":
+            self.io_penalty_s += self.g4_deadline_s
+            self.io_failures["timeout"] = \
+                self.io_failures.get("timeout", 0) + 1
+            if br is not None:
+                br.record_failure("g4")
+            return False
+        present = h in self.g4
+        if act == "corrupt":
+            if present:
+                self.g4.quarantine(h)
+                self._tier_event(out, [], [h], "g4")
+                if self.ledger is not None:
+                    self.ledger.corruption("g4", h)
+                if self.on_corruption is not None:
+                    self.on_corruption("g4", h)
+            if br is not None:
+                br.record_ok("g4")
+            return False
+        if br is not None:
+            br.record_ok("g4")
+        return present
+
     @property
     def g2_blocks(self) -> int:
         return len(self._g2)
@@ -253,7 +321,7 @@ class KvCacheSim:
             if run_alive:
                 if h in self._g2:
                     src = "g2"
-                elif self.g4 is not None and h in self.g4:
+                elif self._g4_lookup(h, out):
                     src = "g4"
             self.free_blocks -= 1
             self._ref[h] = 1

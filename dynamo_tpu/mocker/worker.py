@@ -243,6 +243,18 @@ class MockerWorker:
             out["g4"] = {"blobs_total": len(store),
                          "blobs_sampled": len(keys),
                          "residency": res.verdicts(keys)}
+        # KV-integrity plane (same keys as the JAX worker's kv_debug):
+        # breaker states + (tier, action) failure counters, rank-merged
+        states = engines[0].tier_states() if engines else {}
+        if states:
+            out["tier_state"] = states
+        integ: dict = {}
+        for e in engines:
+            for (t, action), n in e.kv_integrity_counters().items():
+                k = f"{t}:{action}"
+                integ[k] = integ.get(k, 0) + n
+        if integ:
+            out["integrity"] = integ
         return out
 
     def debug_state(self) -> dict:
@@ -416,6 +428,34 @@ class MockerWorker:
                         "g4": min(1.0, self.args.g4_onboard_s_per_block
                                   / recompute),
                     }
+            # tier breakers (KV-integrity plane): merge per-rank states
+            # (worst wins — the ranks share one simulated mount), price
+            # any non-closed tier at recompute in the advertised costs,
+            # and export the same gauges the JAX worker exports
+            from ..kvbm.breaker import NUMERIC as _TIER_NUMERIC
+            from ..router.tiered_index import degraded_tier_costs
+
+            tier_states = {}
+            for e in self.engines:
+                for t, s in e.tier_states().items():
+                    if (_TIER_NUMERIC.get(s, 0) >= _TIER_NUMERIC.get(
+                            tier_states.get(t, "closed"), 0)):
+                        tier_states[t] = s
+            if tier_states:
+                tier_costs = degraded_tier_costs(tier_costs, tier_states)
+                for t, s in tier_states.items():
+                    m.set("dynamo_kvbm_tier_state",
+                          float(_TIER_NUMERIC.get(s, 0)),
+                          "KV tier circuit-breaker state "
+                          "(0=closed, 1=half_open, 2=open)", tier=t)
+            integ: dict = {}
+            for e in self.engines:
+                for (t, action), n in e.kv_integrity_counters().items():
+                    integ[(t, action)] = integ.get((t, action), 0) + n
+            for (t, action), n in integ.items():
+                m.set("dynamo_kv_integrity_failures_total", float(n),
+                      "KV integrity/I-O failures by tier and action",
+                      tier=t, action=action)
             await self.runtime.event_plane.publish(subject, {
                 "worker_id": self.served.instance_id,
                 "active_seqs": sum(e.num_active_seqs for e in self.engines),

@@ -437,10 +437,19 @@ def reduce_kv_ledgers(kv_states: List[dict]) -> Optional[dict]:
     onboards: Dict[str, int] = {}
     g4_residency: Dict[str, int] = {}
     g4_workers = 0
+    # degraded-mode fold: tier -> breaker-state -> worker count, plus
+    # total integrity failures ((tier, action) quarantine/timeout rows)
+    tier_states: Dict[str, Dict[str, int]] = {}
+    integrity: Dict[str, int] = {}
     for s in kv_states:
         for kind, tiers in (s.get("violations_total") or {}).items():
             violations[kind] = violations.get(kind, 0) \
                 + sum(int(n) for n in tiers.values())
+        for tier, st in (s.get("tier_state") or {}).items():
+            by_state = tier_states.setdefault(tier, {})
+            by_state[st] = by_state.get(st, 0) + 1
+        for key, n in (s.get("integrity") or {}).items():
+            integrity[key] = integrity.get(key, 0) + int(n)
         for tier, states_ in (s.get("attribution") or {}).items():
             dst = occupancy.setdefault(tier, {})
             for state in ("active", "prefix_cached",
@@ -470,6 +479,10 @@ def reduce_kv_ledgers(kv_states: List[dict]) -> Optional[dict]:
     if g4_workers:
         out["g4"] = {"workers_reporting": g4_workers,
                      "residency": g4_residency}
+    if tier_states:
+        out["tier_state"] = tier_states
+    if integrity:
+        out["integrity_failures"] = integrity
     return out
 
 

@@ -98,10 +98,15 @@ logger = logging.getLogger(__name__)
 LEDGER_OPS = frozenset({
     "alloc", "pin", "unpin", "cache", "commit", "evict", "release",
     "park", "unpark", "partial", "stage", "tier_evict", "onboard",
-    "clear",
+    "clear", "quarantine",
 })
 
-VIOLATION_KINDS = ("leak", "double-free", "orphan", "refcount-drift")
+# `corrupt` differs from the reconciliation kinds: it is recorded at
+# the consume site the moment a checksum fails (corruption()), not
+# derived by an audit sweep — an audit can't see a flipped bit, only a
+# read can
+VIOLATION_KINDS = ("leak", "double-free", "orphan", "refcount-drift",
+                   "corrupt")
 
 DEFAULT_RING = 4096
 
@@ -339,6 +344,30 @@ class KvLedger:
         dynamo_engine_kv_onboard_total{tier})."""
         with self._lock:
             return dict(self._onboards)
+
+    def corruption(self, tier: str, h: Optional[int] = None,
+                   detail: str = "") -> None:
+        """One checksum-failed consume, attributed at the read site
+        (kind=corrupt — see VIOLATION_KINDS).  The blob/frame is already
+        quarantined by the caller; this is the forensic record: the
+        monotonic (corrupt, tier) counter, a `quarantine` tape entry,
+        and a flight-recorder snapshot on each tier's FIRST corruption
+        (the context that poisoned a tier is exactly what post-incident
+        forensics needs and exactly what a counter loses)."""
+        from .. import obs
+
+        with self._lock:
+            key = ("corrupt", tier)
+            first = key not in self.violations_total
+            self.violations_total[key] = \
+                self.violations_total.get(key, 0) + 1
+            self._note("quarantine", tier, None, h, None)
+        logger.error(
+            "KV integrity: corrupt block %s in tier %s quarantined%s",
+            f"{h:x}" if h is not None else "?", tier,
+            f" ({detail})" if detail else "")
+        if first:
+            obs.flight_dump(f"kv_ledger.corrupt.{tier}")
 
     def clear(self) -> None:
         with self._lock:

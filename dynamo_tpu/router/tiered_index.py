@@ -83,6 +83,29 @@ def compute_tier_costs(prefill_flops_per_s: Optional[float],
     return costs
 
 
+def degraded_tier_costs(costs: Optional[Dict[str, float]],
+                        tier_states: Optional[Dict[str, str]],
+                        ) -> Optional[Dict[str, float]]:
+    """Fold circuit-breaker states (kvbm/breaker.py) into the costs a
+    worker advertises: any non-closed tier is priced AT recompute (1.0),
+    so the selector's overlap discount for blocks only reachable through
+    that tier collapses to zero — it prices recompute instead of
+    onboarding from a tier that times out.  Shared by the JAX and mocker
+    workers (one definition, so /metrics + routing parity can't drift).
+
+    Publishing the degraded tier beats omitting it: a missing key makes
+    the selector fall back to DEFAULT_TIER_COSTS, which would keep
+    advertising a cheap tier this worker cannot actually read."""
+    if not tier_states or all(s == "closed"
+                              for s in tier_states.values()):
+        return costs
+    out = dict(costs) if costs else dict(DEFAULT_TIER_COSTS)
+    for tier, st in tier_states.items():
+        if st != "closed":
+            out[tier] = 1.0
+    return out
+
+
 class TieredKvIndexer:
     """Tier-aware wrapper over either base indexer implementation.
 

@@ -241,6 +241,45 @@ def test_bench_prefix_fleet_smoke_closed_loop():
     assert g4["cold_start_penalty"] < ctl["cold_start_penalty"]
 
 
+def test_bench_chaos_cache_smoke_closed_loop():
+    """The ISSUE-20 KV-integrity A/B at smoke scale runs IN tier-1
+    (seconds on CPU): warm fleet -> junk churn spills prefixes into the
+    shared G4 store -> the measure wave re-onboards them, once healthy
+    and once under injected corruption + stalls.  The mechanism gates —
+    byte identity across arms, store populated, real G4 onboarding,
+    stall/breaker observation, 1:1 corrupt attribution, clean ledger
+    audits — are enforced even in smoke mode (the bench exits 1 on
+    failure); only the p90-TTFT-ratio chip bar is skipped."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_chaos_cache.py"),
+         "--mode", "smoke"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    (rep,) = [json.loads(line) for line in r.stdout.splitlines()
+              if line.startswith("{")]
+    status = {g["name"]: g["status"] for g in rep["gates"]}
+    assert status["chaos_cache_byte_identity"] == "pass"
+    assert status["chaos_cache_store_populated"] == "pass"
+    assert status["chaos_cache_control_onboard_g4"] == "pass"
+    assert status["chaos_cache_stall_observed"] == "pass"
+    assert status["chaos_cache_corrupt_attributed"] == "pass"
+    assert status["chaos_cache_ledger_audit"] == "pass"
+    assert status["chaos_cache_p90_ttft_ratio"] == "skipped_smoke"
+    res = rep["result"]
+    cha, ctl = res["chaos"], res["control"]
+    # every materialized corruption quarantined AND attributed; the
+    # healthy arm saw none of either
+    hi = cha["integrity"]
+    assert hi["quarantined"] > 0
+    assert hi["ledger_corrupt_g4"] == hi["quarantined"]
+    assert hi["breaker_trips"] > 0 and hi["timeouts"] > 0
+    ci = ctl["integrity"]
+    assert ci["quarantined"] == 0 and ci["breaker_trips"] == 0
+
+
 def test_run_round_help_exits_zero():
     """benchmarks/run_round.py is not matched by the bench_*.py glob
     above, so it gets its own drift gate: --help must import the driver
@@ -260,7 +299,7 @@ def test_run_round_smoke_emits_gated_json_per_bench():
     """The round driver end to end at smoke scale: one JSON line per
     bench, every line labeled mode=smoke, and every TPU acceptance gate
     PRESENT but skipped (interpret/mocker numbers must never satisfy a
-    chip bar).  This is the r06 cash-in path minus the chip."""
+    chip bar).  This is the r07 cash-in path minus the chip."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks",
@@ -273,10 +312,10 @@ def test_run_round_smoke_emits_gated_json_per_bench():
     by_bench = {rep["bench"]: rep for rep in lines}
     assert set(by_bench) == {"prefill", "kv_quant", "serving",
                              "indexer", "global_router",
-                             "prefix_fleet"}
+                             "prefix_fleet", "chaos_cache"}
     gate_names = set()
     for rep in by_bench.values():
-        assert rep["round"] == "r06"
+        assert rep["round"] == "r07"
         assert rep["mode"] == "smoke"
         assert rep["gates"], rep
         for g in rep["gates"]:
@@ -295,7 +334,10 @@ def test_run_round_smoke_emits_gated_json_per_bench():
                           "grouter_staleness_spread",
                           "prefix_fleet_byte_identity",
                           "prefix_fleet_cold_onboard_g4",
-                          "prefix_fleet_cold_start_penalty"}
+                          "prefix_fleet_cold_start_penalty",
+                          "chaos_cache_byte_identity",
+                          "chaos_cache_corrupt_attributed",
+                          "chaos_cache_p90_ttft_ratio"}
     # the correctness bars really ran
     assert {g["name"]: g["status"]
             for g in by_bench["global_router"]["gates"]

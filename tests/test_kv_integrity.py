@@ -1,0 +1,613 @@
+"""KV integrity & degraded-mode serving: checksummed cache fabric,
+poisoned-source quarantine, tier circuit breakers.
+
+Every tier-crossing consume of a persisted/transferred KV block must
+verify the crc32 footer, and every verification failure must degrade to
+a MISS with attribution (quarantined blob, ledger `corrupt` violation,
+suspect peer) — never raise into the scheduler, never serve wrong
+bytes.  The breaker suite proves a failing tier prices recompute
+instead of wedging admission, and re-probes its way back.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu import chaos
+from dynamo_tpu.kvbm import object_store as obj_mod
+from dynamo_tpu.kvbm.breaker import NUMERIC, TierBreaker
+from dynamo_tpu.kvbm.manager import TieredKvManager
+from dynamo_tpu.kvbm.object_store import ObjectStorePool
+from dynamo_tpu.kvbm.pools import (
+    BlockIntegrityError,
+    DiskBlockPool,
+    _save_block,
+    block_crc,
+    read_block_file,
+    verify_block,
+)
+from dynamo_tpu.obs.kv_ledger import KvLedger
+
+
+def blk(seed):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(2, 4, 2, 8)).astype(np.float32),
+            rng.normal(size=(2, 4, 2, 8)).astype(np.float32))
+
+
+def _write_tampered(path, arrays, mutate):
+    """Persist `arrays` claiming their TRUE crc, then let `mutate`
+    corrupt the payload dict before it hits disk — a valid npz whose
+    footer no longer matches its bytes (bit rot / version skew), which
+    only the checksum (not the npz layer) can catch."""
+    payload = {}
+    for name, arr in zip(("k", "v"), arrays):
+        payload[name] = np.ascontiguousarray(arr).view(np.uint8).copy()
+        payload[name + "d"] = str(arr.dtype)
+    payload["crc"] = np.uint32(block_crc(arrays))
+    mutate(payload)
+    np.savez(path, **payload)
+
+
+def _flip_bit(payload):
+    payload["k"].reshape(-1)[0] ^= 0xFF
+
+
+def _skew_dtype(payload):
+    # version-skewed reader metadata: same bytes, re-viewed at a
+    # different width — the crc commits to dtype, so this must fail
+    payload["kd"] = np.str_("float16")
+
+
+# --------------------------- canonical checksum -------------------------
+
+
+def test_block_crc_commits_to_bytes_dtype_and_shape():
+    k, v = blk(1)
+    base = block_crc((k, v))
+    assert base == block_crc((k.copy(), v.copy()))  # deterministic
+    flipped = k.copy()
+    flipped.view(np.uint8).reshape(-1)[0] ^= 0x01
+    assert block_crc((flipped, v)) != base
+    assert block_crc((k.view(np.uint8), v)) != base      # dtype committed
+    assert block_crc((k.reshape(2, 4, 16), v)) != base   # shape committed
+    assert block_crc((k,)) != base                       # member count
+
+
+def test_save_load_round_trip_and_verify(tmp_path):
+    k, v = blk(2)
+    path = str(tmp_path / "b.npz")
+    _save_block(path, (k, v))
+    got, crc = read_block_file(path)
+    assert crc is not None
+    verify_block(got, crc)  # clean blob passes
+    np.testing.assert_array_equal(got[0], k)
+    bad = (got[0].copy(),) + got[1:]
+    bad[0].view(np.uint8).reshape(-1)[0] ^= 0xFF
+    with pytest.raises(BlockIntegrityError):
+        verify_block(bad, crc)
+    verify_block(bad, None)  # legacy blob (no footer): caller re-stamps
+
+
+# --------------------------- G3 consume sites ---------------------------
+
+
+@pytest.mark.parametrize("mutate", [_flip_bit, _skew_dtype],
+                         ids=["bitflip", "dtype_skew"])
+def test_g3_corrupt_read_quarantines_with_attribution(tmp_path, mutate):
+    """A checksum-failed G3 read must degrade to a miss: entry dropped,
+    file unlinked, on_corruption fired — no exception reaches the
+    caller (the engine scheduler)."""
+    pool = DiskBlockPool(str(tmp_path), capacity_blocks=4)
+    try:
+        seen = []
+        pool.on_corruption = lambda h: seen.append(h)
+        pool.put(7, *blk(3))
+        _write_tampered(pool._path(7), blk(3), mutate)
+        assert 7 in pool
+        assert pool.get(7) is None
+        assert seen == [7]
+        assert 7 not in pool
+        assert not os.path.exists(pool._path(7))  # quarantined on disk too
+    finally:
+        pool.close()
+
+
+def test_g3_truncated_file_is_a_miss_not_a_raise(tmp_path):
+    """A torn write (not valid npz at all) is an unreadable-file drop —
+    the pre-checksum degradation path, distinct from corruption."""
+    pool = DiskBlockPool(str(tmp_path), capacity_blocks=4)
+    try:
+        seen = []
+        pool.on_corruption = lambda h: seen.append(h)
+        pool.put(9, *blk(4))
+        with open(pool._path(9), "wb") as f:
+            f.write(b"PK\x03\x04 torn")
+        assert pool.get(9) is None
+        assert 9 not in pool
+        assert seen == []  # unreadable != checksum-failed
+    finally:
+        pool.close()
+
+
+# --------------------------- G4 consume sites ---------------------------
+
+
+def test_g4_chaos_corrupt_is_caught_by_the_checksum(tmp_path):
+    """The kvbm.object_io "corrupt" action tampers the payload AFTER the
+    file is read — the crc verification (not the injector) must catch
+    it, delete the blob fleet-wide, and raise BlockIntegrityError for
+    the caller to attribute."""
+    pool = ObjectStorePool(str(tmp_path))
+    k, v = blk(5)
+    assert pool.put(0xABC, k, v)
+    plane = chaos.ChaosPlane(seed=1)
+    plane.rule("kvbm.object_io", "corrupt", times=1, match="get:")
+    with plane:
+        with pytest.raises(BlockIntegrityError, match="quarantined"):
+            pool.get(0xABC)
+    assert 0xABC not in pool  # blob deleted at the source
+    assert pool.get(0xABC) is None  # now a plain miss, fleet-wide
+
+
+def test_g4_legacy_blob_read_once_and_restamped(tmp_path):
+    """A pre-checksum blob is served once and re-stamped with the
+    footer in place — the shared namespace converges to all-checksummed
+    without a migration."""
+    pool = ObjectStorePool(str(tmp_path))
+    k, v = blk(6)
+    p = pool._path(0xDEF)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    # blob paths carry no .npz suffix: write through the file handle
+    # (np.savez on a bare path would append one)
+    with open(p, "wb") as f:
+        np.savez(f, k=np.ascontiguousarray(k).view(np.uint8),
+                 kd=str(k.dtype),
+                 v=np.ascontiguousarray(v).view(np.uint8),
+                 vd=str(v.dtype))
+    _, crc = read_block_file(p)
+    assert crc is None  # really legacy
+    got = pool.get(0xDEF)
+    np.testing.assert_array_equal(got[0], k)
+    _, crc2 = read_block_file(p)
+    assert crc2 == block_crc((k, v))  # footer landed
+
+
+def test_g4_legacy_blob_reaped_when_restamp_cannot_land(
+        tmp_path, monkeypatch):
+    """A legacy blob whose re-stamp fails must not sit unverifiable in
+    the shared namespace forever: serve the one read, then reap it."""
+    pool = ObjectStorePool(str(tmp_path))
+    k, v = blk(7)
+    p = pool._path(0x123)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    with open(p, "wb") as f:
+        np.savez(f, k=np.ascontiguousarray(k).view(np.uint8),
+                 kd=str(k.dtype),
+                 v=np.ascontiguousarray(v).view(np.uint8),
+                 vd=str(v.dtype))
+
+    def refuse_write(*a, **kw):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(obj_mod, "_save_block", refuse_write)
+    got = pool.get(0x123)
+    assert got is not None  # the read itself was served
+    assert 0x123 not in pool  # un-restampable blob reaped
+    assert not any(".tmp" in n for _, _, ns in os.walk(str(tmp_path))
+                   for n in ns)
+
+
+def test_g4_put_reaps_tmp_on_any_failure(tmp_path):
+    """Satellite: a put that dies for ANY reason (not just OSError) must
+    not orphan its tmp blob on the shared volume."""
+    pool = ObjectStorePool(str(tmp_path))
+    bad = np.array([object()])  # .view(np.uint8) raises TypeError
+    with pytest.raises(TypeError):
+        pool.put(0x777, bad)
+    assert not any(".tmp" in n for _, _, ns in os.walk(str(tmp_path))
+                   for n in ns)
+    assert 0x777 not in pool
+
+
+def test_g4_sweep_reaps_stale_tmp_but_not_live_put(tmp_path):
+    """Satellite: an abandoned mid-put tmp blob (crashed writer) ages
+    out after the TTL; a fresh tmp (a put in flight right now)
+    survives."""
+    pool = ObjectStorePool(str(tmp_path), ttl_s=5.0)
+    sub = tmp_path / "ab"
+    sub.mkdir()
+    stale = sub / (f"{0xAB0:032x}" + ".tmpdeadbeef")
+    stale.write_bytes(b"orphan")
+    old = time.time() - 100.0
+    os.utime(str(stale), (old, old))
+    fresh = sub / (f"{0xAB1:032x}" + ".tmpcafebabe")
+    fresh.write_bytes(b"in flight")
+    pool.sweep()
+    assert not stale.exists()
+    assert fresh.exists()
+    # without a pool TTL the orphan grace defaults to _TMP_TTL_S
+    pool2 = ObjectStorePool(str(tmp_path))
+    stale.write_bytes(b"orphan again")
+    os.utime(str(stale), (old, old))
+    pool2.sweep(now=time.time() + obj_mod._TMP_TTL_S)
+    assert not stale.exists()
+
+
+def test_g4_sweep_and_keys_survive_listing_failure(
+        tmp_path, monkeypatch):
+    """Satellite: a fanout dir that vanishes (concurrent GC) or an
+    unmounted volume yields a PARTIAL sweep/manifest, never an exception
+    out of every caller."""
+    pool = ObjectStorePool(str(tmp_path), ttl_s=0.0)
+    # top byte of the 128-bit PLH is the fanout dir: force two of them
+    h_broken = (0xAA << 120) | 0x1
+    h_healthy = (0xBB << 120) | 0x2
+    pool.put(h_broken, *blk(8))
+    pool.put(h_healthy, *blk(9))
+    real_listdir = os.listdir
+
+    def flaky(d):
+        if os.path.basename(d) == "aa":
+            raise OSError("stale NFS handle")
+        return real_listdir(d)
+
+    monkeypatch.setattr(os, "listdir", flaky)
+    assert list(pool.keys()) == [h_healthy]  # partial manifest
+    reaped = pool.sweep(now=time.time() + 10.0)  # partial sweep, no raise
+    assert reaped == [h_healthy]
+    monkeypatch.setattr(os, "listdir", real_listdir)
+    assert h_broken in pool  # unreachable subtree untouched
+
+
+# --------------------------- manager consume sites ----------------------
+
+
+def test_manager_g3_corruption_publishes_removal_and_attributes(tmp_path):
+    """fetch() of a corrupted G3 block: miss + removed(g3) event (the
+    router must see it gone) + on_corruption attribution + stats."""
+    mgr = TieredKvManager(host_blocks=1, disk_dir=str(tmp_path / "g3"),
+                          disk_blocks=4)
+    try:
+        seen = []
+        mgr.on_corruption = lambda tier, h: seen.append((tier, h))
+        mgr.offload(1, *blk(10))
+        mgr.offload(2, *blk(11))  # g2 cap 1: block 1 demotes to g3
+        assert 1 in mgr.g3
+        _write_tampered(mgr.g3._path(1), blk(10), _flip_bit)
+        got, events, src = mgr.fetch(1)
+        assert got is None and src is None
+        assert ([], [1], "g3") in events
+        assert seen == [("g3", 1)]
+        assert mgr.stats.get("g3_quarantined") == 1
+        assert mgr.tier_states().get("g3") == "closed"  # data fault only
+    finally:
+        mgr.close()
+
+
+def test_manager_g4_corrupt_fetch_degrades_with_attribution(tmp_path):
+    """The full serving-path wiring: chaos-corrupted G4 blob → ObjectIO
+    status "corrupt" → quarantine already done in the pool → manager
+    publishes removed(g4), attributes, and recomputes (miss).  The
+    breaker records OK: a data fault is not a tier fault."""
+    mgr = TieredKvManager(host_blocks=2,
+                          object_dir=str(tmp_path / "g4"))
+    try:
+        seen = []
+        mgr.on_corruption = lambda tier, h: seen.append((tier, h))
+        k, v = blk(12)
+        mgr.g4.put(0xBEEF, k, v)
+        plane = chaos.ChaosPlane(seed=2)
+        plane.rule("kvbm.object_io", "corrupt", times=1, match="get:")
+        with plane:
+            got, events, src = mgr.fetch(0xBEEF)
+        assert got is None and src is None
+        assert ([], [0xBEEF], "g4") in events
+        assert seen == [("g4", 0xBEEF)]
+        assert mgr.stats.get("g4_quarantined") == 1
+        assert mgr.tier_states()["g4"] == "closed"
+        assert 0xBEEF not in mgr.g4
+        # a clean re-spill heals the namespace: next fetch onboards
+        mgr.g4.put(0xBEEF, k, v)
+        got2, _, src2 = mgr.fetch(0xBEEF)
+        assert src2 == "g4"
+        np.testing.assert_array_equal(got2[0], k)
+    finally:
+        mgr.close()
+
+
+def test_manager_g4_stalls_trip_breaker_then_reprobe_heals(
+        tmp_path, monkeypatch):
+    """Deadline-bounded I/O + breaker: a hung shared mount turns into
+    bounded timeouts; `threshold` consecutive ones trip the breaker
+    (match_run stops promising G4 blocks), and after the cooldown one
+    probe re-closes it."""
+    monkeypatch.setattr(obj_mod, "_STALL_S", 0.05)
+    mgr = TieredKvManager(host_blocks=2,
+                          object_dir=str(tmp_path / "g4"),
+                          io_deadline_s=0.01, breaker_threshold=3,
+                          breaker_cooldown_s=0.3)
+    try:
+        k, v = blk(13)
+        mgr.g4.put(0xFEED, k, v)
+        plane = chaos.ChaosPlane(seed=3)
+        plane.rule("kvbm.object_io", "stall", times=3, match="get:")
+        with plane:
+            for _ in range(3):
+                got, _, _ = mgr.fetch(0xFEED)
+                assert got is None  # bounded give-up, not a wedge
+        assert mgr.tier_states()["g4"] == "open"
+        assert mgr.breaker.trips("g4") == 1
+        assert mgr.io_failure_counters()[("g4", "timeout")] == 3
+        assert NUMERIC[mgr.tier_states()["g4"]] == 2
+        # open tier advertises nothing: admission prices recompute
+        assert mgr.match_run([0xFEED]) == 0
+        time.sleep(0.35)  # cooldown + let the wedged I/O thread drain
+        assert mgr.tier_states()["g4"] == "half_open"
+        got, _, src = mgr.fetch(0xFEED)  # the single probe
+        assert src == "g4"
+        np.testing.assert_array_equal(got[0], k)
+        assert mgr.tier_states()["g4"] == "closed"
+    finally:
+        mgr.close()
+
+
+# --------------------------- breaker unit -------------------------------
+
+
+def test_tier_breaker_trip_probe_and_reclose():
+    clk = [0.0]
+    br = TierBreaker(("g4",), threshold=2, cooldown_s=10.0,
+                     clock=lambda: clk[0])
+    assert br.allow("g4")
+    br.record_failure("g4")
+    assert br.state("g4") == "closed"  # one failure is not a trip
+    br.record_failure("g4")
+    assert br.state("g4") == "open" and br.trips("g4") == 1
+    assert not br.allow("g4")
+    clk[0] = 10.0
+    assert br.state("g4") == "half_open"
+    assert br.allow("g4")       # consumes the single probe slot
+    assert not br.allow("g4")   # second concurrent probe refused
+    br.record_failure("g4")     # probe failed: straight back to open
+    assert br.state("g4") == "open" and br.trips("g4") == 2
+    clk[0] = 20.0
+    assert br.allow("g4")
+    br.record_ok("g4")          # probe succeeded
+    assert br.state("g4") == "closed"
+    assert br.allow("g4") and br.allow("g4")  # closed admits freely
+    assert br.state("untracked") == "closed" and br.allow("untracked")
+
+
+def test_success_resets_the_consecutive_failure_count():
+    br = TierBreaker(("g4",), threshold=3, cooldown_s=10.0)
+    br.record_failure("g4")
+    br.record_failure("g4")
+    br.record_ok("g4")  # CONSECUTIVE failures trip, interleaved ok resets
+    br.record_failure("g4")
+    br.record_failure("g4")
+    assert br.state("g4") == "closed" and br.trips("g4") == 0
+
+
+def test_degraded_tier_costs_prices_open_tiers_at_recompute():
+    from dynamo_tpu.router.tiered_index import degraded_tier_costs
+
+    costs = {"g2": 0.05, "g3": 0.2, "g4": 0.5}
+    assert degraded_tier_costs(costs, {"g4": "closed"}) == costs
+    assert degraded_tier_costs(costs, None) == costs
+    out = degraded_tier_costs(costs, {"g4": "open", "g3": "closed"})
+    assert out["g4"] == 1.0 and out["g3"] == 0.2 and out["g2"] == 0.05
+    # half_open is still degraded: one probe is not a tier
+    assert degraded_tier_costs(costs, {"g4": "half_open"})["g4"] == 1.0
+    # publishing beats omitting: no costs + a broken tier still prices it
+    assert degraded_tier_costs(None, {"g4": "open"})["g4"] == 1.0
+
+
+# --------------------------- remote pulls -------------------------------
+
+
+def test_remote_frame_round_trip_and_tamper_detection():
+    from dynamo_tpu.kvbm.remote import (
+        _tamper_frame, decode_block, encode_block,
+    )
+
+    k, v = blk(14)
+    ks = np.ones((2, 4, 2), np.float32)
+    vs = np.ones((2, 4, 2), np.float32) * 2
+    frame = encode_block(0x42, k.astype(np.int8), v.astype(np.int8),
+                         ks, vs)
+    h, *arrays = decode_block(frame)
+    assert h == 0x42 and len(arrays) == 4  # scales ride verbatim
+    np.testing.assert_array_equal(arrays[2], ks)
+    with pytest.raises(BlockIntegrityError):
+        decode_block(_tamper_frame(frame))
+    # an unupgraded peer's frame (no crc) still decodes: mixed-version
+    # fleets keep pulling
+    legacy = dict(frame)
+    del legacy["crc"]
+    assert decode_block(legacy)[0] == 0x42
+
+
+def test_remote_index_suspect_marking_drops_the_peer():
+    from dynamo_tpu.kvbm.remote import RemoteBlockIndex
+
+    idx = RemoteBlockIndex(None, "ns", "comp", self_worker_id=0)
+    for h in (1, 2, 3):
+        idx.holders.setdefault(h, {}).setdefault(7, set()).add("g2")
+    idx.holders.setdefault(2, {}).setdefault(8, set()).add("g2")
+    assert idx.best_run([1, 2, 3]) == (7, 3)
+    idx.mark_suspect(7)  # one corrupt frame: stop advertising it NOW
+    assert idx.best_run([1, 2, 3]) == (None, 0)
+    assert idx.best_run([2]) == (8, 1)  # other peers unaffected
+    assert idx.suspects[7] == 1
+    # a future stored event re-admits the peer (not exiled forever)
+    idx.holders.setdefault(1, {}).setdefault(7, set()).add("g2")
+    assert idx.best_run([1]) == (7, 1)
+
+
+async def test_remote_pull_corrupt_frame_marks_suspect_and_attributes():
+    """A chaos-corrupted pull frame: the wire crc (not the injector)
+    catches it, the source is marked suspect BEFORE retry policy runs,
+    and the corruption is attributed with tier="remote"."""
+    from dynamo_tpu.kvbm.remote import (
+        RemoteBlockIndex, RemoteKvbmPuller, encode_block,
+    )
+
+    k, v = blk(15)
+
+    class FakeClient:
+        async def generate(self, payload, instance_id=None):
+            for h in payload["hashes"]:
+                yield encode_block(h, k, v)
+
+    idx = RemoteBlockIndex(None, "ns", "comp", self_worker_id=0)
+    for h in (10, 11):
+        idx.holders.setdefault(h, {}).setdefault(5, set()).add("g2")
+    puller = RemoteKvbmPuller(idx, FakeClient(), timeout_s=2.0)
+    seen = []
+    puller.on_corruption = lambda tier, h: seen.append((tier, h))
+    plane = chaos.ChaosPlane(seed=4)
+    # every frame from peer 5 decodes corrupt (retries included)
+    plane.rule("kvbm.remote_pull", "corrupt", match="5:")
+    with plane:
+        out = await puller.fetch_run([10, 11])
+    assert out == []  # nothing corrupt was staged
+    assert idx.suspects.get(5, 0) >= 1
+    assert 5 not in idx.holders.get(10, {})  # advertisements dropped
+    assert seen and seen[0] == ("remote", 10)
+    # with the plane gone and the peer re-advertised, pulls verify clean
+    for h in (10, 11):
+        idx.holders.setdefault(h, {}).setdefault(5, set()).add("g2")
+    out2 = await puller.fetch_run([10, 11])
+    assert [b[0] for b in out2] == [10, 11]
+    np.testing.assert_array_equal(out2[0][1], k)
+
+
+# --------------------------- disagg transfer ----------------------------
+
+
+def test_disagg_chunk_frame_crc_catches_tamper_and_splice():
+    from dynamo_tpu.disagg.transfer import (
+        KvLayout, decode_chunk_frame, encode_chunk_frame,
+    )
+
+    rng = np.random.default_rng(6)
+    k = rng.normal(size=(2, 4, 4, 2, 8)).astype(np.float32)
+    v = rng.normal(size=(2, 4, 4, 2, 8)).astype(np.float32)
+    layout = KvLayout.of(k, tp=1)
+    frame = encode_chunk_frame(1, k[:, 1:3], v[:, 1:3])
+    decode_chunk_frame(frame, layout)  # clean frame passes
+
+    flipped = dict(frame)
+    b = bytearray(flipped["k"])
+    b[0] ^= 0xFF
+    flipped["k"] = bytes(b)
+    with pytest.raises(ValueError, match="crc32"):
+        decode_chunk_frame(flipped, layout)
+
+    # the crc seeds with (block_start, block_count): a frame spliced
+    # onto the wrong range fails even with intact payload bytes
+    spliced = dict(frame)
+    spliced["block_start"] = 2
+    with pytest.raises(ValueError, match="crc32"):
+        decode_chunk_frame(spliced, layout)
+
+    legacy = dict(frame)
+    del legacy["crc"]  # unupgraded sender: passes
+    decode_chunk_frame(legacy, layout)
+
+
+# --------------------------- ledger attribution -------------------------
+
+
+def test_ledger_corruption_counts_without_dirtying_audits(tmp_path):
+    """corruption() is recorded at the consume site, not derived by an
+    audit sweep — the violation counter moves, a quarantine tape entry
+    lands, the first per tier snapshots the flight recorder, and a
+    subsequent reconciliation audit stays clean."""
+    from dynamo_tpu import obs
+
+    led = KvLedger()
+    tr = obs.Tracer(out_path=str(tmp_path / "trace.json"))
+    tr.install()
+    try:
+        led.corruption("g4", 0xABC)
+        led.corruption("g4", 0xDEF)
+        led.corruption("remote", 0x123)
+    finally:
+        tr.uninstall()
+    vk = led.violations_by_kind()
+    assert vk["corrupt"]["g4"] == 2
+    assert vk["corrupt"]["remote"] == 1
+    # first corruption per tier dumps the flight recorder (2 tiers)
+    assert len(tr.flight_dumps) == 2
+    report = led.finish_audit([], where="test")
+    assert report["clean"]  # corrupt never comes from the sweep
+    # the /debug/kv payload carries the totals + the quarantine tape ops
+    snap = led.dump()
+    assert snap["violations_total"]["corrupt"]["g4"] == 2
+    assert any(e["op"] == "quarantine" for e in snap["events_tail"])
+
+
+# --------------------------- mocker parity ------------------------------
+
+
+def test_sim_g4_corrupt_quarantines_and_attributes_like_the_manager():
+    from dynamo_tpu.mocker.kv_cache_sim import KvCacheSim, SimObjectStore
+
+    led = KvLedger()
+    store = SimObjectStore()
+    seen = []
+    sim = KvCacheSim(num_blocks=8, ledger=led, object_store=store,
+                     breaker=TierBreaker(("g4",), threshold=3),
+                     g4_deadline_s=0.05,
+                     on_corruption=lambda t, h: seen.append((t, h)))
+    store.put(101)
+    plane = chaos.ChaosPlane(seed=7)
+    plane.rule("kvbm.object_io", "corrupt", times=1, match="get:")
+    with plane:
+        out = sim.allocate("s1", [101], 1)
+    assert out is not None
+    assert out.onboarded == {}  # corrupt lookup never onboards
+    assert ([], [101], "g4") in out.tier_events  # removed(g4) published
+    assert 101 not in store  # quarantined fleet-wide
+    assert seen == [("g4", 101)]
+    assert led.violations_by_kind()["corrupt"]["g4"] == 1
+    assert sim.breaker.state("g4") == "closed"  # data fault, mount fine
+    # the block was recomputed into G1: same-tenant reuse proceeds
+    sim.free("s1")
+    out2 = sim.allocate("s2", [101], 1)
+    assert out2.cached_blocks == 1
+
+
+def test_sim_g4_stall_charges_deadline_and_trips_breaker():
+    from dynamo_tpu.mocker.kv_cache_sim import KvCacheSim, SimObjectStore
+
+    clk = [0.0]
+    br = TierBreaker(("g4",), threshold=3, cooldown_s=5.0,
+                     clock=lambda: clk[0])
+    store = SimObjectStore()
+    sim = KvCacheSim(num_blocks=16, object_store=store, breaker=br,
+                     g4_deadline_s=0.05)
+    for h in (201, 202, 203, 204):
+        store.put(h)
+    plane = chaos.ChaosPlane(seed=8)
+    plane.rule("kvbm.object_io", "stall", times=3, match="get:")
+    with plane:
+        for i, h in enumerate((201, 202, 203)):
+            sim.allocate(f"s{i}", [h], 1)
+    # each stall charged one deadline of SIMULATED time (no real sleep)
+    assert sim.io_penalty_s == pytest.approx(3 * 0.05)
+    assert sim.io_failures == {"timeout": 3}
+    assert br.state("g4") == "open" and br.trips("g4") == 1
+    # open breaker: the store is not even consulted
+    out = sim.allocate("s4", [204], 1)
+    assert out.onboarded == {}
+    clk[0] = 5.0  # cooldown elapsed: half-open probe onboards + recloses
+    sim.free("s4")
+    sim.clear_cached()
+    out2 = sim.allocate("s5", [204], 1)
+    assert out2.onboarded == {"g4": 1}
+    assert br.state("g4") == "closed"

@@ -23,7 +23,7 @@ from dynamo_tpu.lint.core import canon_path
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-ALL_RULES = tuple(f"DYN{i:03d}" for i in range(1, 14))
+ALL_RULES = tuple(f"DYN{i:03d}" for i in range(1, 15))
 
 
 def run(src, path="dynamo_tpu/engine/snippet.py", rules=None):
@@ -506,6 +506,43 @@ def test_dyn013_applies_in_tests_and_suppresses():
     src = ("a._free.append(3)  "
            "# dynlint: disable=DYN013 seeding the fault the auditor must catch\n")
     assert lint.run_source(src, "tests/test_something.py") == []
+
+
+# ------------------- DYN014: raw npz of block payloads -------------------
+
+def test_dyn014_flags_raw_npz_outside_sanctioned_helpers():
+    bad = run("""
+        import numpy as np
+
+        def restore(path, arrays):
+            np.savez(path, **arrays)             # skips the crc stamp
+            blob = np.load(path)                 # skips the verify
+            np.savez_compressed(path, **arrays)
+            return blob
+        """, path="dynamo_tpu/engine/core.py")
+    assert rule_ids(bad) == ["DYN014"]
+    assert len(bad) == 3
+
+
+def test_dyn014_sanctioned_modules_and_tests_exempt():
+    src = """
+        import numpy as np
+
+        def _load_block(path):
+            return np.load(path)
+        """
+    # kvbm/pools.py IS the checksummed helper layer
+    assert run(src, path="dynamo_tpu/kvbm/pools.py") == []
+    # multimodal decodes media tensors, not KV block payloads
+    assert run(src, path="dynamo_tpu/multimodal/encoder.py") == []
+    # tests craft corrupt/legacy blobs on purpose — out of scope
+    assert run(src, path="tests/test_kv_integrity.py") == []
+
+
+def test_dyn014_suppresses_with_reason():
+    src = ("blob = np.load(path)  "
+           "# dynlint: disable=DYN014 reading a non-block npz artifact\n")
+    assert lint.run_source(src, "dynamo_tpu/engine/core.py") == []
 
 
 # --------------------------- suppressions -------------------------------
